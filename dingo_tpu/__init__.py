@@ -1,0 +1,26 @@
+"""dingo-tpu: a TPU-native rebuild of dingodb/dingo-store.
+
+A distributed Key-Value storage system on multi-Raft replication groups whose
+Index role serves high-dimensional vector search. The reference's ANN compute
+path (faiss + src/simd AVX kernels) is rebuilt TPU-first: region-local vectors
+live in (sharded) JAX arrays, and distance / top-k / IVF / PQ kernels run as
+jit'd XLA / Pallas programs.
+
+Layering (mirrors SURVEY.md §1, TPU-first re-design):
+
+    server/       RPC services (grpc)           <- reference src/server/
+    engine/       Storage facade + engines      <- reference src/engine/
+    raft/         Raft consensus + state machine<- reference src/raft, src/log
+    mvcc/         MVCC codec / reader / TSO     <- reference src/mvcc/
+    index/        Vector index families         <- reference src/vector/
+    ops/          TPU kernels (XLA/Pallas)      <- reference src/simd/ + faiss
+    parallel/     Mesh sharding / collectives   <- (TPU-native; no reference
+                                                   analog: replaces ThreadPool
+                                                   batch parallelism)
+    coordinator/  Cluster control plane         <- reference src/coordinator/
+    store/        Store-side control            <- reference src/store/
+    coprocessor/  Scalar filter / aggregation   <- reference src/coprocessor/
+    common/       Runtime utils (config, crontab, failpoint, tracker, metrics)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,21 @@
+"""TPU kernel layer: pure JAX/XLA/Pallas numerics with no framework deps.
+
+Replaces the reference's SIMD hook surface (src/simd/hook.h:23-31 —
+fvec_L2sqr / fvec_inner_product / fvec_norm_L2sqr / ... with runtime
+AVX512/AVX2/SSE dispatch) and the faiss compute kernels behind the
+VectorIndex hierarchy. Everything here is batched and jit-friendly:
+distance computation is an MXU matmul, k-selection is lax.top_k, binary
+(hamming) distance is a ±1 matmul, IVF/PQ training is on-device k-means.
+"""
+
+from dingo_tpu.ops.distance import (  # noqa: F401
+    Metric,
+    pairwise_l2sqr,
+    pairwise_inner_product,
+    pairwise_cosine,
+    pairwise_hamming,
+    score_matrix,
+    scores_to_distances,
+    squared_norms,
+)
+from dingo_tpu.ops.topk import topk_scores, merge_topk  # noqa: F401

@@ -1,0 +1,167 @@
+"""On-device k-means for IVF coarse quantizers and PQ codebooks.
+
+Replaces faiss::Clustering (used by the reference's IVF_FLAT/IVF_PQ training:
+vector_index_ivf_flat.cc Train, vector_index_ivf_pq.cc:337-341 where train
+size is derived from ClusteringParameters.max_points_per_centroid * nlist).
+
+TPU design: Lloyd's iterations where BOTH phases are matmuls —
+  assign:  argmax over the [chunk, k] score matrix (MXU)
+  update:  one-hot(assign)^T @ x  accumulated over chunks (MXU again)
+Data is processed in fixed-size chunks under lax.scan so arbitrary n compiles
+to one program; empty clusters are re-seeded from the globally farthest
+points (faiss re-assigns empty clusters similarly).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dingo_tpu.ops.distance import pairwise_l2sqr, squared_norms
+
+#: max_points_per_centroid default in faiss ClusteringParameters is 256;
+#: the reference derives IVF train sizes from it (vector_index_ivf_pq.cc:337).
+MAX_POINTS_PER_CENTROID = 256
+
+
+def _pad_to_multiple(x: jax.Array, m: int) -> Tuple[jax.Array, jax.Array]:
+    n = x.shape[0]
+    pad = (-n) % m
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, x.shape[1]), x.dtype)])
+    valid = (jnp.arange(n + pad) < n)
+    return x, valid
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def farthest_first_init(x: jax.Array, first_idx: jax.Array, k: int) -> jax.Array:
+    """Deterministic k-means++-style seeding: greedy farthest-first traversal.
+
+    Replaces faiss's random-subsample init; being deterministic keeps index
+    Train() reproducible across raft peers (the reference trains on the leader
+    and ships the index via snapshot — we keep training reproducible instead).
+    Returns [k] int32 indices into x.
+    """
+    x = x.astype(jnp.float32)
+    n = x.shape[0]
+    x_sq = squared_norms(x)
+
+    def body(carry, _):
+        min_d, chosen, i = carry
+        c = x[chosen[i - 1]]
+        d = x_sq - 2.0 * jnp.einsum('nd,d->n', x, c, precision=jax.lax.Precision.HIGHEST) + jnp.dot(c, c, precision=jax.lax.Precision.HIGHEST)
+        min_d = jnp.minimum(min_d, d)
+        nxt = jnp.argmax(min_d).astype(jnp.int32)
+        chosen = chosen.at[i].set(nxt)
+        return (min_d, chosen, i + 1), None
+
+    chosen0 = jnp.zeros((k,), jnp.int32).at[0].set(first_idx.astype(jnp.int32))
+    (_, chosen, _), _ = jax.lax.scan(
+        body, (jnp.full((n,), jnp.inf), chosen0, 1), None, length=k - 1
+    )
+    return chosen
+
+
+@functools.partial(jax.jit, static_argnames=("k", "iters", "chunk"))
+def kmeans_fit(
+    x: jax.Array,
+    seed_idx: jax.Array,
+    k: int,
+    iters: int = 10,
+    chunk: int = 16384,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fit k centroids to x[n, d] with Lloyd's algorithm.
+
+    seed_idx: [k] int32 initial centroid row indices (host picks a random
+    permutation — keeps this function deterministic/jit-pure).
+    Returns (centroids[k, d] f32, cluster_sizes[k] f32).
+    """
+    x = x.astype(jnp.float32)
+    n, d = x.shape
+    chunk = min(chunk, max(256, n))
+    xp, valid = _pad_to_multiple(x, chunk)
+    nchunks = xp.shape[0] // chunk
+    xc = xp.reshape(nchunks, chunk, d)
+    vc = valid.reshape(nchunks, chunk)
+
+    centroids = jnp.take(x, seed_idx, axis=0)
+
+    def lloyd_iter(centroids, _):
+        def body(carry, inp):
+            sums, counts, far_d, far_pt = carry
+            xi, vi = inp
+            dist = pairwise_l2sqr(xi, centroids)          # [chunk, k]
+            assign = jnp.argmin(dist, axis=1)
+            best = jnp.min(dist, axis=1)
+            onehot = jax.nn.one_hot(assign, k, dtype=jnp.float32)
+            onehot = onehot * vi[:, None]
+            sums = sums + jnp.einsum('ck,cd->kd', onehot, xi, precision=jax.lax.Precision.HIGHEST)
+            counts = counts + onehot.sum(axis=0)
+            # Track the single farthest point for empty-cluster reseeding.
+            best = jnp.where(vi, best, -jnp.inf)
+            j = jnp.argmax(best)
+            better = best[j] > far_d
+            far_d = jnp.where(better, best[j], far_d)
+            far_pt = jnp.where(better, xi[j], far_pt)
+            return (sums, counts, far_d, far_pt), None
+
+        init = (
+            jnp.zeros((k, d), jnp.float32),
+            jnp.zeros((k,), jnp.float32),
+            -jnp.inf,
+            jnp.zeros((d,), jnp.float32),
+        )
+        (sums, counts, _, far_pt), _ = jax.lax.scan(body, init, (xc, vc))
+        empty = counts < 0.5
+        new_c = sums / jnp.maximum(counts, 1.0)[:, None]
+        # Empty clusters: keep old centroid, except the first empty one which
+        # jumps to the farthest point (cheap on-device splitting heuristic).
+        new_c = jnp.where(empty[:, None], centroids, new_c)
+        first_empty = jnp.argmax(empty)
+        any_empty = jnp.any(empty)
+        new_c = jnp.where(
+            (jnp.arange(k) == first_empty)[:, None] & any_empty,
+            far_pt[None, :],
+            new_c,
+        )
+        return new_c, counts
+
+    centroids, counts = jax.lax.scan(lloyd_iter, centroids, None, length=iters)
+    return centroids, counts[-1]
+
+
+def train_kmeans(
+    x: jax.Array, k: int, iters: int = 10, seed: int = 0
+) -> Tuple[jax.Array, jax.Array]:
+    """Host-convenience trainer: farthest-first init + Lloyd iterations.
+
+    Deterministic given (data, seed) — see farthest_first_init docstring."""
+    import numpy as _np
+
+    first = _np.random.default_rng(seed).integers(0, x.shape[0])
+    seeds = farthest_first_init(x, jnp.int32(first), k)
+    return kmeans_fit(x, seeds, k=k, iters=iters)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def kmeans_assign(
+    x: jax.Array, centroids: jax.Array, chunk: int = 16384
+) -> jax.Array:
+    """Nearest-centroid assignment [n] int32, chunked for memory."""
+    x = x.astype(jnp.float32)
+    n, d = x.shape
+    chunk = min(chunk, max(256, n))
+    xp, _ = _pad_to_multiple(x, chunk)
+    nchunks = xp.shape[0] // chunk
+    xc = xp.reshape(nchunks, chunk, d)
+    c_sq = squared_norms(centroids)
+
+    def body(_, xi):
+        dist = pairwise_l2sqr(xi, centroids, c_sq)
+        return None, jnp.argmin(dist, axis=1).astype(jnp.int32)
+
+    _, assign = jax.lax.scan(body, None, xc)
+    return assign.reshape(-1)[:n]

@@ -1,0 +1,29 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Mirrors the reference's single-process multi-peer raft tests
+(test/unit_test/test_raft_node.cc:125-199): all "distributed" behavior is
+exercised in one process. Here the device mesh itself is virtualized so
+sharding/collective code paths compile and run without TPU hardware.
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The image's sitecustomize (/root/.axon_site) imports jax at interpreter
+# startup with JAX_PLATFORMS=axon already baked in, so the env var alone is
+# too late — override through the config API before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Make the repo root importable regardless of pytest rootdir.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

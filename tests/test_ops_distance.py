@@ -1,0 +1,157 @@
+"""Kernel-level numeric tests vs numpy reference.
+
+Mirrors the reference's SIMD correctness suites
+(test/unit_test/vector/test_vector_index_flat_simd.cc etc.): every distance
+kernel is validated against a straightforward numpy implementation.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dingo_tpu.ops import (
+    Metric,
+    pairwise_l2sqr,
+    pairwise_inner_product,
+    pairwise_cosine,
+    pairwise_hamming,
+    score_matrix,
+    scores_to_distances,
+    squared_norms,
+)
+from dingo_tpu.ops.topk import topk_scores, merge_topk, merge_sharded_topk
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(42)
+    q = rng.standard_normal((7, 64), dtype=np.float32)
+    x = rng.standard_normal((200, 64), dtype=np.float32)
+    return q, x
+
+
+def np_l2sqr(q, x):
+    return ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+
+
+def test_l2sqr_matches_numpy(data):
+    q, x = data
+    got = np.asarray(pairwise_l2sqr(jnp.array(q), jnp.array(x)))
+    np.testing.assert_allclose(got, np_l2sqr(q, x), rtol=5e-3, atol=5e-2)
+
+
+def test_l2sqr_with_cached_norms(data):
+    q, x = data
+    xs = squared_norms(jnp.array(x))
+    got = np.asarray(pairwise_l2sqr(jnp.array(q), jnp.array(x), xs))
+    np.testing.assert_allclose(got, np_l2sqr(q, x), rtol=5e-3, atol=5e-2)
+
+
+def test_inner_product_matches_numpy(data):
+    q, x = data
+    got = np.asarray(pairwise_inner_product(jnp.array(q), jnp.array(x)))
+    np.testing.assert_allclose(got, q @ x.T, rtol=2e-3, atol=2e-3)
+
+
+def test_cosine_matches_numpy(data):
+    q, x = data
+    qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+    xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+    got = np.asarray(pairwise_cosine(jnp.array(q), jnp.array(x)))
+    np.testing.assert_allclose(got, qn @ xn.T, rtol=5e-3, atol=5e-3)
+
+
+def test_hamming_matches_numpy():
+    rng = np.random.default_rng(0)
+    nbits = 128
+    a = rng.integers(0, 256, (5, nbits // 8), dtype=np.uint8)
+    b = rng.integers(0, 256, (31, nbits // 8), dtype=np.uint8)
+    want = np.zeros((5, 31))
+    for i in range(5):
+        for j in range(31):
+            want[i, j] = bin(
+                int.from_bytes(a[i].tobytes(), "little")
+                ^ int.from_bytes(b[j].tobytes(), "little")
+            ).count("1")
+    got = np.asarray(pairwise_hamming(jnp.array(a), jnp.array(b), nbits))
+    np.testing.assert_allclose(got, want, atol=1e-3)
+
+
+def test_score_matrix_roundtrip(data):
+    q, x = data
+    for metric in (Metric.L2, Metric.INNER_PRODUCT, Metric.COSINE):
+        s = score_matrix(jnp.array(q), jnp.array(x), metric)
+        d = scores_to_distances(s, metric)
+        if metric is Metric.L2:
+            np.testing.assert_allclose(
+                np.asarray(d), np_l2sqr(q, x), rtol=5e-3, atol=5e-2
+            )
+
+
+def test_topk_exact(data):
+    q, x = data
+    d = np_l2sqr(q, x)
+    scores = jnp.array(-d)
+    vals, ids = topk_scores(scores, 10)
+    want_ids = np.argsort(d, axis=1)[:, :10]
+    # Compare distance values (ties can permute ids).
+    np.testing.assert_allclose(
+        -np.asarray(vals), np.take_along_axis(d, want_ids, 1), rtol=5e-3, atol=5e-2
+    )
+
+
+def test_topk_mask_and_external_ids(data):
+    q, x = data
+    d = np_l2sqr(q, x)
+    valid = np.ones(200, bool)
+    valid[::2] = False  # mask half
+    ext_ids = np.arange(1000, 1200, dtype=np.int64)
+    vals, ids = topk_scores(
+        jnp.array(-d), 5, valid=jnp.array(valid), ids=jnp.array(ext_ids)
+    )
+    ids = np.asarray(ids)
+    assert ((ids - 1000) % 2 == 1).all()  # only odd slots survive
+    dm = np.where(valid[None, :], d, np.inf)
+    want = np.sort(dm, axis=1)[:, :5]
+    np.testing.assert_allclose(-np.asarray(vals), want, rtol=5e-3, atol=5e-2)
+
+
+def test_topk_k_larger_than_n():
+    scores = jnp.array([[1.0, 0.5]])
+    vals, ids = topk_scores(scores, 4)
+    assert np.asarray(ids).tolist()[0][:2] == [0, 1]
+    assert (np.asarray(ids)[0, 2:] == -1).all()
+
+
+def test_topk_fully_masked_returns_minus_one():
+    scores = jnp.zeros((2, 8))
+    vals, ids = topk_scores(scores, 3, valid=jnp.zeros(8, bool))
+    assert (np.asarray(ids) == -1).all()
+
+
+def test_merge_topk(data):
+    q, x = data
+    d = np_l2sqr(q, x)
+    half = 100
+    v1, i1 = topk_scores(jnp.array(-d[:, :half]), 10, ids=jnp.arange(half))
+    v2, i2 = topk_scores(
+        jnp.array(-d[:, half:]), 10, ids=jnp.arange(half, 200)
+    )
+    vals, ids = merge_topk(v1, i1, v2, i2, 10)
+    want = np.sort(d, axis=1)[:, :10]
+    np.testing.assert_allclose(-np.asarray(vals), want, rtol=5e-3, atol=5e-2)
+
+
+def test_merge_sharded_topk(data):
+    q, x = data
+    d = np_l2sqr(q, x)
+    shards = []
+    for s in range(4):
+        sl = slice(s * 50, (s + 1) * 50)
+        v, i = topk_scores(jnp.array(-d[:, sl]), 10, ids=jnp.arange(200)[sl])
+        shards.append((v, i))
+    sv = jnp.stack([v for v, _ in shards])
+    si = jnp.stack([i for _, i in shards])
+    vals, ids = merge_sharded_topk(sv, si, 10)
+    want = np.sort(d, axis=1)[:, :10]
+    np.testing.assert_allclose(-np.asarray(vals), want, rtol=5e-3, atol=5e-2)

@@ -1,0 +1,95 @@
+"""k-means + PQ kernel tests (training quality, encode/ADC numerics).
+
+Mirrors the reference's IVF/PQ train+boundary suites
+(test/unit_test/vector/test_vector_index_ivf_flat.cc,
+ test_vector_index_raw_ivf_pq_boundary.cc)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from dingo_tpu.ops.kmeans import kmeans_assign, kmeans_fit, train_kmeans
+from dingo_tpu.ops.pq import (
+    adc_lut,
+    adc_scan,
+    pq_encode,
+    pq_reconstruct,
+    pq_train,
+)
+
+
+def make_blobs(rng, k=8, per=200, d=32, spread=0.05):
+    centers = rng.standard_normal((k, d)).astype(np.float32) * 3
+    x = np.concatenate(
+        [c + spread * rng.standard_normal((per, d)).astype(np.float32) for c in centers]
+    )
+    return x, centers
+
+
+def test_kmeans_recovers_blobs():
+    rng = np.random.default_rng(1)
+    x, centers = make_blobs(rng)
+    c, counts = train_kmeans(jnp.array(x), k=8, iters=15)
+    c = np.asarray(c)
+    # Every true center has a learned centroid nearby.
+    d = ((centers[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+    assert (d.min(axis=1) < 0.5).all(), d.min(axis=1)
+    assert np.asarray(counts).sum() == len(x)
+
+
+def test_kmeans_assign_consistent():
+    rng = np.random.default_rng(2)
+    x, _ = make_blobs(rng, k=4, per=100)
+    c, _ = train_kmeans(jnp.array(x), k=4, iters=10)
+    a = np.asarray(kmeans_assign(jnp.array(x), c))
+    # numpy argmin agreement
+    d = ((x[:, None, :] - np.asarray(c)[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(a, d.argmin(axis=1))
+
+
+def test_kmeans_empty_cluster_reseed():
+    rng = np.random.default_rng(3)
+    # 2 tight blobs but ask for 4 clusters: forces empties; should not NaN.
+    x, _ = make_blobs(rng, k=2, per=50, d=8, spread=0.01)
+    seed = np.array([0, 1, 2, 3], np.int32)
+    c, _ = kmeans_fit(jnp.array(x), jnp.array(seed), k=4, iters=8)
+    assert np.isfinite(np.asarray(c)).all()
+
+
+def test_pq_encode_decode_error_small():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2000, 64)).astype(np.float32)
+    cb = pq_train(jnp.array(x), m=8, iters=8)
+    codes = pq_encode(jnp.array(x), cb)
+    assert codes.shape == (2000, 8) and codes.dtype == jnp.uint8
+    recon = np.asarray(pq_reconstruct(codes, cb))
+    rel = np.linalg.norm(recon - x) / np.linalg.norm(x)
+    assert rel < 0.75, rel  # 8 bytes for 256 f32 dims: coarse but bounded
+
+
+def test_adc_matches_reconstruction_distance():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((512, 32)).astype(np.float32)
+    q = rng.standard_normal((4, 32)).astype(np.float32)
+    cb = pq_train(jnp.array(x), m=4, iters=8)
+    codes = pq_encode(jnp.array(x), cb)
+    lut = adc_lut(jnp.array(q), cb)
+    d_adc = np.asarray(adc_scan(lut, codes))
+    recon = np.asarray(pq_reconstruct(codes, cb))
+    d_exact = ((q[:, None, :] - recon[None, :, :]) ** 2).sum(-1)
+    # ADC == exact distance to the reconstruction, up to bf16 matmul noise.
+    np.testing.assert_allclose(d_adc, d_exact, rtol=2e-2, atol=2e-1)
+
+
+def test_adc_recall_vs_exact():
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((4096, 64)).astype(np.float32)
+    q = rng.standard_normal((16, 64)).astype(np.float32)
+    cb = pq_train(jnp.array(x), m=16, iters=10)
+    codes = pq_encode(jnp.array(x), cb)
+    lut = adc_lut(jnp.array(q), cb)
+    d_adc = np.asarray(adc_scan(lut, codes))
+    d_exact = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    got = np.argsort(d_adc, 1)[:, :10]
+    want = np.argsort(d_exact, 1)[:, :10]
+    recall = np.mean([len(set(g) & set(w)) / 10 for g, w in zip(got, want)])
+    assert recall >= 0.5, recall  # PQ16 on random gaussian data
