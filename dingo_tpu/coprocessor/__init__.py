@@ -1,0 +1,11 @@
+"""Coprocessor: pushdown scalar filtering and aggregation.
+
+Mirrors reference src/coprocessor/ (CoprocessorScalar for schema-typed
+comparisons, CoprocessorV2 + rel-expression VM, AggregationManager)."""
+
+from dingo_tpu.coprocessor.scalar_filter import (  # noqa: F401
+    CmpOp,
+    ScalarPredicate,
+    ScalarFilter,
+)
+from dingo_tpu.coprocessor.aggregation import Aggregator  # noqa: F401

@@ -1,0 +1,4 @@
+"""Engines: raw KV storage, replication engines, storage facade, txn.
+
+Mirrors reference src/engine/ (raw_engine.h, engine.h, storage.{h,cc},
+txn_engine_helper.{h,cc})."""

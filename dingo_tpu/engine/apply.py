@@ -1,0 +1,148 @@
+"""Apply handlers: committed write payloads -> raw engine + vector index.
+
+Reference: src/handler/raft_apply_handler.{h,cc} — per-command-type handlers
+dispatched from StoreStateMachine::on_apply (store_state_machine.cc:110-216).
+The same handlers serve both the raft path (every replica applies the
+committed entry) and the mono path (single-replica direct apply), which is
+exactly how MonoStoreEngine reuses them in the reference.
+
+Key invariant (§3.2): the raw engine write happens FIRST (source of truth),
+then the vector index is updated iff log_id > wrapper.apply_log_id — the
+in-memory ANN index is an apply-log-tracked materialized view that can always
+be rebuilt from the engine.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from dingo_tpu.engine.raw_engine import (
+    CF_DEFAULT,
+    CF_VECTOR_SCALAR,
+    RawEngine,
+    WriteBatch,
+)
+from dingo_tpu.engine import write_data as wd
+from dingo_tpu.index import codec as vcodec
+from dingo_tpu.index.vector_reader import serialize_scalar, serialize_vector
+from dingo_tpu.mvcc.codec import Codec, ValueFlag
+from dingo_tpu.store.region import Region
+
+
+def apply_write(
+    engine: RawEngine, region: Region, data: wd.WriteData, log_id: int = 0
+) -> None:
+    """Dispatch one committed payload (RaftApplyHandlerFactory equivalent)."""
+    if isinstance(data, wd.KvPutData):
+        _apply_kv_put(engine, data)
+    elif isinstance(data, wd.KvDeleteData):
+        _apply_kv_delete(engine, data)
+    elif isinstance(data, wd.KvDeleteRangeData):
+        _apply_kv_delete_range(engine, data)
+    elif isinstance(data, wd.VectorAddData):
+        _apply_vector_add(engine, region, data, log_id)
+    elif isinstance(data, wd.VectorDeleteData):
+        _apply_vector_delete(engine, region, data, log_id)
+    elif isinstance(data, wd.TxnRaftData):
+        _apply_txn(engine, data)
+    else:
+        raise TypeError(f"unknown write payload {type(data)}")
+
+
+def _apply_kv_put(engine: RawEngine, data: wd.KvPutData) -> None:
+    batch = WriteBatch()
+    flag = ValueFlag.PUT_TTL if data.ttl_ms else ValueFlag.PUT
+    for key, value in data.kvs:
+        batch.put(
+            data.cf,
+            Codec.encode_key(key, data.ts),
+            Codec.package_value(value, flag, data.ttl_ms),
+        )
+    engine.write(batch)
+
+
+def _apply_kv_delete(engine: RawEngine, data: wd.KvDeleteData) -> None:
+    batch = WriteBatch()
+    for key in data.keys:
+        batch.put(
+            data.cf,
+            Codec.encode_key(key, data.ts),
+            Codec.package_value(b"", ValueFlag.DELETE),
+        )
+    engine.write(batch)
+
+
+def _apply_kv_delete_range(engine: RawEngine, data: wd.KvDeleteRangeData) -> None:
+    """Range deletes drop whole encoded ranges (the reference issues RocksDB
+    DeleteRange on the raw engine rather than writing per-key tombstones)."""
+    batch = WriteBatch()
+    for start, end in data.ranges:
+        batch.delete_range(
+            data.cf, Codec.encode_bytes(start), Codec.encode_bytes(end)
+        )
+    engine.write(batch)
+
+
+def _apply_vector_add(
+    engine: RawEngine, region: Region, data: wd.VectorAddData, log_id: int
+) -> None:
+    """VectorAddHandler (raft_apply_handler.cc:1115): write data CF + scalar
+    CF (+ speed-up/table CFs when schemas exist), then update the index."""
+    part = region.definition.partition_id
+    batch = WriteBatch()
+    flag = ValueFlag.PUT_TTL if data.ttl_ms else ValueFlag.PUT
+    for i, vid in enumerate(data.ids):
+        key = vcodec.encode_vector_key(part, int(vid))
+        ekey = Codec.encode_key(key, data.ts)
+        batch.put(
+            CF_DEFAULT,
+            ekey,
+            Codec.package_value(
+                serialize_vector(data.vectors[i]), flag, data.ttl_ms
+            ),
+        )
+        if data.scalars is not None:
+            batch.put(
+                CF_VECTOR_SCALAR,
+                ekey,
+                Codec.package_value(
+                    serialize_scalar(data.scalars[i]), flag, data.ttl_ms
+                ),
+            )
+    engine.write(batch)
+
+    wrapper = region.vector_index_wrapper
+    if wrapper is not None and wrapper.is_ready():
+        if data.is_update:
+            wrapper.add(data.ids, data.vectors, log_id, is_upsert=True)
+        else:
+            wrapper.add(data.ids, data.vectors, log_id, is_upsert=False)
+
+
+def _apply_vector_delete(
+    engine: RawEngine, region: Region, data: wd.VectorDeleteData, log_id: int
+) -> None:
+    part = region.definition.partition_id
+    batch = WriteBatch()
+    for vid in data.ids:
+        key = vcodec.encode_vector_key(part, int(vid))
+        ekey = Codec.encode_key(key, data.ts)
+        batch.put(CF_DEFAULT, ekey, Codec.package_value(b"", ValueFlag.DELETE))
+        batch.put(
+            CF_VECTOR_SCALAR, ekey, Codec.package_value(b"", ValueFlag.DELETE)
+        )
+    engine.write(batch)
+    wrapper = region.vector_index_wrapper
+    if wrapper is not None and wrapper.is_ready():
+        wrapper.delete(np.asarray(data.ids, np.int64), log_id)
+
+
+def _apply_txn(engine: RawEngine, data: wd.TxnRaftData) -> None:
+    batch = WriteBatch()
+    for cf, key, value in data.puts:
+        batch.put(cf, key, value)
+    for cf, key in data.deletes:
+        batch.delete(cf, key)
+    engine.write(batch)

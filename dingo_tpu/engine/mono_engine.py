@@ -1,0 +1,58 @@
+"""MonoStoreEngine: single-replica engine (no raft) with the Engine API.
+
+Reference: src/engine/mono_store_engine.{h,cc} — same reader/writer surface
+as RaftStoreEngine but writes apply directly through the handlers; used for
+MONO_STORE regions and single-node deployments. Keeping the apply path
+shared (engine/apply.py) means raft and mono regions behave identically
+after commit.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from dingo_tpu.engine.apply import apply_write
+from dingo_tpu.engine.raw_engine import RawEngine
+from dingo_tpu.engine.write_data import WriteData
+from dingo_tpu.index.vector_reader import ReaderContext, VectorReader
+from dingo_tpu.mvcc.codec import MAX_TS
+from dingo_tpu.store.region import Region
+
+
+class MonoStoreEngine:
+    def __init__(self, raw_engine: RawEngine):
+        self.raw = raw_engine
+        self._lock = threading.Lock()
+        self._log_ids: Dict[int, int] = {}  # per-region apply log counter
+
+    def next_log_id(self, region_id: int) -> int:
+        with self._lock:
+            n = self._log_ids.get(region_id, 0) + 1
+            self._log_ids[region_id] = n
+            return n
+
+    # -- Engine::Writer ------------------------------------------------------
+    def write(self, region: Region, data: WriteData) -> int:
+        """Synchronous apply; returns the log id (mono engine fakes the raft
+        log with a per-region counter so the wrapper's apply-log contract
+        stays identical)."""
+        log_id = self.next_log_id(region.id)
+        apply_write(self.raw, region, data, log_id)
+        return log_id
+
+    async_write = write  # mono apply is already synchronous
+
+    # -- Engine::VectorReader --------------------------------------------------
+    def new_vector_reader(self, region: Region, read_ts: int = MAX_TS) -> VectorReader:
+        ctx = ReaderContext(
+            region_id=region.id,
+            partition_id=region.definition.partition_id,
+            start_key=region.definition.start_key,
+            end_key=region.definition.end_key,
+            index_wrapper=region.vector_index_wrapper,
+            engine=self.raw,
+            read_ts=read_ts,
+            parameter=region.definition.index_parameter,
+        )
+        return VectorReader(ctx)

@@ -1,0 +1,200 @@
+"""Storage facade: role-agnostic entry points over the engines.
+
+Reference: src/engine/storage.{h,cc} (storage.h:33) — stateless dispatch that
+picks the engine (raft vs mono, GetStoreEngine storage.cc:65), stamps TSO
+timestamps (ts_provider_->GetTs(), storage.cc:460), validates requests, and
+exposes KvGet/KvPut/VectorAdd (storage.cc:458)/VectorBatchSearch
+(storage.cc:577)/Txn* to the RPC services.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dingo_tpu.engine import write_data as wd
+from dingo_tpu.engine.raw_engine import CF_DEFAULT
+from dingo_tpu.index.base import InvalidParameter
+from dingo_tpu.index.vector_reader import VectorWithData
+from dingo_tpu.mvcc.codec import MAX_TS
+from dingo_tpu.mvcc.reader import Reader as MvccReader
+from dingo_tpu.mvcc.ts_provider import TsProvider
+from dingo_tpu.store.region import Region
+
+#: FLAGS_vector_max_batch_count (index_service.cc:50)
+VECTOR_MAX_BATCH_COUNT = 4096
+#: FLAGS_vector_max_request_size (index_service.cc:51)
+VECTOR_MAX_REQUEST_SIZE = 32 * 1024 * 1024
+#: topN * batch guard (index_service.cc:206)
+MAX_TOPN_BATCH_PRODUCT = 10 * VECTOR_MAX_BATCH_COUNT
+
+
+class Storage:
+    def __init__(self, engine, ts_provider: Optional[TsProvider] = None):
+        """engine: MonoStoreEngine or RaftStoreEngine (same surface)."""
+        self.engine = engine
+        self.ts_provider = ts_provider or TsProvider()
+
+    # ---------------- KV ----------------------------------------------------
+
+    def kv_get(self, region: Region, key: bytes,
+               read_ts: int = MAX_TS) -> Optional[bytes]:
+        return MvccReader(self.engine.raw, CF_DEFAULT).kv_get(key, read_ts)
+
+    def kv_batch_get(self, region: Region, keys: Sequence[bytes],
+                     read_ts: int = MAX_TS) -> List[Optional[bytes]]:
+        reader = MvccReader(self.engine.raw, CF_DEFAULT)
+        return [reader.kv_get(k, read_ts) for k in keys]
+
+    def kv_put(self, region: Region, kvs: Sequence[Tuple[bytes, bytes]],
+               ttl_ms: int = 0) -> int:
+        ts = self.ts_provider.get_ts()
+        self.engine.write(
+            region, wd.KvPutData(cf=CF_DEFAULT, ts=ts, kvs=list(kvs),
+                                 ttl_ms=ttl_ms)
+        )
+        return ts
+
+    def kv_put_if_absent(
+        self, region: Region, kvs: Sequence[Tuple[bytes, bytes]]
+    ) -> List[bool]:
+        """KvPutIfAbsent semantics: per-key success flags."""
+        reader = MvccReader(self.engine.raw, CF_DEFAULT)
+        ts = self.ts_provider.get_ts()
+        wins, results = [], []
+        for k, v in kvs:
+            if reader.kv_get(k, MAX_TS) is None:
+                wins.append((k, v))
+                results.append(True)
+            else:
+                results.append(False)
+        if wins:
+            self.engine.write(
+                region, wd.KvPutData(cf=CF_DEFAULT, ts=ts, kvs=wins)
+            )
+        return results
+
+    def kv_compare_and_set(
+        self, region: Region, key: bytes, expect: Optional[bytes], value: bytes
+    ) -> bool:
+        reader = MvccReader(self.engine.raw, CF_DEFAULT)
+        cur = reader.kv_get(key, MAX_TS)
+        if cur != expect:
+            return False
+        ts = self.ts_provider.get_ts()
+        self.engine.write(
+            region, wd.KvPutData(cf=CF_DEFAULT, ts=ts, kvs=[(key, value)])
+        )
+        return True
+
+    def kv_batch_delete(self, region: Region, keys: Sequence[bytes]) -> int:
+        ts = self.ts_provider.get_ts()
+        self.engine.write(
+            region, wd.KvDeleteData(cf=CF_DEFAULT, ts=ts, keys=list(keys))
+        )
+        return ts
+
+    def kv_delete_range(
+        self, region: Region, ranges: Sequence[Tuple[bytes, bytes]]
+    ) -> int:
+        ts = self.ts_provider.get_ts()
+        self.engine.write(
+            region,
+            wd.KvDeleteRangeData(cf=CF_DEFAULT, ts=ts, ranges=list(ranges)),
+        )
+        return ts
+
+    def kv_scan(
+        self,
+        region: Region,
+        start: bytes,
+        end: bytes,
+        limit: int = 0,
+        read_ts: int = MAX_TS,
+        keys_only: bool = False,
+    ) -> List[Tuple[bytes, bytes]]:
+        return MvccReader(self.engine.raw, CF_DEFAULT).kv_scan(
+            start, end, read_ts, limit, keys_only
+        )
+
+    # ---------------- vector -------------------------------------------------
+
+    def _validate_vector_batch(self, region: Region, ids, vectors) -> None:
+        if len(ids) != len(vectors):
+            raise InvalidParameter("ids/vectors length mismatch")
+        if len(ids) > VECTOR_MAX_BATCH_COUNT:
+            raise InvalidParameter(
+                f"batch {len(ids)} > {VECTOR_MAX_BATCH_COUNT}"
+            )
+        if vectors.nbytes > VECTOR_MAX_REQUEST_SIZE:
+            raise InvalidParameter("request exceeds 32MiB")
+        param = region.definition.index_parameter
+        if param and vectors.shape[1] != param.dimension:
+            raise InvalidParameter(
+                f"dimension {vectors.shape[1]} != {param.dimension}"
+            )
+        lo, hi = region.id_window()
+        ids = np.asarray(ids, np.int64)
+        if ((ids < lo) | (ids >= hi)).any():
+            raise InvalidParameter("vector id out of region range")
+
+    def vector_add(
+        self,
+        region: Region,
+        ids: np.ndarray,
+        vectors: np.ndarray,
+        scalars: Optional[List[Dict[str, Any]]] = None,
+        is_update: bool = True,
+        ttl_ms: int = 0,
+    ) -> int:
+        """Storage::VectorAdd (storage.cc:458-482): stamp TSO ts, build write
+        payload, hand to the engine (raft propose or mono apply)."""
+        vectors = np.asarray(vectors, np.float32)
+        ids = np.asarray(ids, np.int64)
+        self._validate_vector_batch(region, ids, vectors)
+        ts = self.ts_provider.get_ts()
+        self.engine.write(
+            region,
+            wd.VectorAddData(
+                ts=ts, ids=ids, vectors=vectors, scalars=scalars,
+                is_update=is_update, ttl_ms=ttl_ms,
+            ),
+        )
+        return ts
+
+    def vector_delete(self, region: Region, ids: Sequence[int]) -> int:
+        ts = self.ts_provider.get_ts()
+        self.engine.write(
+            region,
+            wd.VectorDeleteData(ts=ts, ids=np.asarray(ids, np.int64)),
+        )
+        return ts
+
+    def vector_batch_search(
+        self, region: Region, queries: np.ndarray, topk: int, **kw
+    ) -> List[List[VectorWithData]]:
+        """Storage::VectorBatchSearch (storage.cc:577)."""
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if len(queries) > VECTOR_MAX_BATCH_COUNT:
+            raise InvalidParameter("too many queries")
+        if topk * len(queries) > MAX_TOPN_BATCH_PRODUCT:
+            raise InvalidParameter(
+                "topN * batch exceeds guard (index_service.cc:206)"
+            )
+        reader = self.engine.new_vector_reader(region)
+        return reader.vector_batch_search(queries, topk, **kw)
+
+    def vector_batch_query(self, region: Region, ids: Sequence[int], **kw):
+        return self.engine.new_vector_reader(region).vector_batch_query(ids, **kw)
+
+    def vector_get_border_id(self, region: Region, get_min: bool):
+        return self.engine.new_vector_reader(region).vector_get_border_id(get_min)
+
+    def vector_scan_query(self, region: Region, **kw):
+        return self.engine.new_vector_reader(region).vector_scan_query(**kw)
+
+    def vector_count(self, region: Region) -> int:
+        return self.engine.new_vector_reader(region).vector_count()

@@ -1,0 +1,95 @@
+"""Typed write payloads (raft proposal bodies).
+
+Reference: src/engine/write_data.h (762 LoC) — WriteDataBuilder::BuildWrite
+constructs typed RaftCmdRequest payloads (KV puts, vector adds with cf/ts/ttl,
+deletes); the same payload is applied by the raft state machine on every
+replica (handler/raft_apply_handler.h:29-193).
+
+These dataclasses are the wire-neutral equivalents; raft serializes them with
+pickle for replication (a protobuf schema lands with the grpc service layer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class KvPutData:
+    """PutHandler payload."""
+
+    cf: str
+    ts: int
+    kvs: List[Tuple[bytes, bytes]]
+    ttl_ms: int = 0
+
+
+@dataclasses.dataclass
+class KvDeleteData:
+    """DeleteBatchHandler payload (tombstone versions)."""
+
+    cf: str
+    ts: int
+    keys: List[bytes]
+
+
+@dataclasses.dataclass
+class KvDeleteRangeData:
+    """DeleteRangeHandler payload."""
+
+    cf: str
+    ts: int
+    ranges: List[Tuple[bytes, bytes]]
+
+
+@dataclasses.dataclass
+class VectorAddData:
+    """VectorAddHandler payload (raft_apply_handler.cc:1115): vector rows +
+    scalar data; handler writes data/scalar/table CFs then updates the
+    in-memory index through the wrapper."""
+
+    ts: int
+    ids: np.ndarray                       # [n] int64
+    vectors: np.ndarray                   # [n, d] f32
+    scalars: Optional[List[Dict[str, Any]]] = None
+    is_update: bool = True                # upsert vs add
+    ttl_ms: int = 0
+
+
+@dataclasses.dataclass
+class VectorDeleteData:
+    """VectorDeleteHandler payload (raft_apply_handler.cc:1374)."""
+
+    ts: int
+    ids: np.ndarray
+
+
+@dataclasses.dataclass
+class RebuildVectorIndexData:
+    """RebuildVectorIndexHandler (raft_apply_handler.cc:1546): replicated
+    marker that a rebuild cutover happened at this log position."""
+
+    cutover_log_id: int = 0
+
+
+@dataclasses.dataclass
+class SplitRegionData:
+    """SplitHandler payload (raft_apply_handler.cc:702)."""
+
+    child_region_id: int
+    split_key: bytes
+
+
+@dataclasses.dataclass
+class TxnRaftData:
+    """TxnHandler payload (raft_apply_handler_txn.cc): pre-encoded CF writes
+    produced by the Percolator helper (engine/txn.py)."""
+
+    puts: List[Tuple[str, bytes, bytes]]
+    deletes: List[Tuple[str, bytes]]
+
+
+WriteData = Any  # union of the payload dataclasses above

@@ -1,0 +1,23 @@
+"""Vector index families (TPU-resident), mirroring reference src/vector/.
+
+Index types match pb::common::VectorIndexType:
+  FLAT        -> TpuFlat         (vector_index_flat.{h,cc})
+  IVF_FLAT    -> TpuIvfFlat      (vector_index_ivf_flat.{h,cc})
+  IVF_PQ      -> TpuIvfPq        (vector_index_ivf_pq.{h,cc}, hybrid flat->pq)
+  HNSW        -> TpuHnsw         (vector_index_hnsw.{h,cc}, CPU graph + TPU rerank)
+  BRUTEFORCE  -> TpuBruteforce   (vector_index_bruteforce.{h,cc})
+  BINARY_FLAT -> TpuBinaryFlat   (faiss::IndexBinaryFlat equivalent)
+"""
+
+from dingo_tpu.index.base import (  # noqa: F401
+    FilterSpec,
+    IndexParameter,
+    IndexType,
+    SearchResult,
+    VectorIndex,
+    VectorIndexError,
+    InvalidParameter,
+    NotSupported,
+    NotTrained,
+)
+from dingo_tpu.index.factory import new_index  # noqa: F401

@@ -1,0 +1,38 @@
+"""Index factory (reference VectorIndexFactory, src/vector/
+vector_index_factory.h:37-68: New/NewHnsw/NewFlat/NewIvfFlat/NewIvfPq/
+NewBruteForce/NewBinaryFlat/NewBinaryIVFFlat from VectorIndexParameter)."""
+
+from __future__ import annotations
+
+from dingo_tpu.index.base import IndexParameter, IndexType, InvalidParameter, VectorIndex
+
+
+def new_index(index_id: int, parameter: IndexParameter) -> VectorIndex:
+    t = parameter.index_type
+    if t is IndexType.FLAT:
+        from dingo_tpu.index.flat import TpuFlat
+
+        return TpuFlat(index_id, parameter)
+    if t is IndexType.BRUTEFORCE:
+        from dingo_tpu.index.flat import TpuBruteforce
+
+        return TpuBruteforce(index_id, parameter)
+    if t is IndexType.BINARY_FLAT:
+        from dingo_tpu.index.flat import TpuBinaryFlat
+
+        return TpuBinaryFlat(index_id, parameter)
+    if t is IndexType.IVF_FLAT:
+        from dingo_tpu.index.ivf_flat import TpuIvfFlat
+
+        return TpuIvfFlat(index_id, parameter)
+    if t is IndexType.IVF_PQ:
+        from dingo_tpu.index.ivf_pq import TpuIvfPq
+
+        return TpuIvfPq(index_id, parameter)
+    if t is IndexType.HNSW:
+        from dingo_tpu.index.hnsw import TpuHnsw
+
+        return TpuHnsw(index_id, parameter)
+    from dingo_tpu.index.base import NotSupported
+
+    raise NotSupported(f"index type {t} not implemented")

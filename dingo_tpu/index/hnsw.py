@@ -1,0 +1,276 @@
+"""TpuHnsw: CPU graph navigation + TPU exact re-rank.
+
+Reference: VectorIndexHnsw (src/vector/vector_index_hnsw.{h,cc} — wraps
+hnswlib::HierarchicalNSW with L2Space/InnerProductSpace,
+vector_index_hnsw.cc:154-181; NeedToRebuild when deleted count exceeds half
+the TOTAL element count :577-589; hnswlib-file Save/Load :310).
+
+TPU-first split (BASELINE config 4): graph construction and beam search are
+irregular pointer-chasing — they run in our own C++ NSW implementation
+(native/hnsw/hnsw.cc, an original implementation, not a copy of hnswlib).
+The graph returns an over-fetched candidate set (ef per query, CPU float
+distances), and the TPU re-ranks candidates with exact batched distances
+against the authoritative SlotStore copy — one gather + einsum + top-k
+kernel. This keeps CPU beam cost low (graph can use cheap distances) while
+final ordering matches the flat index bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import json
+import os
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dingo_tpu.index.base import (
+    FilterSpec,
+    IndexParameter,
+    InvalidParameter,
+    SearchResult,
+    VectorIndex,
+    strip_invalid,
+)
+from dingo_tpu.index.flat import _SlotStoreIndex, _pad_batch
+from dingo_tpu.index.slot_store import SlotStore
+from dingo_tpu.ops.distance import Metric, normalize
+from dingo_tpu.ops.topk import topk_scores
+
+_LIB = None
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        from dingo_tpu.native import load_hnsw
+
+        _LIB = load_hnsw()
+    return _LIB
+
+
+@functools.partial(jax.jit, static_argnames=("k", "ascending"))
+def _rerank_kernel(vecs, sqnorm, queries, cand_slots, cand_valid, k, ascending):
+    """Exact re-rank of per-query candidate slots.
+
+    vecs [cap, d], queries [b, d], cand_slots [b, ef] int32 (safe >= 0),
+    cand_valid [b, ef]. Returns (distances [b, k], slots [b, k])."""
+    cand = jnp.take(vecs, cand_slots, axis=0)           # [b, ef, d]
+    dots = jnp.einsum(
+        "bd,bed->be", queries, cand,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    if ascending:  # L2
+        q_sq = jnp.einsum(
+            "bd,bd->b", queries, queries,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        sq = jnp.take(sqnorm, cand_slots)               # [b, ef]
+        scores = -(q_sq[:, None] - 2.0 * dots + sq)
+    else:          # IP / cosine
+        scores = dots
+    scores = jnp.where(cand_valid, scores, -jnp.inf)
+    vals, idx = jax.lax.top_k(scores, k)
+    slots = jnp.take_along_axis(cand_slots, idx, axis=1)
+    slots = jnp.where(jnp.isneginf(vals), -1, slots)
+    dists = jnp.where(ascending, -vals, vals)
+    return dists, slots
+
+
+class TpuHnsw(_SlotStoreIndex):
+    def __init__(self, index_id: int, parameter: IndexParameter):
+        VectorIndex.__init__(self, index_id, parameter)
+        p = parameter
+        if p.dimension <= 0:
+            raise InvalidParameter(f"dimension {p.dimension}")
+        if p.metric is Metric.HAMMING:
+            raise InvalidParameter("hamming not valid for HNSW")
+        self.store = SlotStore(p.dimension, jnp.dtype(p.dtype))
+        self.ef_search_default = max(64, p.efconstruction // 2)
+        metric_code = 0 if p.metric is Metric.L2 else 1
+        self._graph = _lib().hnsw_new(
+            p.dimension, metric_code, p.nlinks, p.efconstruction, index_id
+        )
+        self._kernel_metric = p.metric
+        self._kernel_nbits = 0
+
+    def __del__(self):  # noqa: D105
+        try:
+            if getattr(self, "_graph", None):
+                _lib().hnsw_free(self._graph)
+        except Exception:
+            pass
+
+    # -- prep ---------------------------------------------------------------
+    def _prep_vectors(self, vectors: np.ndarray) -> np.ndarray:
+        vectors = np.ascontiguousarray(vectors, np.float32)
+        if vectors.ndim != 2 or vectors.shape[1] != self.dimension:
+            raise InvalidParameter(
+                f"vector dim {vectors.shape} != {self.dimension}"
+            )
+        if self.metric is Metric.COSINE:
+            vectors = np.ascontiguousarray(normalize(jnp.asarray(vectors)))
+        return vectors
+
+    def _prep_queries(self, queries: np.ndarray) -> np.ndarray:
+        queries = np.ascontiguousarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if queries.shape[1] != self.dimension:
+            raise InvalidParameter(
+                f"query dim {queries.shape[1]} != {self.dimension}"
+            )
+        if self.metric is Metric.COSINE:
+            queries = np.ascontiguousarray(normalize(jnp.asarray(queries)))
+        return queries
+
+    # -- mutation ------------------------------------------------------------
+    def upsert(self, ids: np.ndarray, vectors: np.ndarray) -> None:
+        vectors = self._prep_vectors(vectors)
+        ids = np.ascontiguousarray(ids, np.int64)
+        if len(ids) != len(vectors):
+            raise InvalidParameter("ids/vectors length mismatch")
+        self.store.put(ids, vectors)
+        _lib().hnsw_add(
+            self._graph,
+            len(ids),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            vectors.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        self.write_count_since_save += len(ids)
+
+    def delete(self, ids: np.ndarray) -> None:
+        ids = np.ascontiguousarray(ids, np.int64)
+        removed = self.store.remove(ids)
+        _lib().hnsw_delete(
+            self._graph, len(ids),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        self.write_count_since_save += removed
+
+    # -- search --------------------------------------------------------------
+    def search(
+        self,
+        queries: np.ndarray,
+        topk: int,
+        filter_spec: Optional[FilterSpec] = None,
+        ef: Optional[int] = None,
+    ) -> List[SearchResult]:
+        return self.search_async(queries, topk, filter_spec, ef)()
+
+    def search_async(
+        self,
+        queries: np.ndarray,
+        topk: int,
+        filter_spec: Optional[FilterSpec] = None,
+        ef: Optional[int] = None,
+    ):
+        queries = self._prep_queries(queries)
+        b = queries.shape[0]
+        ef = max(ef or self.ef_search_default, topk)
+        # 1) CPU graph: over-fetched candidate labels per query.
+        cand_labels = np.empty((b, ef), np.int64)
+        cand_d = np.empty((b, ef), np.float32)
+        _lib().hnsw_search(
+            self._graph, b,
+            queries.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ef, ef,
+            cand_labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            cand_d.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        # 2) host filter on candidates (graph has no filter pushdown; the
+        #    reference's HnswRangeFilterFunctor filters inside the beam —
+        #    over-fetch + post-filter keeps the graph branch-free instead).
+        flat = cand_labels.reshape(-1)
+        slots = self.store.slots_of(flat).reshape(b, ef)
+        valid = slots >= 0
+        if filter_spec is not None and not filter_spec.is_empty():
+            fmask = filter_spec.slot_mask(self.store.ids_by_slot)
+            safe = np.where(slots >= 0, slots, 0)
+            valid &= fmask[safe]
+        # 3) TPU exact re-rank.
+        qpad = jnp.asarray(_pad_batch(queries))
+        bb = qpad.shape[0]
+        if bb != b:
+            pad_rows = np.zeros((bb - b, ef), slots.dtype)
+            slots = np.concatenate([slots, pad_rows])
+            valid = np.concatenate([valid, np.zeros((bb - b, ef), bool)])
+        dists, out_slots = _rerank_kernel(
+            self.store.vecs,
+            self.store.sqnorm,
+            qpad,
+            jnp.asarray(np.where(slots >= 0, slots, 0), jnp.int32),
+            jnp.asarray(valid),
+            k=int(topk),
+            ascending=self.metric is Metric.L2,
+        )
+        store = self.store
+        lease = store.begin_search()
+        dists.copy_to_host_async()
+        out_slots.copy_to_host_async()
+        def resolve() -> List[SearchResult]:
+            try:
+                dists_h, slots_h = jax.device_get((dists, out_slots))
+                ids = store.ids_of_slots(slots_h[:b])
+                return [strip_invalid(i, d) for i, d in zip(ids, dists_h[:b])]
+            finally:
+                lease.release()
+
+        return resolve
+
+    # -- lifecycle ------------------------------------------------------------
+    def get_count(self) -> int:
+        return len(self.store)
+
+    def get_deleted_count(self) -> int:
+        return int(_lib().hnsw_deleted_count(self._graph))
+
+    def get_memory_size(self) -> int:
+        return self.store.memory_size() + int(_lib().hnsw_memory(self._graph))
+
+    def need_to_rebuild(self) -> bool:
+        """Reference trigger: deleted_count > total/2
+        (vector_index_hnsw.cc:577-589; note hnswlib's getCurrentElementCount
+        includes tombstones, so the threshold is half of TOTAL)."""
+        deleted = self.get_deleted_count()
+        total = deleted + self.get_count()
+        return total > 0 and deleted * 2 > total
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(path, "hnsw_vectors.npz"), **self.store.to_host())
+        size = _lib().hnsw_save_size(self._graph)
+        buf = np.empty(size, np.uint8)
+        written = _lib().hnsw_save(
+            self._graph, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        )
+        with open(os.path.join(path, "hnsw_graph.bin"), "wb") as f:
+            f.write(buf[:written].tobytes())
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(self._save_meta(), f)
+
+    def load(self, path: str) -> None:
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        self._check_meta(meta)
+        data = np.load(os.path.join(path, "hnsw_vectors.npz"))
+        self.store = SlotStore(
+            self.dimension, jnp.dtype(self.parameter.dtype),
+            max(len(data["ids"]), 1),
+        )
+        if len(data["ids"]):
+            self.store.put(np.asarray(data["ids"], np.int64), data["vectors"])
+        blob = np.fromfile(os.path.join(path, "hnsw_graph.bin"), np.uint8)
+        new_graph = _lib().hnsw_load(
+            blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(blob)
+        )
+        if not new_graph:
+            raise InvalidParameter("bad hnsw graph blob")
+        _lib().hnsw_free(self._graph)
+        self._graph = new_graph
+        self.apply_log_id = meta["apply_log_id"]
+        self.write_count_since_save = 0

@@ -1,0 +1,389 @@
+"""TpuIvfPq: IVF + product quantization with residual encoding and the
+reference's hybrid flat->pq lifecycle.
+
+Reference: VectorIndexIvfPq (src/vector/vector_index_ivf_pq.{h,cc}) is a
+**hybrid**: it serves exact search from an internal flat index until trained,
+then switches to faiss::IndexIVFPQ (vector_index_ivf_pq.h:113-115,
+VectorIndexSubType() vector_index.h:238). Train size derives from
+ClusteringParameters.max_points_per_centroid * nlist and
+ProductQuantizer(d, m, nbits) (vector_index_ivf_pq.cc:337-341).
+
+TPU-first design:
+  codes    — residual PQ (faiss IVFPQ by_residual convention): code(x) =
+             pq_encode(x - centroid[assign(x)]). Codes live in a device
+             [capacity, m] uint8 array updated incrementally on upsert;
+             a bucketed view [nlist, cap_list, m] groups codes by coarse
+             list (same scheme as ivf_flat.py).
+  search   — per probe rank r: residual LUT [b, m, ksub] for each query's
+             rank-r list (m vmapped tiny matmuls), then ADC over the gathered
+             code bucket via one take_along_axis ([b, m, cap_list]) + sum.
+             Running top-k across ranks.
+  fallback — untrained: exact flat-kernel scan over the SlotStore (the
+             hybrid contract; NOT an error, unlike IVF_FLAT).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dingo_tpu.index.base import (
+    FilterSpec,
+    IndexParameter,
+    InvalidParameter,
+    NotTrained,
+    SearchResult,
+    VectorIndex,
+    strip_invalid,
+)
+from dingo_tpu.index.flat import _SlotStoreIndex, _flat_search_kernel, _pad_batch
+from dingo_tpu.index.ivf_flat import _probe_lists
+from dingo_tpu.index.slot_store import SlotStore, _next_pow2
+from dingo_tpu.ops.distance import Metric, normalize, pairwise_l2sqr, squared_norms
+from dingo_tpu.ops.kmeans import (
+    MAX_POINTS_PER_CENTROID,
+    kmeans_assign,
+    train_kmeans,
+)
+from dingo_tpu.ops.pq import pq_train, split_subvectors
+from dingo_tpu.ops.topk import merge_topk
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _encode_residual(vectors, assign, centroids, codebooks):
+    """codes[n, m] uint8 for residuals (vectors - their centroid)."""
+    resid = vectors - jnp.take(centroids, assign, axis=0)
+    m, ksub, dsub = codebooks.shape
+    subs = split_subvectors(resid, m)                  # [m, n, dsub]
+
+    def enc_one(sub, cb):
+        return jnp.argmin(pairwise_l2sqr(sub, cb), axis=1)
+
+    return jax.vmap(enc_one)(subs, codebooks).T.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _ivfpq_scan_kernel(
+    code_buckets,      # [nlist, cap_list, m] uint8
+    bucket_valid,      # [nlist, cap_list] bool
+    bucket_slot,       # [nlist, cap_list] int32
+    probes,            # [b, nprobe] int32
+    queries,           # [b, d] f32
+    centroids,         # [nlist, d] f32
+    codebooks,         # [m, ksub, dsub] f32
+    k,
+):
+    """ADC scan over probed lists with per-(query, list) residual LUTs."""
+    b, d = queries.shape
+    m, ksub, dsub = codebooks.shape
+    nprobe = probes.shape[1]
+    neg_inf = jnp.float32(-jnp.inf)
+    cb_sq = jnp.einsum(
+        "mkd,mkd->mk", codebooks, codebooks,
+        precision=jax.lax.Precision.HIGHEST,
+    )                                                   # [m, ksub]
+
+    def body(carry, r):
+        best_vals, best_slots = carry
+        lists_r = jnp.take(probes, r, axis=1)           # [b]
+        qr = queries - jnp.take(centroids, lists_r, axis=0)   # residual targets
+        # LUT[b, m, ksub] = ||qr_sub - codeword||^2
+        qsubs = split_subvectors(qr, m)                 # [m, b, dsub]
+        dots = jnp.einsum(
+            "mbd,mkd->mbk", qsubs, codebooks,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        q_sq = jnp.einsum(
+            "mbd,mbd->mb", qsubs, qsubs,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        lut = q_sq[:, :, None] - 2.0 * dots + cb_sq[:, None, :]  # [m, b, ksub]
+        lut = jnp.transpose(lut, (1, 0, 2))             # [b, m, ksub]
+
+        codes = jnp.take(code_buckets, lists_r, axis=0)  # [b, cap, m]
+        val = jnp.take(bucket_valid, lists_r, axis=0)
+        slot = jnp.take(bucket_slot, lists_r, axis=0)
+        # ADC: dist[b, cap] = sum_m LUT[b, m, codes[b, cap, m]]
+        codes_t = jnp.transpose(codes, (0, 2, 1)).astype(jnp.int32)  # [b, m, cap]
+        gathered = jnp.take_along_axis(lut, codes_t, axis=2)         # [b, m, cap]
+        dist = gathered.sum(axis=1)                                   # [b, cap]
+        scores = jnp.where(val, -dist, neg_inf)
+        vals_r, idx_r = jax.lax.top_k(scores, min(k, scores.shape[1]))
+        slots_r = jnp.take_along_axis(slot, idx_r, axis=1)
+        slots_r = jnp.where(jnp.isneginf(vals_r), -1, slots_r)
+        return merge_topk(best_vals, best_slots, vals_r, slots_r, k), None
+
+    init = (
+        jnp.full((b, k), neg_inf, jnp.float32),
+        jnp.full((b, k), -1, jnp.int32),
+    )
+    (vals, slots), _ = jax.lax.scan(body, init, jnp.arange(nprobe))
+    return -vals, slots    # wire convention: squared-L2-approx ascending
+
+
+class TpuIvfPq(_SlotStoreIndex):
+    def __init__(self, index_id: int, parameter: IndexParameter):
+        VectorIndex.__init__(self, index_id, parameter)
+        p = parameter
+        if p.dimension <= 0:
+            raise InvalidParameter(f"dimension {p.dimension}")
+        if p.dimension % p.nsubvector:
+            raise InvalidParameter(
+                f"dimension {p.dimension} not divisible by m={p.nsubvector}"
+            )
+        if p.nbits_per_idx != 8:
+            raise InvalidParameter("only nbits=8 supported (uint8 codes)")
+        if p.metric is Metric.HAMMING:
+            raise InvalidParameter("hamming not valid for IVF_PQ")
+        self.store = SlotStore(p.dimension, jnp.dtype(p.dtype))
+        self.nlist = p.ncentroids
+        self.m = p.nsubvector
+        self.ksub = 1 << p.nbits_per_idx
+        self.centroids: Optional[jax.Array] = None
+        self._c_sqnorm: Optional[jax.Array] = None
+        self.codebooks: Optional[jax.Array] = None       # [m, ksub, dsub]
+        self._assign_h = np.full((self.store.capacity,), -1, np.int32)
+        self._codes: Optional[jax.Array] = None          # [capacity, m] uint8
+        self._code_buckets = None
+        self._bucket_valid = None
+        self._bucket_slot = None
+        self._view_dirty = True
+        self._kernel_metric = p.metric
+        self._kernel_nbits = 0
+
+    # -- prep (shared shape checks + cosine normalize) ----------------------
+    def _prep_vectors(self, vectors: np.ndarray) -> np.ndarray:
+        vectors = np.asarray(vectors, np.float32)
+        if vectors.ndim != 2 or vectors.shape[1] != self.dimension:
+            raise InvalidParameter(
+                f"vector dim {vectors.shape} != {self.dimension}"
+            )
+        if self.metric is Metric.COSINE:
+            vectors = np.asarray(normalize(jnp.asarray(vectors)))
+        return vectors
+
+    def _prep_queries(self, queries: np.ndarray) -> np.ndarray:
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if queries.shape[1] != self.dimension:
+            raise InvalidParameter(
+                f"query dim {queries.shape[1]} != {self.dimension}"
+            )
+        if self.metric is Metric.COSINE:
+            queries = np.asarray(normalize(jnp.asarray(queries)))
+        return queries
+
+    # -- mutation ------------------------------------------------------------
+    def _ensure_code_capacity(self) -> None:
+        cap = self.store.capacity
+        if self._assign_h.shape[0] < cap:
+            grown = np.full((cap,), -1, np.int32)
+            grown[: self._assign_h.shape[0]] = self._assign_h
+            self._assign_h = grown
+        if self._codes is not None and self._codes.shape[0] < cap:
+            pad = cap - self._codes.shape[0]
+            self._codes = jnp.concatenate(
+                [self._codes, jnp.zeros((pad, self.m), jnp.uint8)]
+            )
+
+    def upsert(self, ids: np.ndarray, vectors: np.ndarray) -> None:
+        vectors = self._prep_vectors(vectors)
+        if len(ids) != len(vectors):
+            raise InvalidParameter("ids/vectors length mismatch")
+        slots = self.store.put(np.asarray(ids, np.int64), vectors)
+        self._ensure_code_capacity()
+        if self.is_trained():
+            dv = jnp.asarray(vectors)
+            assign = kmeans_assign(dv, self.centroids)
+            codes = _encode_residual(dv, assign, self.centroids, self.codebooks)
+            self._assign_h[slots] = np.asarray(assign)
+            self._codes = self._codes.at[jnp.asarray(slots, jnp.int32)].set(codes)
+        self._view_dirty = True
+        self.write_count_since_save += len(ids)
+
+    def delete(self, ids: np.ndarray) -> None:
+        removed = self.store.remove(np.asarray(ids, np.int64))
+        self._view_dirty = True
+        self.write_count_since_save += removed
+
+    # -- training ------------------------------------------------------------
+    def need_train(self) -> bool:
+        return True
+
+    def is_trained(self) -> bool:
+        return self.codebooks is not None
+
+    def train(self, vectors: Optional[np.ndarray] = None) -> None:
+        if vectors is None:
+            vectors = self.store.to_host()["vectors"]
+        vectors = np.asarray(vectors, np.float32)
+        min_train = max(self.nlist, self.ksub)
+        if len(vectors) < min_train:
+            raise NotTrained(
+                f"need >= {min_train} train vectors, have {len(vectors)}"
+            )
+        if self.metric is Metric.COSINE:
+            vectors = np.asarray(normalize(jnp.asarray(vectors)))
+        cap = MAX_POINTS_PER_CENTROID * self.nlist
+        if len(vectors) > cap:
+            sel = np.random.default_rng(self.id).choice(
+                len(vectors), cap, replace=False
+            )
+            vectors = vectors[sel]
+        dv = jnp.asarray(vectors)
+        self.centroids, _ = train_kmeans(dv, k=self.nlist, iters=10, seed=self.id)
+        self._c_sqnorm = squared_norms(self.centroids)
+        assign = kmeans_assign(dv, self.centroids)
+        resid = dv - jnp.take(self.centroids, assign, axis=0)
+        self.codebooks = pq_train(resid, m=self.m, ksub=self.ksub, iters=10,
+                                  seed=self.id)
+        # encode everything stored
+        self._codes = jnp.zeros((self.store.capacity, self.m), jnp.uint8)
+        self._ensure_code_capacity()
+        live = np.flatnonzero(self.store.ids_by_slot >= 0)
+        if len(live):
+            _, vecs = self.store.gather(self.store.ids_by_slot[live])
+            dvv = jnp.asarray(vecs)
+            a = kmeans_assign(dvv, self.centroids)
+            codes = _encode_residual(dvv, a, self.centroids, self.codebooks)
+            self._assign_h[live] = np.asarray(a)
+            self._codes = self._codes.at[jnp.asarray(live, jnp.int32)].set(codes)
+        self._view_dirty = True
+
+    # -- bucketed view -------------------------------------------------------
+    def _rebuild_view(self) -> None:
+        live = np.flatnonzero(self.store.valid_h)
+        assign = self._assign_h[live]
+        counts = np.bincount(assign[assign >= 0], minlength=self.nlist)
+        cap_list = max(8, _next_pow2(int(counts.max()) if len(counts) else 1))
+        order = np.argsort(assign, kind="stable")
+        live, assign = live[order], assign[order]
+        bucket_slot = np.full((self.nlist, cap_list), -1, np.int32)
+        fill = np.zeros(self.nlist, np.int64)
+        for s, a in zip(live, assign):
+            bucket_slot[a, fill[a]] = s
+            fill[a] += 1
+        safe = np.where(bucket_slot >= 0, bucket_slot, 0)
+        gidx = jnp.asarray(safe.reshape(-1), jnp.int32)
+        self._code_buckets = jnp.take(self._codes, gidx, axis=0).reshape(
+            self.nlist, cap_list, self.m
+        )
+        self._bucket_slot = jnp.asarray(bucket_slot)
+        self._bucket_valid = jnp.asarray(bucket_slot >= 0)
+        self._view_dirty = False
+
+    def _bucket_valid_for_filter(self, filter_spec: Optional[FilterSpec]):
+        if filter_spec is None or filter_spec.is_empty():
+            return self._bucket_valid
+        mask = filter_spec.slot_mask(self.store.ids_by_slot)
+        bucket_slot = np.asarray(self._bucket_slot)
+        safe = np.where(bucket_slot >= 0, bucket_slot, 0)
+        return jnp.asarray(mask[safe] & (bucket_slot >= 0))
+
+    # -- search --------------------------------------------------------------
+    def search(
+        self,
+        queries: np.ndarray,
+        topk: int,
+        filter_spec: Optional[FilterSpec] = None,
+        nprobe: Optional[int] = None,
+    ) -> List[SearchResult]:
+        return self.search_async(queries, topk, filter_spec, nprobe)()
+
+    def search_async(
+        self,
+        queries: np.ndarray,
+        topk: int,
+        filter_spec: Optional[FilterSpec] = None,
+        nprobe: Optional[int] = None,
+    ):
+        queries = self._prep_queries(queries)
+        b = queries.shape[0]
+        qpad = jnp.asarray(_pad_batch(queries))
+        store = self.store
+        if not self.is_trained():
+            # Hybrid contract: exact flat scan until trained
+            # (vector_index_ivf_pq.h:113-115).
+            if filter_spec is None or filter_spec.is_empty():
+                mask = store.device_mask()
+            else:
+                mask = jnp.asarray(filter_spec.slot_mask(store.ids_by_slot))
+            dists, slots = _flat_search_kernel(
+                store.vecs, store.sqnorm, mask, qpad,
+                k=int(topk), metric=self.metric, nbits=0,
+            )
+        else:
+            if self._view_dirty:
+                self._rebuild_view()
+            nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
+            probes = _probe_lists(qpad, self.centroids, self._c_sqnorm, nprobe)
+            valid = self._bucket_valid_for_filter(filter_spec)
+            dists, slots = _ivfpq_scan_kernel(
+                self._code_buckets,
+                valid,
+                self._bucket_slot,
+                probes,
+                qpad,
+                self.centroids,
+                self.codebooks,
+                k=int(topk),
+            )
+        lease = store.begin_search()
+        dists.copy_to_host_async()
+        slots.copy_to_host_async()
+        def resolve() -> List[SearchResult]:
+            try:
+                dists_h, slots_h = jax.device_get((dists, slots))
+                ids = store.ids_of_slots(slots_h[:b])
+                return [strip_invalid(i, d) for i, d in zip(ids, dists_h[:b])]
+            finally:
+                lease.release()
+
+        return resolve
+
+    # -- lifecycle -----------------------------------------------------------
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        snap = self.store.to_host()
+        extras = {}
+        if self.is_trained():
+            extras["centroids"] = np.asarray(self.centroids)
+            extras["codebooks"] = np.asarray(self.codebooks)
+        np.savez(os.path.join(path, "ivf_pq.npz"), **snap, **extras)
+        meta = self._save_meta()
+        meta.update(nlist=self.nlist, m=self.m, trained=self.is_trained())
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f)
+
+    def load(self, path: str) -> None:
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        self._check_meta(meta)
+        if meta["nlist"] != self.nlist or meta["m"] != self.m:
+            raise InvalidParameter("snapshot nlist/m mismatch")
+        data = np.load(os.path.join(path, "ivf_pq.npz"))
+        self.store = SlotStore(self.dimension, jnp.dtype(self.parameter.dtype),
+                               max(len(data["ids"]), 1))
+        self._assign_h = np.full((self.store.capacity,), -1, np.int32)
+        self._codes = None
+        self.centroids = None
+        self._c_sqnorm = None
+        self.codebooks = None
+        if meta.get("trained"):
+            self.centroids = jnp.asarray(data["centroids"])
+            self._c_sqnorm = squared_norms(self.centroids)
+            self.codebooks = jnp.asarray(data["codebooks"])
+            self._codes = jnp.zeros((self.store.capacity, self.m), jnp.uint8)
+        if len(data["ids"]):
+            self.upsert(data["ids"], data["vectors"])
+        self.apply_log_id = meta["apply_log_id"]
+        self._view_dirty = True
+        self.write_count_since_save = 0

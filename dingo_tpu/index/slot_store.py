@@ -1,0 +1,273 @@
+"""Device-resident slot store: the IndexIDMap2 equivalent.
+
+The reference wraps faiss indexes in faiss::IndexIDMap2 (vector_index_flat.h:
+57-127) to map external vector ids <-> internal sequential slots. Here the
+mapping is split to fit TPU + XLA realities (measured on the axon tunnel:
+row-scatter into a [131072,128] array ≈ 385 ms, device->host materialization
+≈ 60-80 ms per call, H2D ≈ 230 MB/s):
+
+  host side   — ids_by_slot np.int64[capacity] (-1 = empty) + dict id->slot +
+                free-slot list + validity bitmap. 64-bit external ids NEVER
+                go on device (JAX x64-off truncates them); kernels work in
+                slot space and the host translates slots->ids after top-k.
+                The validity bitmap lives host-side and is lazily refreshed
+                to device only when dirty (uploading [cap] bools is far
+                cheaper than TPU scatter).
+  device side — vecs[capacity, d] and sqnorm[capacity] f32 (cached ||x||^2),
+                updated by contiguous-run dynamic_update_slice writes with
+                donated buffers (TPU scatter is the slow path; appends are
+                contiguous because free slots are handed out ascending).
+
+Capacity grows by doubling (static shapes per power-of-two bucket keep the
+XLA compile cache bounded — SURVEY.md §7 'capacity-bucketed arrays').
+Deletes are tombstones in the host bitmap; compaction happens on
+save/rebuild, mirroring the reference's rebuild-on-too-many-deletes policy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+MIN_CAPACITY = 4096
+#: Max rows per dynamic_update_slice program (pads to pow2 buckets up to this).
+MAX_WRITE_BUCKET = 4096
+
+
+@functools.partial(
+    jax.jit, static_argnames=("nrows",), donate_argnums=(0, 1)
+)
+def _write_run(vecs, sqnorm, rows, start, lo, hi, nrows):
+    """Blend rows[lo:hi] of the padded [nrows] window into vecs/sqnorm at
+    window position `start` (i.e. slots start+lo .. start+hi-1).
+
+    Rows outside [lo, hi) keep the old content — the pad can sit at either
+    end, which lets the caller shift the window left at the capacity
+    boundary instead of letting dynamic_update_slice clamp (a clamped start
+    silently lands the write one slot off and corrupts neighbors).
+    Donated buffers -> in-place on device."""
+    d = vecs.shape[1]
+    rows32 = rows.astype(jnp.float32)
+    old = lax.dynamic_slice(vecs, (start, 0), (nrows, d))
+    idx = jnp.arange(nrows)
+    keep = (idx >= lo) & (idx < hi)
+    blend = jnp.where(keep[:, None], rows.astype(vecs.dtype), old)
+    vecs = lax.dynamic_update_slice(vecs, blend, (start, 0))
+    sq = jnp.einsum(
+        "ld,ld->l", rows32, rows32, precision=jax.lax.Precision.HIGHEST
+    )
+    old_sq = lax.dynamic_slice(sqnorm, (start,), (nrows,))
+    sqnorm = lax.dynamic_update_slice(
+        sqnorm, jnp.where(keep, sq, old_sq), (start,)
+    )
+    return vecs, sqnorm
+
+
+class SlotStore:
+    def __init__(self, dim: int, dtype=jnp.float32, capacity: int = MIN_CAPACITY):
+        self.dim = dim
+        self.dtype = dtype
+        self.capacity = max(MIN_CAPACITY, _next_pow2(capacity))
+        self.vecs = jnp.zeros((self.capacity, dim), dtype)
+        self.sqnorm = jnp.zeros((self.capacity,), jnp.float32)
+        self.ids_by_slot = np.full((self.capacity,), -1, np.int64)
+        self.valid_h = np.zeros((self.capacity,), np.bool_)
+        self._dmask: Optional[jax.Array] = None   # lazy device copy of valid_h
+        self._id_to_slot: dict[int, int] = {}
+        self._free: list[int] = list(range(self.capacity - 1, -1, -1))
+        # Epoch-based reclamation: slots freed while searches are in flight
+        # park in limbo so an async resolve never sees a reassigned slot
+        # (it translates them to -1/dropped instead of to the wrong id).
+        self._inflight: int = 0
+        self._limbo: list[int] = []
+
+    # -- bookkeeping -------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._id_to_slot)
+
+    def __contains__(self, vid: int) -> bool:
+        return int(vid) in self._id_to_slot
+
+    def slots_of(self, ids: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            [self._id_to_slot.get(int(i), -1) for i in ids], np.int64
+        )
+
+    def ids_of_slots(self, slots: np.ndarray) -> np.ndarray:
+        """Translate kernel-space slots (-1 allowed) back to external ids."""
+        safe = np.where(slots >= 0, slots, 0)
+        out = self.ids_by_slot[safe]
+        return np.where(slots >= 0, out, -1)
+
+    def device_mask(self) -> jax.Array:
+        """Validity bitmap on device, refreshed only when host state changed."""
+        if self._dmask is None:
+            self._dmask = jnp.asarray(self.valid_h)
+        return self._dmask
+
+    def memory_size(self) -> int:
+        itemsize = jnp.zeros((), self.dtype).dtype.itemsize
+        return self.capacity * (self.dim * itemsize + 8 + 4 + 1)
+
+    # -- mutation ----------------------------------------------------------
+    def put(self, ids: np.ndarray, vectors: np.ndarray) -> np.ndarray:
+        """Insert/replace rows; returns assigned slots. Contiguous slot runs
+        are written with dynamic_update_slice (fresh appends are one run);
+        scattered overwrites degrade to per-run writes."""
+        n = len(ids)
+        if n == 0:
+            return np.empty(0, np.int64)
+        slots = np.empty(n, np.int64)
+        for i, vid in enumerate(ids):
+            vid = int(vid)
+            s = self._id_to_slot.get(vid)
+            if s is None:
+                if not self._free:
+                    self._grow(max(self.capacity * 2, _next_pow2(self.capacity + n)))
+                s = self._free.pop()
+                self._id_to_slot[vid] = s
+                self.ids_by_slot[s] = vid
+            slots[i] = s
+        vectors = np.asarray(vectors)
+        # Sort into ascending slot order, then split into contiguous runs.
+        order = np.argsort(slots, kind="stable")
+        sslots = slots[order]
+        svecs = vectors[order]
+        run_starts = np.flatnonzero(np.diff(sslots) != 1) + 1
+        for seg_lo, seg_hi in zip(
+            np.concatenate([[0], run_starts]),
+            np.concatenate([run_starts, [n]]),
+        ):
+            self._write_segment(int(sslots[seg_lo]), svecs[seg_lo:seg_hi])
+        self.valid_h[slots] = True
+        self._dmask = None
+        return slots
+
+    def _write_segment(self, start: int, rows: np.ndarray) -> None:
+        """One contiguous run, chunked into pow2 buckets <= MAX_WRITE_BUCKET."""
+        off = 0
+        total = rows.shape[0]
+        while off < total:
+            chunk = min(MAX_WRITE_BUCKET, total - off)
+            bucket = min(MAX_WRITE_BUCKET, _next_pow2(chunk))
+            padded = rows[off:off + chunk]
+            if bucket != chunk:
+                padded = np.concatenate(
+                    [padded, np.zeros((bucket - chunk, self.dim), padded.dtype)]
+                )
+            win_start = start + off
+            lo = 0
+            if win_start + bucket > self.capacity:
+                # Shift the window left so it stays in bounds; the pad moves
+                # to the front (dynamic_update_slice would otherwise clamp
+                # the start and shift the whole write — data corruption).
+                lo = win_start + bucket - self.capacity
+                win_start = self.capacity - bucket
+                padded = np.roll(padded, lo, axis=0)
+            self.vecs, self.sqnorm = _write_run(
+                self.vecs,
+                self.sqnorm,
+                jnp.asarray(padded),
+                jnp.int32(win_start),
+                jnp.int32(lo),
+                jnp.int32(lo + chunk),
+                nrows=bucket,
+            )
+            off += chunk
+
+    def remove(self, ids: np.ndarray) -> int:
+        """Tombstone rows; returns number actually removed."""
+        removed = 0
+        dest = self._limbo if self._inflight > 0 else self._free
+        for vid in ids:
+            s = self._id_to_slot.pop(int(vid), None)
+            if s is not None:
+                self.ids_by_slot[s] = -1
+                self.valid_h[s] = False
+                dest.append(s)
+                removed += 1
+        if removed:
+            self._dmask = None
+        return removed
+
+    # -- in-flight search accounting --------------------------------------
+    def begin_search(self) -> "SearchLease":
+        self._inflight += 1
+        return SearchLease(self)
+
+    def end_search(self) -> None:
+        self._inflight -= 1
+        if self._inflight == 0 and self._limbo:
+            self._free.extend(self._limbo)
+            self._limbo.clear()
+
+    def _grow(self, new_capacity: int) -> None:
+        new_capacity = _next_pow2(new_capacity)
+        pad = new_capacity - self.capacity
+        self.vecs = jnp.concatenate(
+            [self.vecs, jnp.zeros((pad, self.dim), self.dtype)]
+        )
+        self.sqnorm = jnp.concatenate([self.sqnorm, jnp.zeros((pad,), jnp.float32)])
+        self.ids_by_slot = np.concatenate(
+            [self.ids_by_slot, np.full((pad,), -1, np.int64)]
+        )
+        self.valid_h = np.concatenate(
+            [self.valid_h, np.zeros((pad,), np.bool_)]
+        )
+        self._dmask = None
+        self._free.extend(range(new_capacity - 1, self.capacity - 1, -1))
+        self.capacity = new_capacity
+
+    # -- host round-trips --------------------------------------------------
+    def gather(self, ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Fetch vectors by external id (found_mask, vectors)."""
+        slots = self.slots_of(ids)
+        found = slots >= 0
+        safe = np.where(found, slots, 0)
+        vecs = np.asarray(jnp.take(self.vecs, jnp.asarray(safe, jnp.int32), axis=0))
+        return found, vecs
+
+    def to_host(self) -> dict:
+        """Compacted host snapshot {ids, vectors} of live rows (save path)."""
+        live = self.ids_by_slot >= 0
+        return {
+            "ids": self.ids_by_slot[live],
+            "vectors": np.asarray(self.vecs)[live],
+        }
+
+    @classmethod
+    def from_host(cls, dim: int, dtype, ids: np.ndarray, vectors: np.ndarray,
+                  capacity: Optional[int] = None) -> "SlotStore":
+        store = cls(dim, dtype, capacity or max(MIN_CAPACITY, len(ids)))
+        if len(ids):
+            store.put(np.asarray(ids, np.int64), vectors)
+        return store
+
+
+class SearchLease:
+    """Pairs begin_search with exactly one end_search even when the caller
+    drops the resolve thunk or resolve raises: release() is idempotent and
+    __del__ backstops it at GC, so limbo can't starve the free list."""
+
+    __slots__ = ("_store", "_done")
+
+    def __init__(self, store: "SlotStore"):
+        self._store = store
+        self._done = False
+
+    def release(self) -> None:
+        if not self._done:
+            self._done = True
+            self._store.end_search()
+
+    def __del__(self):  # noqa: D105
+        self.release()
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1)).bit_length()

@@ -1,0 +1,351 @@
+"""VectorReader: region-local search orchestration (the query planner).
+
+Reference: src/vector/vector_reader.{h,cc} (2,429 LoC) — VectorBatchSearch
+(vector_reader.cc:439) -> SearchVector (:104) dispatches on filter mode:
+  SCALAR post-filter  — over-fetch topk*10, then compare scalar data (:120-215)
+  VECTOR_ID pre-filter — explicit candidate ids (:216-222, impl :830)
+  SCALAR pre-filter   — scan scalar CF for candidates -> id filter (:853)
+plus SearchAndRangeSearchWrapper (:1781) choosing index search vs
+BruteForceSearch (:1873: scan region KVs in 2,048-vector batches —
+FLAGS_vector_index_bruteforce_batch_count :61 — build temp flat index,
+search, merge per-query top-k), and the VectorBatchQuery / GetBorderId /
+ScanQuery / Count entry points (vector_reader.h:44-88).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import pickle
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dingo_tpu.coprocessor.scalar_filter import ScalarFilter
+from dingo_tpu.engine.raw_engine import (
+    CF_DEFAULT,
+    CF_VECTOR_SCALAR,
+    RawEngine,
+)
+from dingo_tpu.index import codec as vcodec
+from dingo_tpu.index.base import (
+    FilterSpec,
+    IndexParameter,
+    IndexType,
+    NotSupported,
+    NotTrained,
+    SearchResult,
+    VectorIndexError,
+)
+from dingo_tpu.index.flat import TpuFlat
+from dingo_tpu.index.wrapper import VectorIndexWrapper
+from dingo_tpu.mvcc.codec import MAX_TS
+from dingo_tpu.mvcc.reader import Reader as MvccReader
+
+#: FLAGS_vector_index_bruteforce_batch_count (vector_reader.cc:61)
+BRUTEFORCE_BATCH = 2048
+#: scalar post-filter over-fetch multiplier (vector_reader.cc:137,182)
+POST_FILTER_OVERFETCH = 10
+#: FLAGS_vector_max_range_search_result_count (vector_reader.cc:60)
+RANGE_SEARCH_CAP = 1024
+
+
+class VectorFilterMode(enum.Enum):
+    """pb::common::VectorFilter."""
+
+    NONE = "none"
+    SCALAR = "scalar"          # scalar key/values must match
+    VECTOR_ID = "vector_id"    # explicit candidate list
+    TABLE = "table"            # coprocessor over table data
+
+
+class VectorFilterType(enum.Enum):
+    """pb::common::VectorFilterType."""
+
+    QUERY_POST = "post"
+    QUERY_PRE = "pre"
+
+
+@dataclasses.dataclass
+class VectorWithData:
+    id: int
+    distance: float = 0.0
+    vector: Optional[np.ndarray] = None
+    scalar: Optional[Dict[str, Any]] = None
+
+
+@dataclasses.dataclass
+class ReaderContext:
+    """Engine::VectorReader::Context (engine.h:124-156)."""
+
+    region_id: int
+    partition_id: int
+    start_key: bytes
+    end_key: bytes
+    index_wrapper: Optional[VectorIndexWrapper]
+    engine: RawEngine
+    read_ts: int = MAX_TS
+    parameter: Optional[IndexParameter] = None
+
+    def id_window(self) -> Tuple[int, int]:
+        return vcodec.range_to_vector_ids(self.start_key, self.end_key)
+
+
+def serialize_vector(v: np.ndarray) -> bytes:
+    return np.asarray(v, np.float32).tobytes()
+
+
+def deserialize_vector(b: bytes, dim: int) -> np.ndarray:
+    return np.frombuffer(b, np.float32, count=dim)
+
+
+def serialize_scalar(scalar: Dict[str, Any]) -> bytes:
+    return pickle.dumps(scalar, protocol=4)
+
+
+def deserialize_scalar(b: bytes) -> Dict[str, Any]:
+    return pickle.loads(b)
+
+
+class VectorReader:
+    def __init__(self, ctx: ReaderContext):
+        self.ctx = ctx
+        self._data = MvccReader(ctx.engine, CF_DEFAULT)
+        self._scalar = MvccReader(ctx.engine, CF_VECTOR_SCALAR)
+
+    # ---------------- public entry points (vector_reader.h:44-88) ----------
+
+    def vector_batch_search(
+        self,
+        queries: np.ndarray,
+        topk: int,
+        filter_mode: VectorFilterMode = VectorFilterMode.NONE,
+        filter_type: VectorFilterType = VectorFilterType.QUERY_POST,
+        scalar_filter: Optional[ScalarFilter] = None,
+        vector_ids: Optional[Sequence[int]] = None,
+        with_vector_data: bool = False,
+        with_scalar_data: bool = False,
+        **search_kw,
+    ) -> List[List[VectorWithData]]:
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        base = FilterSpec(ranges=[self.ctx.id_window()])
+
+        if filter_mode is VectorFilterMode.VECTOR_ID:
+            # pre-filter on explicit ids (vector_reader.cc:216-222, :830)
+            ids = np.asarray(sorted(set(map(int, vector_ids or []))), np.int64)
+            spec = FilterSpec(ranges=base.ranges, include_ids=ids)
+            results = self._search_with_fallback(queries, topk, spec, **search_kw)
+        elif filter_mode is VectorFilterMode.SCALAR and (
+            filter_type is VectorFilterType.QUERY_PRE
+        ):
+            # scan scalar CF for candidates (vector_reader.cc:853)
+            cand = self._scan_scalar_candidates(scalar_filter)
+            spec = FilterSpec(ranges=base.ranges, include_ids=cand)
+            results = self._search_with_fallback(queries, topk, spec, **search_kw)
+        elif filter_mode is VectorFilterMode.SCALAR:
+            # post-filter with x10 over-fetch (vector_reader.cc:120-215)
+            over = self._search_with_fallback(
+                queries, topk * POST_FILTER_OVERFETCH, base, **search_kw
+            )
+            results = [
+                self._post_filter_scalar(r, scalar_filter, topk) for r in over
+            ]
+        else:
+            results = self._search_with_fallback(queries, topk, base, **search_kw)
+
+        out: List[List[VectorWithData]] = []
+        for r in results:
+            row = [
+                VectorWithData(int(i), float(d))
+                for i, d in zip(r.ids, r.distances)
+            ]
+            out.append(row)
+        if with_vector_data or with_scalar_data:
+            for row in out:
+                self._backfill(row, with_vector_data, with_scalar_data)
+        return out
+
+    def vector_batch_query(
+        self,
+        vector_ids: Sequence[int],
+        with_vector_data: bool = True,
+        with_scalar_data: bool = False,
+    ) -> List[Optional[VectorWithData]]:
+        out: List[Optional[VectorWithData]] = []
+        for vid in vector_ids:
+            key = vcodec.encode_vector_key(self.ctx.partition_id, int(vid))
+            blob = self._data.kv_get(key, self.ctx.read_ts)
+            if blob is None:
+                out.append(None)
+                continue
+            v = VectorWithData(int(vid))
+            if with_vector_data and self.ctx.parameter:
+                v.vector = deserialize_vector(blob, self.ctx.parameter.dimension)
+            if with_scalar_data:
+                sb = self._scalar.kv_get(key, self.ctx.read_ts)
+                v.scalar = deserialize_scalar(sb) if sb else {}
+            out.append(v)
+        return out
+
+    def vector_get_border_id(self, get_min: bool) -> Optional[int]:
+        """Min/max visible vector id in the region (VectorGetBorderId)."""
+        ids = self._visible_ids()
+        if not ids:
+            return None
+        return min(ids) if get_min else max(ids)
+
+    def vector_scan_query(
+        self,
+        start_id: int,
+        end_id: Optional[int] = None,
+        limit: int = 1000,
+        is_reverse: bool = False,
+        with_vector_data: bool = True,
+        with_scalar_data: bool = False,
+        scalar_filter: Optional[ScalarFilter] = None,
+    ) -> List[VectorWithData]:
+        lo, hi = self.ctx.id_window()
+        lo = max(lo, int(start_id)) if not is_reverse else lo
+        if end_id is not None:
+            hi = min(hi, int(end_id) + 1)
+        out: List[VectorWithData] = []
+        items = self._scan_data(lo, hi)
+        if is_reverse:
+            items = list(items)[::-1]
+            items = [x for x in items if x[0] <= start_id]
+        for vid, blob in items:
+            v = VectorWithData(vid)
+            if with_scalar_data or (scalar_filter and not scalar_filter.is_empty()):
+                key = vcodec.encode_vector_key(self.ctx.partition_id, vid)
+                sb = self._scalar.kv_get(key, self.ctx.read_ts)
+                scalar = deserialize_scalar(sb) if sb else {}
+                if scalar_filter and not scalar_filter.matches(scalar):
+                    continue
+                if with_scalar_data:
+                    v.scalar = scalar
+            if with_vector_data and self.ctx.parameter:
+                v.vector = deserialize_vector(blob, self.ctx.parameter.dimension)
+            out.append(v)
+            if len(out) >= limit:
+                break
+        return out
+
+    def vector_count(self) -> int:
+        return sum(1 for _ in self._scan_data(*self.ctx.id_window()))
+
+    # ---------------- internals --------------------------------------------
+
+    def _search_with_fallback(
+        self, queries: np.ndarray, topk: int, spec: FilterSpec, **kw
+    ) -> List[SearchResult]:
+        """SearchAndRangeSearchWrapper (:1781): index search when the wrapper
+        is ready and supports it, else brute-force scan (:1873)."""
+        wrapper = self.ctx.index_wrapper
+        if wrapper is not None and wrapper.is_ready():
+            try:
+                return wrapper.search(queries, topk, spec, **kw)
+            except (NotSupported, NotTrained):
+                pass  # EVECTOR_NOT_SUPPORT contract -> brute force
+        return self._brute_force_search(queries, topk, spec)
+
+    def _brute_force_search(
+        self, queries: np.ndarray, topk: int, spec: FilterSpec
+    ) -> List[SearchResult]:
+        """Scan region data in BRUTEFORCE_BATCH chunks into a temp flat index
+        (the reference builds a temp faiss flat per 2,048-vector batch and
+        merges per-query top-k heaps; one TPU flat over the scan is the same
+        result with fewer kernel launches)."""
+        if self.ctx.parameter is None:
+            raise VectorIndexError("brute force needs index parameter (dim)")
+        dim = self.ctx.parameter.dimension
+        param = IndexParameter(
+            index_type=IndexType.FLAT,
+            dimension=dim,
+            metric=self.ctx.parameter.metric,
+        )
+        temp = TpuFlat(self.ctx.region_id, param)
+        lo, hi = self.ctx.id_window()
+        batch_ids: List[int] = []
+        batch_vecs: List[np.ndarray] = []
+        for vid, blob in self._scan_data(lo, hi):
+            batch_ids.append(vid)
+            batch_vecs.append(deserialize_vector(blob, dim))
+            if len(batch_ids) >= BRUTEFORCE_BATCH:
+                temp.upsert(np.asarray(batch_ids, np.int64), np.stack(batch_vecs))
+                batch_ids, batch_vecs = [], []
+        if batch_ids:
+            temp.upsert(np.asarray(batch_ids, np.int64), np.stack(batch_vecs))
+        if temp.get_count() == 0:
+            return [SearchResult(np.empty(0, np.int64), np.empty(0, np.float32))
+                    for _ in range(len(queries))]
+        return temp.search(queries, topk, spec)
+
+    def _scan_data(self, lo: int, hi: int):
+        start = vcodec.encode_vector_key(self.ctx.partition_id, lo)
+        end = vcodec.encode_vector_key(self.ctx.partition_id, hi)
+        for key, blob in self._data.iter_visible(start, end, self.ctx.read_ts):
+            _, vid, _ = vcodec.decode_vector_key(key)
+            if vid is None:
+                continue
+            yield vid, blob
+
+    def _visible_ids(self) -> List[int]:
+        return [vid for vid, _ in self._scan_data(*self.ctx.id_window())]
+
+    def _scan_scalar_candidates(
+        self, scalar_filter: Optional[ScalarFilter]
+    ) -> np.ndarray:
+        lo, hi = self.ctx.id_window()
+        start = vcodec.encode_vector_key(self.ctx.partition_id, lo)
+        end = vcodec.encode_vector_key(self.ctx.partition_id, hi)
+        out = []
+        for key, blob in self._scalar.iter_visible(start, end, self.ctx.read_ts):
+            _, vid, _ = vcodec.decode_vector_key(key)
+            if vid is None:
+                continue
+            if scalar_filter is None or scalar_filter.matches(
+                deserialize_scalar(blob)
+            ):
+                out.append(vid)
+        return np.asarray(out, np.int64)
+
+    def _post_filter_scalar(
+        self,
+        result: SearchResult,
+        scalar_filter: Optional[ScalarFilter],
+        topk: int,
+    ) -> SearchResult:
+        if scalar_filter is None or scalar_filter.is_empty():
+            return SearchResult(result.ids[:topk], result.distances[:topk])
+        keep_ids, keep_d = [], []
+        for vid, dist in zip(result.ids, result.distances):
+            key = vcodec.encode_vector_key(self.ctx.partition_id, int(vid))
+            sb = self._scalar.kv_get(key, self.ctx.read_ts)
+            scalar = deserialize_scalar(sb) if sb else {}
+            if scalar_filter.matches(scalar):
+                keep_ids.append(vid)
+                keep_d.append(dist)
+                if len(keep_ids) >= topk:
+                    break
+        return SearchResult(
+            np.asarray(keep_ids, np.int64), np.asarray(keep_d, np.float32)
+        )
+
+    def _backfill(
+        self, row: List[VectorWithData], with_vector: bool, with_scalar: bool
+    ) -> None:
+        """Backfill vectors/scalars from the engine by id
+        (vector_reader.cc:243-266)."""
+        for v in row:
+            key = vcodec.encode_vector_key(self.ctx.partition_id, v.id)
+            if with_vector and self.ctx.parameter:
+                blob = self._data.kv_get(key, self.ctx.read_ts)
+                if blob is not None:
+                    v.vector = deserialize_vector(
+                        blob, self.ctx.parameter.dimension
+                    )
+            if with_scalar:
+                sb = self._scalar.kv_get(key, self.ctx.read_ts)
+                v.scalar = deserialize_scalar(sb) if sb else {}

@@ -1,0 +1,162 @@
+"""VectorIndexWrapper: lifecycle state machine around a VectorIndex.
+
+Reference: src/vector/vector_index.h:283-506 — tracks ready/stop/build-error
+flags, apply_log_id & snapshot_log_id (:467-470), own/share/sibling index
+pointers used during region split & merge (:476-480), pending-task counters,
+and the save threshold by write count (:497-500). The raft apply handlers
+talk to the wrapper, never to the index directly (§3.2 dual-write contract:
+RocksDB is the source of truth; the in-memory index is an apply-log-tracked
+materialized view).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from dingo_tpu.index.base import (
+    FilterSpec,
+    IndexParameter,
+    SearchResult,
+    VectorIndex,
+    VectorIndexError,
+)
+from dingo_tpu.index.factory import new_index
+
+
+class VectorIndexWrapper:
+    def __init__(self, index_id: int, parameter: IndexParameter,
+                 save_write_threshold: int = 10000):
+        self.id = index_id
+        self.parameter = parameter
+        self._lock = threading.RLock()
+        self.own_index: Optional[VectorIndex] = None
+        #: parent's index served by a child region after split until its own
+        #: rebuild completes (SplitHandler SetShareVectorIndex,
+        #: raft_apply_handler.cc:372,630)
+        self.share_index: Optional["VectorIndexWrapper"] = None
+        #: pre-merge sibling's index (raft_apply_handler.cc:1021)
+        self.sibling_index: Optional["VectorIndexWrapper"] = None
+        self.ready = False
+        self.stopped = False
+        self.build_error = False
+        self.is_switching = False
+        self.apply_log_id = 0
+        self.snapshot_log_id = 0
+        self.pending_tasks = 0
+        self.write_count = 0
+        self.save_write_threshold = save_write_threshold
+
+    # -- index lifecycle -----------------------------------------------------
+    def build_own(self) -> VectorIndex:
+        with self._lock:
+            self.own_index = new_index(self.id, self.parameter)
+            return self.own_index
+
+    def set_own(self, index: VectorIndex) -> None:
+        """Atomic switch after rebuild/catch-up (UpdateVectorIndex,
+        vector_index_manager.cc:1149 'final round under switching flag')."""
+        with self._lock:
+            self.own_index = index
+            self.apply_log_id = index.apply_log_id
+            self.ready = True
+            self.build_error = False
+
+    def set_share(self, share: Optional["VectorIndexWrapper"]) -> None:
+        with self._lock:
+            self.share_index = share
+
+    def set_sibling(self, sibling: Optional["VectorIndexWrapper"]) -> None:
+        with self._lock:
+            self.sibling_index = sibling
+
+    def active(self) -> Optional[VectorIndex]:
+        """Index to serve searches from: own if ready, else shared parent's
+        (split children serve the parent's index filtered to their range)."""
+        with self._lock:
+            if self.ready and self.own_index is not None:
+                return self.own_index
+            if self.share_index is not None:
+                return self.share_index.active()
+            return None
+
+    def is_ready(self) -> bool:
+        with self._lock:
+            return (self.ready and not self.stopped) or (
+                self.share_index is not None and self.share_index.is_ready()
+            )
+
+    def stop(self) -> None:
+        with self._lock:
+            self.stopped = True
+
+    # -- writes (apply-log contract, §3.2) ------------------------------------
+    def add(self, ids: np.ndarray, vectors: np.ndarray, log_id: int,
+            is_upsert: bool = True) -> None:
+        """Apply a raft-committed VECTOR_ADD iff log_id advances
+        (VectorAddHandler guard: 'if log_id > ApplyLogId',
+        raft_apply_handler.cc:1115)."""
+        with self._lock:
+            idx = self.own_index
+            if idx is None or self.stopped:
+                return
+            if log_id != 0 and log_id <= self.apply_log_id:
+                return  # already materialized (snapshot load or replay)
+            if is_upsert:
+                idx.upsert(ids, vectors)
+            else:
+                idx.add(ids, vectors)
+            if log_id:
+                self.apply_log_id = log_id
+                idx.apply_log_id = log_id
+            self.write_count += len(ids)
+
+    def delete(self, ids: np.ndarray, log_id: int) -> None:
+        with self._lock:
+            idx = self.own_index
+            if idx is None or self.stopped:
+                return
+            if log_id != 0 and log_id <= self.apply_log_id:
+                return
+            idx.delete(ids)
+            if log_id:
+                self.apply_log_id = log_id
+                idx.apply_log_id = log_id
+            self.write_count += len(ids)
+
+    # -- reads ---------------------------------------------------------------
+    def search(
+        self,
+        queries: np.ndarray,
+        topk: int,
+        filter_spec: Optional[FilterSpec] = None,
+        **kw,
+    ) -> List[SearchResult]:
+        idx = self.active()
+        if idx is None:
+            raise VectorIndexError(f"vector index {self.id} not ready")
+        return idx.search(queries, topk, filter_spec, **kw)
+
+    # -- policies --------------------------------------------------------------
+    def need_to_save(self) -> bool:
+        idx = self.own_index
+        if idx is None:
+            return False
+        log_behind = self.apply_log_id - self.snapshot_log_id
+        return self.write_count >= self.save_write_threshold or idx.need_to_save(
+            log_behind
+        )
+
+    def need_to_rebuild(self) -> bool:
+        idx = self.own_index
+        return idx is not None and idx.need_to_rebuild()
+
+    def get_count(self) -> int:
+        idx = self.active()
+        return idx.get_count() if idx else 0
+
+    def get_memory_size(self) -> int:
+        idx = self.own_index
+        return idx.get_memory_size() if idx else 0

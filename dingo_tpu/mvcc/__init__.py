@@ -1,0 +1,6 @@
+"""MVCC layer: memcomparable key codec, versioned reads, TSO timestamps.
+
+Mirrors reference src/mvcc/ (codec.h, reader.h, ts_provider.h)."""
+
+from dingo_tpu.mvcc.codec import Codec, ValueFlag  # noqa: F401
+from dingo_tpu.mvcc.ts_provider import TsProvider  # noqa: F401

@@ -1,0 +1,63 @@
+"""ctypes bindings to the native C++ runtime pieces (built by native/Makefile).
+
+The shared libraries are built on demand at import time if missing — the
+environment guarantees g++ but no pip installs, so we ship sources and
+compile lazily (cached .so next to this file).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_NATIVE_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native")
+
+
+def _build(lib: str, src: str) -> str:
+    path = os.path.join(_HERE, lib)
+    srcpath = os.path.join(_NATIVE_SRC, src)
+    if not os.path.exists(path) or (
+        os.path.exists(srcpath)
+        and os.path.getmtime(srcpath) > os.path.getmtime(path)
+    ):
+        subprocess.run(
+            [
+                "g++", "-O3", "-std=c++17", "-fPIC", "-shared",
+                "-march=native", srcpath, "-o", path,
+            ],
+            check=True,
+            capture_output=True,
+        )
+    return path
+
+
+def load_hnsw() -> ctypes.CDLL:
+    lib = ctypes.CDLL(_build("libdingohnsw.so", "hnsw/hnsw.cc"))
+    c = ctypes
+    lib.hnsw_new.restype = c.c_void_p
+    lib.hnsw_new.argtypes = [c.c_int, c.c_int, c.c_int, c.c_int, c.c_uint64]
+    lib.hnsw_free.argtypes = [c.c_void_p]
+    lib.hnsw_add.argtypes = [
+        c.c_void_p, c.c_int, c.POINTER(c.c_int64), c.POINTER(c.c_float),
+    ]
+    lib.hnsw_delete.restype = c.c_int
+    lib.hnsw_delete.argtypes = [c.c_void_p, c.c_int, c.POINTER(c.c_int64)]
+    lib.hnsw_search.argtypes = [
+        c.c_void_p, c.c_int, c.POINTER(c.c_float), c.c_int, c.c_int,
+        c.POINTER(c.c_int64), c.POINTER(c.c_float),
+    ]
+    lib.hnsw_count.restype = c.c_int64
+    lib.hnsw_count.argtypes = [c.c_void_p]
+    lib.hnsw_deleted_count.restype = c.c_int64
+    lib.hnsw_deleted_count.argtypes = [c.c_void_p]
+    lib.hnsw_memory.restype = c.c_int64
+    lib.hnsw_memory.argtypes = [c.c_void_p]
+    lib.hnsw_save_size.restype = c.c_int64
+    lib.hnsw_save_size.argtypes = [c.c_void_p]
+    lib.hnsw_save.restype = c.c_int64
+    lib.hnsw_save.argtypes = [c.c_void_p, c.POINTER(c.c_uint8)]
+    lib.hnsw_load.restype = c.c_void_p
+    lib.hnsw_load.argtypes = [c.POINTER(c.c_uint8), c.c_int64]
+    return lib

@@ -129,8 +129,21 @@ def kmeans_fit(
         )
         return new_c, counts
 
-    centroids, counts = jax.lax.scan(lloyd_iter, centroids, None, length=iters)
-    return centroids, counts[-1]
+    centroids, _ = jax.lax.scan(lloyd_iter, centroids, None, length=iters)
+
+    # Final counts against the RETURNED centroids (the scan's per-iteration
+    # counts describe the centroids entering each iteration, which disagrees
+    # with the final update; callers use sizes for balance decisions).
+    def count_body(counts, inp):
+        xi, vi = inp
+        dist = pairwise_l2sqr(xi, centroids)
+        onehot = jax.nn.one_hot(jnp.argmin(dist, axis=1), k, dtype=jnp.float32)
+        return counts + (onehot * vi[:, None]).sum(axis=0), None
+
+    counts, _ = jax.lax.scan(
+        count_body, jnp.zeros((k,), jnp.float32), (xc, vc)
+    )
+    return centroids, counts
 
 
 def train_kmeans(

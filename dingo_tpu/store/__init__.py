@@ -1,0 +1,2 @@
+"""Store-side runtime: region objects, meta manager, region controller,
+heartbeat. Mirrors reference src/meta/ + src/store/."""

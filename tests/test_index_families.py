@@ -1,0 +1,265 @@
+"""IVF_FLAT / IVF_PQ / HNSW functional + recall tests.
+
+Mirrors reference suites test/unit_test/vector/test_vector_index_ivf_flat.cc,
+test_vector_index_ivf_pq.cc (hybrid contract), test_vector_index_hnsw.cc."""
+
+import numpy as np
+import pytest
+
+from dingo_tpu.index import (
+    FilterSpec,
+    IndexParameter,
+    IndexType,
+    NotSupported,
+    new_index,
+)
+from dingo_tpu.index.base import NotTrained
+from dingo_tpu.ops.distance import Metric
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((5000, 32)).astype(np.float32)
+    ids = np.arange(5000, dtype=np.int64)
+    q = x[:16] + 0.01 * rng.standard_normal((16, 32)).astype(np.float32)
+    d = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    want = np.argsort(d, 1)[:, :10]
+    return ids, x, q, want
+
+
+def recall(res, want):
+    return np.mean([len(set(r.ids) & set(w)) / 10 for r, w in zip(res, want)])
+
+
+# ---------------- IVF_FLAT ----------------
+
+
+def ivf_param(**kw):
+    defaults = dict(
+        index_type=IndexType.IVF_FLAT, dimension=32, ncentroids=32,
+        default_nprobe=8,
+    )
+    defaults.update(kw)
+    return IndexParameter(**defaults)
+
+
+def test_ivf_untrained_raises(corpus):
+    ids, x, q, want = corpus
+    idx = new_index(1, ivf_param())
+    idx.add(ids[:100], x[:100])
+    with pytest.raises(NotTrained):
+        idx.search(q, 10)
+    assert idx.need_train() and not idx.is_trained()
+
+
+def test_ivf_train_too_small_raises(corpus):
+    ids, x, q, want = corpus
+    idx = new_index(1, ivf_param())
+    idx.add(ids[:10], x[:10])
+    with pytest.raises(NotTrained):
+        idx.train()
+
+
+def test_ivf_full_probe_is_exact(corpus):
+    ids, x, q, want = corpus
+    idx = new_index(1, ivf_param())
+    idx.add(ids, x)
+    idx.train()
+    res = idx.search(q, 10, nprobe=32)
+    assert recall(res, want) == 1.0
+
+
+def test_ivf_partial_probe_recall(corpus):
+    ids, x, q, want = corpus
+    idx = new_index(1, ivf_param())
+    idx.add(ids, x)
+    idx.train()
+    res = idx.search(q, 10, nprobe=8)
+    assert recall(res, want) >= 0.7
+
+
+def test_ivf_add_after_train(corpus):
+    """Vectors added post-train get assigned to lists immediately."""
+    ids, x, q, want = corpus
+    idx = new_index(1, ivf_param())
+    idx.add(ids[:4000], x[:4000])
+    idx.train()
+    idx.add(ids[4000:], x[4000:])
+    res = idx.search(q, 10, nprobe=32)
+    assert recall(res, want) == 1.0
+
+
+def test_ivf_filter_and_delete(corpus):
+    ids, x, q, want = corpus
+    idx = new_index(1, ivf_param())
+    idx.add(ids, x)
+    idx.train()
+    idx.delete(ids[:500])
+    res = idx.search(q, 20, filter_spec=FilterSpec(ranges=[(1000, 2000)]),
+                     nprobe=32)
+    for r in res:
+        assert ((r.ids >= 1000) & (r.ids < 2000)).all()
+
+
+def test_ivf_save_load(tmp_path, corpus):
+    ids, x, q, want = corpus
+    idx = new_index(1, ivf_param())
+    idx.add(ids, x)
+    idx.train()
+    idx.save(str(tmp_path))
+    idx2 = new_index(1, ivf_param())
+    idx2.load(str(tmp_path))
+    assert idx2.is_trained()
+    r1 = idx.search(q[:4], 5, nprobe=8)
+    r2 = idx2.search(q[:4], 5, nprobe=8)
+    for a, b in zip(r1, r2):
+        np.testing.assert_array_equal(a.ids, b.ids)
+
+
+# ---------------- IVF_PQ ----------------
+
+
+def pq_param(**kw):
+    defaults = dict(
+        index_type=IndexType.IVF_PQ, dimension=32, ncentroids=16,
+        nsubvector=8, default_nprobe=8,
+    )
+    defaults.update(kw)
+    return IndexParameter(**defaults)
+
+
+def test_ivfpq_hybrid_untrained_exact(corpus):
+    """The hybrid contract: untrained IVF_PQ serves EXACT flat search
+    (vector_index_ivf_pq.h:113-115), unlike IVF_FLAT which errors."""
+    ids, x, q, want = corpus
+    idx = new_index(2, pq_param())
+    idx.add(ids, x)
+    res = idx.search(q, 10)
+    assert recall(res, want) == 1.0
+
+
+def test_ivfpq_trained_recall(corpus):
+    ids, x, q, want = corpus
+    idx = new_index(2, pq_param())
+    idx.add(ids, x)
+    idx.train()
+    assert idx.is_trained()
+    res = idx.search(q, 10, nprobe=16)
+    # residual PQ8 over 32d: coarse codes; self-neighbors should survive
+    assert recall(res, want) >= 0.5
+
+
+def test_ivfpq_add_after_train_and_delete(corpus):
+    ids, x, q, want = corpus
+    idx = new_index(2, pq_param())
+    idx.add(ids[:4000], x[:4000])
+    idx.train()
+    idx.add(ids[4000:], x[4000:])
+    assert idx.get_count() == 5000
+    idx.delete(ids[:100])
+    res = idx.search(q, 10, nprobe=16)
+    for r in res:
+        assert (r.ids >= 100).all()
+
+
+def test_ivfpq_save_load(tmp_path, corpus):
+    ids, x, q, want = corpus
+    idx = new_index(2, pq_param())
+    idx.add(ids, x)
+    idx.train()
+    idx.save(str(tmp_path))
+    idx2 = new_index(2, pq_param())
+    idx2.load(str(tmp_path))
+    r1 = idx.search(q[:4], 5, nprobe=8)
+    r2 = idx2.search(q[:4], 5, nprobe=8)
+    for a, b in zip(r1, r2):
+        np.testing.assert_array_equal(a.ids, b.ids)
+
+
+def test_ivfpq_dimension_not_divisible():
+    from dingo_tpu.index.base import InvalidParameter
+
+    with pytest.raises(InvalidParameter):
+        new_index(2, pq_param(dimension=30))
+
+
+# ---------------- HNSW ----------------
+
+
+def hnsw_param(**kw):
+    defaults = dict(
+        index_type=IndexType.HNSW, dimension=32, nlinks=16, efconstruction=80,
+    )
+    defaults.update(kw)
+    return IndexParameter(**defaults)
+
+
+def test_hnsw_recall(corpus):
+    ids, x, q, want = corpus
+    idx = new_index(3, hnsw_param())
+    idx.add(ids, x)
+    res = idx.search(q, 10, ef=80)
+    assert recall(res, want) >= 0.9
+
+
+def test_hnsw_delete_and_rebuild_trigger(corpus):
+    ids, x, q, want = corpus
+    idx = new_index(3, hnsw_param())
+    idx.add(ids[:1000], x[:1000])
+    assert not idx.need_to_rebuild()
+    idx.delete(ids[:600])
+    # deleted (600) * 2 > total (1000): reference trigger
+    assert idx.need_to_rebuild()
+    res = idx.search(q, 5, ef=80)
+    for r in res:
+        assert (r.ids >= 600).all()
+
+
+def test_hnsw_filter(corpus):
+    ids, x, q, want = corpus
+    idx = new_index(3, hnsw_param())
+    idx.add(ids, x)
+    res = idx.search(q, 5, filter_spec=FilterSpec(ranges=[(2000, 3000)]),
+                     ef=200)
+    for r in res:
+        if len(r.ids):
+            assert ((r.ids >= 2000) & (r.ids < 3000)).all()
+
+
+def test_hnsw_upsert_moves_vector(corpus):
+    ids, x, q, want = corpus
+    idx = new_index(3, hnsw_param())
+    idx.add(ids[:100], x[:100])
+    idx.upsert(ids[:1], x[4999][None, :])
+    res = idx.search(x[4999][None, :], 1, ef=50)
+    assert res[0].ids[0] == 0
+    assert res[0].distances[0] == pytest.approx(0.0, abs=1e-3)
+
+
+def test_hnsw_save_load(tmp_path, corpus):
+    ids, x, q, want = corpus
+    idx = new_index(3, hnsw_param())
+    idx.add(ids[:2000], x[:2000])
+    idx.save(str(tmp_path))
+    idx2 = new_index(3, hnsw_param())
+    idx2.load(str(tmp_path))
+    assert idx2.get_count() == 2000
+    r1 = idx.search(q[:4], 5)
+    r2 = idx2.search(q[:4], 5)
+    for a, b in zip(r1, r2):
+        np.testing.assert_array_equal(a.ids, b.ids)
+
+
+def test_hnsw_empty_search():
+    idx = new_index(3, hnsw_param())
+    res = idx.search(np.zeros((2, 32), np.float32), 5)
+    assert all(len(r.ids) == 0 for r in res)
+
+
+# ---------------- factory ----------------
+
+
+def test_factory_unimplemented_type_raises_cleanly():
+    with pytest.raises(NotSupported):
+        new_index(1, IndexParameter(index_type=IndexType.DISKANN, dimension=8))

@@ -1,0 +1,239 @@
+"""TpuFlat functional + filter + save/load + recall tests.
+
+Mirrors reference suites test/unit_test/vector/test_vector_index_flat.cc,
+test_vector_index_flat_search_param.cc, test_vector_index_recall_flat.cc
+(recall harness at :103-170), test_vector_index_snapshot.cc."""
+
+import numpy as np
+import pytest
+
+from dingo_tpu.index import (
+    FilterSpec,
+    IndexParameter,
+    IndexType,
+    VectorIndex,
+    new_index,
+)
+from dingo_tpu.index.base import InvalidParameter, NotSupported
+from dingo_tpu.ops.distance import Metric
+
+
+def make_index(metric=Metric.L2, dim=32) -> VectorIndex:
+    return new_index(
+        1001, IndexParameter(index_type=IndexType.FLAT, dimension=dim, metric=metric)
+    )
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((1000, 32)).astype(np.float32)
+    ids = np.arange(100, 1100, dtype=np.int64)
+    return ids, x
+
+
+def test_add_search_exact(corpus):
+    ids, x = corpus
+    idx = make_index()
+    idx.add(ids, x)
+    assert idx.get_count() == 1000
+    q = x[[3, 500]]
+    res = idx.search(q, 5)
+    assert res[0].ids[0] == ids[3] and res[1].ids[0] == ids[500]
+    assert res[0].distances[0] == pytest.approx(0.0, abs=1e-3)
+    # full exactness vs numpy
+    d = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    want = ids[np.argsort(d, 1)[:, :5]]
+    got = np.stack([r.ids for r in res])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_duplicate_add_rejected(corpus):
+    ids, x = corpus
+    idx = make_index()
+    idx.add(ids[:10], x[:10])
+    with pytest.raises(InvalidParameter):
+        idx.add(ids[5:15], x[5:15])
+
+
+def test_upsert_replaces(corpus):
+    ids, x = corpus
+    idx = make_index()
+    idx.add(ids[:10], x[:10])
+    new_vec = x[999][None, :]
+    idx.upsert(ids[:1], new_vec)
+    res = idx.search(new_vec, 1)
+    assert res[0].ids[0] == ids[0]
+    assert idx.get_count() == 10
+
+
+def test_delete_tombstones(corpus):
+    ids, x = corpus
+    idx = make_index()
+    idx.add(ids[:100], x[:100])
+    idx.delete(ids[:50])
+    assert idx.get_count() == 50
+    res = idx.search(x[10][None, :], 3)
+    assert all(i >= ids[50] for i in res[0].ids)
+    # deleting unknown ids is a no-op (reference ignores missing ids)
+    idx.delete(np.array([999999], np.int64))
+
+
+def test_search_more_than_count(corpus):
+    ids, x = corpus
+    idx = make_index()
+    idx.add(ids[:3], x[:3])
+    res = idx.search(x[0][None, :], 10)
+    assert len(res[0].ids) == 3  # fewer results than topk, no -1s
+
+
+def test_ip_and_cosine_metrics(corpus):
+    ids, x = corpus
+    for metric in (Metric.INNER_PRODUCT, Metric.COSINE):
+        idx = make_index(metric)
+        idx.add(ids, x)
+        q = x[[42]]
+        res = idx.search(q, 5)
+        if metric is Metric.INNER_PRODUCT:
+            want = ids[np.argsort(-(q @ x.T), 1)[:, :5]]
+        else:
+            qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+            xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+            want = ids[np.argsort(-(qn @ xn.T), 1)[:, :5]]
+        np.testing.assert_array_equal(res[0].ids, want[0])
+        # descending similarity
+        assert (np.diff(res[0].distances) <= 1e-5).all()
+
+
+def test_range_filter(corpus):
+    """RangeFilterFunctor parity (vector_index.h:75-84): region split child
+    serves [lo, hi) of the parent's id space."""
+    ids, x = corpus
+    idx = make_index()
+    idx.add(ids, x)
+    f = FilterSpec(ranges=[(100, 200), (300, 400)])
+    res = idx.search(x[:4], 20, filter_spec=f)
+    for r in res:
+        assert (((r.ids >= 100) & (r.ids < 200)) | ((r.ids >= 300) & (r.ids < 400))).all()
+        assert len(r.ids) == 20
+
+
+def test_include_ids_filter(corpus):
+    """SortFilterFunctor / scalar pre-filter parity (vector_reader.cc:853)."""
+    ids, x = corpus
+    idx = make_index()
+    idx.add(ids, x)
+    allow = ids[::7]
+    res = idx.search(x[:2], 10, filter_spec=FilterSpec(include_ids=allow))
+    allow_set = set(allow.tolist())
+    for r in res:
+        assert set(r.ids.tolist()) <= allow_set
+    # numpy reference: best allowed neighbors
+    d = ((x[:2][:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    mask = np.isin(ids, allow)
+    d[:, ~mask] = np.inf
+    want = ids[np.argsort(d, 1)[:, :10]]
+    np.testing.assert_array_equal(np.stack([r.ids for r in res]), want)
+
+
+def test_exclude_ids_filter(corpus):
+    ids, x = corpus
+    idx = make_index()
+    idx.add(ids, x)
+    res = idx.search(x[[3]], 5, filter_spec=FilterSpec(exclude_ids=ids[[3]]))
+    assert ids[3] not in res[0].ids
+
+
+def test_range_search(corpus):
+    ids, x = corpus
+    idx = make_index()
+    idx.add(ids, x)
+    q = x[[0]]
+    d = ((q - x) ** 2).sum(-1)
+    radius = float(np.sort(d)[20])
+    res = idx.range_search(q, radius)
+    want = set(ids[d <= radius].tolist())
+    assert set(res[0].ids.tolist()) == want
+
+
+def test_save_load_roundtrip(tmp_path, corpus):
+    ids, x = corpus
+    idx = make_index()
+    idx.add(ids, x)
+    idx.delete(ids[:10])
+    idx.apply_log_id = 777
+    idx.save(str(tmp_path))
+    idx2 = make_index()
+    idx2.load(str(tmp_path))
+    assert idx2.get_count() == 990
+    assert idx2.apply_log_id == 777
+    r1 = idx.search(x[[500]], 5)[0]
+    r2 = idx2.search(x[[500]], 5)[0]
+    np.testing.assert_array_equal(r1.ids, r2.ids)
+
+
+def test_capacity_growth():
+    rng = np.random.default_rng(0)
+    idx = make_index(dim=8)
+    for batch in range(5):
+        ids = np.arange(batch * 2000, (batch + 1) * 2000, dtype=np.int64)
+        idx.add(ids, rng.standard_normal((2000, 8)).astype(np.float32))
+    assert idx.get_count() == 10000
+    assert idx.store.capacity >= 10000
+    res = idx.search(rng.standard_normal((1, 8)).astype(np.float32), 3)
+    assert len(res[0].ids) == 3
+
+
+def test_recall_harness(corpus):
+    """Recall@k == 1.0 for exact flat (reference
+    test_vector_index_recall_flat.cc:103-170 computes the same)."""
+    ids, x = corpus
+    idx = make_index()
+    idx.add(ids, x)
+    rng = np.random.default_rng(11)
+    q = x[rng.choice(1000, 32, replace=False)] + 0.01 * rng.standard_normal(
+        (32, 32)
+    ).astype(np.float32)
+    res = idx.search(q, 10)
+    d = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    want = ids[np.argsort(d, 1)[:, :10]]
+    recall = np.mean(
+        [len(set(r.ids) & set(w)) / 10 for r, w in zip(res, want)]
+    )
+    assert recall == 1.0
+
+
+def test_bruteforce_type_not_supported():
+    idx = new_index(
+        1, IndexParameter(index_type=IndexType.BRUTEFORCE, dimension=8)
+    )
+    with pytest.raises(NotSupported):
+        idx.search(np.zeros((1, 8), np.float32), 1)
+
+
+def test_binary_flat_hamming():
+    rng = np.random.default_rng(1)
+    dim_bits = 64
+    x = rng.integers(0, 256, (200, dim_bits // 8), dtype=np.uint8)
+    ids = np.arange(200, dtype=np.int64)
+    idx = new_index(
+        2,
+        IndexParameter(
+            index_type=IndexType.BINARY_FLAT,
+            dimension=dim_bits,
+            metric=Metric.HAMMING,
+        ),
+    )
+    idx.add(ids, x)
+    res = idx.search(x[[5]], 3)
+    assert res[0].ids[0] == 5 and res[0].distances[0] == 0.0
+
+
+def test_dimension_mismatch_rejected(corpus):
+    ids, x = corpus
+    idx = make_index()
+    with pytest.raises(InvalidParameter):
+        idx.add(ids[:2], np.zeros((2, 16), np.float32))
+    idx.add(ids[:2], x[:2])
+    with pytest.raises(InvalidParameter):
+        idx.search(np.zeros((1, 16), np.float32), 1)

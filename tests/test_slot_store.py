@@ -1,0 +1,93 @@
+"""SlotStore regression tests, including code-review findings:
+capacity-boundary write windows and in-flight slot reclamation."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dingo_tpu.index import IndexParameter, IndexType, new_index
+from dingo_tpu.index.base import InvalidParameter
+from dingo_tpu.index.slot_store import SlotStore
+
+
+def test_capacity_boundary_write_no_corruption():
+    """Regression: a pow2 write bucket reaching past capacity used to get its
+    start clamped by dynamic_update_slice, shifting the write one slot off."""
+    store = SlotStore(4, capacity=4096)
+    ids1 = np.arange(4093, dtype=np.int64)
+    v1 = np.arange(4093 * 4, dtype=np.float32).reshape(4093, 4)
+    store.put(ids1, v1)
+    ids2 = np.arange(4093, 4096, dtype=np.int64)
+    v2 = -np.arange(1, 13, dtype=np.float32).reshape(3, 4)
+    store.put(ids2, v2)
+    # boundary rows and their neighbor are all intact
+    found, got = store.gather(np.array([4091, 4092, 4093, 4094, 4095]))
+    assert found.all()
+    np.testing.assert_array_equal(got[0], v1[4091])
+    np.testing.assert_array_equal(got[1], v1[4092])
+    np.testing.assert_array_equal(got[2:], v2)
+    # sqnorm consistent too
+    sq = np.asarray(store.sqnorm)
+    np.testing.assert_allclose(
+        sq[4092], (v1[4092] ** 2).sum(), rtol=1e-5
+    )
+    np.testing.assert_allclose(sq[4095], (v2[2] ** 2).sum(), rtol=1e-5)
+
+
+def test_growth_preserves_content():
+    store = SlotStore(8, capacity=4096)
+    rng = np.random.default_rng(0)
+    v = rng.standard_normal((10000, 8)).astype(np.float32)
+    store.put(np.arange(10000, dtype=np.int64), v)
+    assert store.capacity >= 10000
+    found, got = store.gather(np.array([0, 4095, 4096, 9999]))
+    assert found.all()
+    np.testing.assert_array_equal(got, v[[0, 4095, 4096, 9999]])
+
+
+def test_inflight_slot_not_reused():
+    """Regression: slots freed while a search is in flight must not be handed
+    to new ids before the search resolves (id misattribution)."""
+    idx = new_index(
+        1, IndexParameter(index_type=IndexType.FLAT, dimension=4)
+    )
+    v = np.eye(4, dtype=np.float32)
+    idx.add(np.arange(4, dtype=np.int64), v)
+    thunk = idx.search_async(v[[0]], 1)
+    # free slot of id 0, then insert id 99 (would reuse the slot eagerly)
+    idx.delete(np.array([0], np.int64))
+    idx.add(np.array([99], np.int64), v[[0]])
+    slot_of_99 = idx.store.slots_of(np.array([99]))[0]
+    res = thunk()
+    # the in-flight search must NOT report id 99 for old slot contents
+    assert 99 not in res[0].ids or slot_of_99 not in idx.store.slots_of(np.array([0]))
+    # after resolve, limbo drains back to the free list
+    assert idx.store._inflight == 0 and not idx.store._limbo
+
+
+def test_intra_batch_duplicate_rejected():
+    idx = new_index(
+        1, IndexParameter(index_type=IndexType.FLAT, dimension=4)
+    )
+    with pytest.raises(InvalidParameter):
+        idx.add(
+            np.array([7, 7], np.int64), np.zeros((2, 4), np.float32)
+        )
+
+
+def test_metric_mismatch_on_load(tmp_path):
+    from dingo_tpu.ops.distance import Metric
+
+    idx = new_index(
+        1, IndexParameter(index_type=IndexType.FLAT, dimension=4)
+    )
+    idx.add(np.arange(3, dtype=np.int64), np.eye(4, dtype=np.float32)[:3])
+    idx.save(str(tmp_path))
+    idx2 = new_index(
+        1,
+        IndexParameter(
+            index_type=IndexType.FLAT, dimension=4, metric=Metric.COSINE
+        ),
+    )
+    with pytest.raises(InvalidParameter):
+        idx2.load(str(tmp_path))

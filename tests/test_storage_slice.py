@@ -1,0 +1,249 @@
+"""End-to-end single-node slice: Storage facade -> MonoStoreEngine -> apply
+handlers -> raw engine + vector index wrapper -> VectorReader.
+
+Mirrors the reference's §3.1/§3.2 call stacks without RPC/raft: the
+dual-write invariant (engine is source of truth, index is an apply-log-
+tracked view), filter modes, brute-force fallback, and recovery-by-rebuild.
+"""
+
+import numpy as np
+import pytest
+
+from dingo_tpu.coprocessor import ScalarFilter
+from dingo_tpu.engine.mono_engine import MonoStoreEngine
+from dingo_tpu.engine.raw_engine import MemEngine, WalEngine
+from dingo_tpu.engine.storage import Storage
+from dingo_tpu.index import codec as vcodec
+from dingo_tpu.index.base import IndexParameter, IndexType, InvalidParameter
+from dingo_tpu.index.vector_reader import VectorFilterMode, VectorFilterType
+from dingo_tpu.store.region import (
+    Region,
+    RegionDefinition,
+    RegionType,
+    StoreMetaManager,
+)
+
+DIM = 16
+
+
+def make_region(region_id=77, id_lo=0, id_hi=1 << 40, index_type=IndexType.FLAT):
+    definition = RegionDefinition(
+        region_id=region_id,
+        start_key=vcodec.encode_vector_key(1, id_lo),
+        end_key=vcodec.encode_vector_key(1, id_hi),
+        partition_id=1,
+        region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(index_type=index_type, dimension=DIM,
+                                       ncentroids=8, default_nprobe=8),
+    )
+    region = Region(definition)
+    w = region.vector_index_wrapper
+    w.build_own()
+    w.set_own(w.own_index)
+    return region
+
+
+@pytest.fixture()
+def stack():
+    raw = MemEngine()
+    engine = MonoStoreEngine(raw)
+    storage = Storage(engine)
+    region = make_region()
+    return raw, engine, storage, region
+
+
+def rand(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, DIM)).astype(np.float32)
+
+
+def test_vector_add_search_roundtrip(stack):
+    raw, engine, storage, region = stack
+    x = rand(100)
+    ids = np.arange(100, dtype=np.int64)
+    scalars = [{"color": "red" if i % 2 == 0 else "blue", "n": i} for i in range(100)]
+    storage.vector_add(region, ids, x, scalars)
+    res = storage.vector_batch_search(region, x[:3], 5)
+    assert [r[0].id for r in res] == [0, 1, 2]
+    assert res[0][0].distance == pytest.approx(0.0, abs=1e-3)
+    # engine holds the data (source of truth)
+    got = storage.vector_batch_query(region, [5, 99, 12345],
+                                     with_scalar_data=True)
+    assert got[0].scalar["n"] == 5
+    assert np.allclose(got[1].vector, x[99], atol=1e-5)
+    assert got[2] is None
+
+
+def test_vector_delete_hides_everywhere(stack):
+    raw, engine, storage, region = stack
+    x = rand(50)
+    storage.vector_add(region, np.arange(50, dtype=np.int64), x)
+    storage.vector_delete(region, [0, 1, 2])
+    res = storage.vector_batch_search(region, x[:1], 3)
+    assert all(v.id >= 3 for v in res[0])
+    assert storage.vector_batch_query(region, [1])[0] is None
+    assert storage.vector_count(region) == 47
+
+
+def test_scalar_post_filter(stack):
+    raw, engine, storage, region = stack
+    x = rand(200)
+    ids = np.arange(200, dtype=np.int64)
+    scalars = [{"color": "red" if i % 4 == 0 else "blue"} for i in range(200)]
+    storage.vector_add(region, ids, x, scalars)
+    res = storage.vector_batch_search(
+        region, x[:2], 5,
+        filter_mode=VectorFilterMode.SCALAR,
+        filter_type=VectorFilterType.QUERY_POST,
+        scalar_filter=ScalarFilter.equals({"color": "red"}),
+        with_scalar_data=True,
+    )
+    for row in res:
+        assert len(row) == 5
+        assert all(v.id % 4 == 0 for v in row)
+        assert all(v.scalar == {"color": "red"} for v in row)
+
+
+def test_scalar_pre_filter(stack):
+    raw, engine, storage, region = stack
+    x = rand(200)
+    ids = np.arange(200, dtype=np.int64)
+    scalars = [{"bucket": i % 10} for i in range(200)]
+    storage.vector_add(region, ids, x, scalars)
+    res = storage.vector_batch_search(
+        region, x[:2], 50,
+        filter_mode=VectorFilterMode.SCALAR,
+        filter_type=VectorFilterType.QUERY_PRE,
+        scalar_filter=ScalarFilter.equals({"bucket": 3}),
+    )
+    for row in res:
+        assert len(row) == 20  # only 20 vectors have bucket==3
+        assert all(v.id % 10 == 3 for v in row)
+
+
+def test_vector_id_pre_filter(stack):
+    raw, engine, storage, region = stack
+    x = rand(100)
+    storage.vector_add(region, np.arange(100, dtype=np.int64), x)
+    res = storage.vector_batch_search(
+        region, x[:1], 10,
+        filter_mode=VectorFilterMode.VECTOR_ID,
+        vector_ids=[7, 13, 21],
+    )
+    assert sorted(v.id for v in res[0]) == [7, 13, 21]
+
+
+def test_bruteforce_fallback_from_untrained_ivf(stack):
+    """EVECTOR_NOT_SUPPORT contract (vector_reader.cc:1814-1833): untrained
+    IVF search falls back to scanning the engine."""
+    raw = MemEngine()
+    engine = MonoStoreEngine(raw)
+    storage = Storage(engine)
+    region = make_region(index_type=IndexType.IVF_FLAT)
+    x = rand(120)
+    storage.vector_add(region, np.arange(120, dtype=np.int64), x)
+    res = storage.vector_batch_search(region, x[:2], 5)
+    assert [r[0].id for r in res] == [0, 1]
+
+
+def test_bruteforce_type_scans_engine(stack):
+    region = make_region(index_type=IndexType.BRUTEFORCE)
+    raw = MemEngine()
+    engine = MonoStoreEngine(raw)
+    storage = Storage(engine)
+    x = rand(30)
+    storage.vector_add(region, np.arange(30, dtype=np.int64), x)
+    res = storage.vector_batch_search(region, x[:1], 3)
+    assert res[0][0].id == 0
+
+
+def test_rebuild_from_engine_after_restart(tmp_path):
+    """Recovery invariant: the index is a materialized view rebuildable from
+    the engine (§3.2/§3.4)."""
+    path = str(tmp_path / "wal")
+    raw = WalEngine(path)
+    engine = MonoStoreEngine(raw)
+    storage = Storage(engine)
+    region = make_region()
+    x = rand(60)
+    storage.vector_add(region, np.arange(60, dtype=np.int64), x)
+    storage.vector_delete(region, [10, 11])
+    raw.close()
+
+    # restart: fresh engine + empty index; rebuild from the data CF
+    raw2 = WalEngine(path)
+    engine2 = MonoStoreEngine(raw2)
+    storage2 = Storage(engine2)
+    region2 = make_region()
+    reader = engine2.new_vector_reader(region2)
+    rows = reader.vector_scan_query(0, limit=10_000, with_vector_data=True)
+    assert len(rows) == 58
+    w = region2.vector_index_wrapper
+    w.add(
+        np.asarray([r.id for r in rows], np.int64),
+        np.stack([r.vector for r in rows]),
+        log_id=1,
+    )
+    res = storage2.vector_batch_search(region2, x[:1], 3)
+    assert res[0][0].id == 0
+    assert storage2.vector_count(region2) == 58
+    raw2.close()
+
+
+def test_validation_guards(stack):
+    raw, engine, storage, region = stack
+    x = rand(10)
+    with pytest.raises(InvalidParameter):
+        storage.vector_add(region, np.arange(9, dtype=np.int64), x)
+    with pytest.raises(InvalidParameter):
+        storage.vector_add(
+            region, np.arange(5000, dtype=np.int64), rand(5000)
+        )
+    storage.vector_add(region, np.arange(10, dtype=np.int64), x)
+    with pytest.raises(InvalidParameter):
+        storage.vector_batch_search(region, x, 100000)
+
+
+def test_border_ids_and_scan(stack):
+    raw, engine, storage, region = stack
+    x = rand(20)
+    ids = (np.arange(20, dtype=np.int64) + 1) * 5
+    storage.vector_add(region, ids, x)
+    assert storage.vector_get_border_id(region, get_min=True) == 5
+    assert storage.vector_get_border_id(region, get_min=False) == 100
+    rows = storage.vector_scan_query(region, start_id=50, limit=3)
+    assert [r.id for r in rows] == [50, 55, 60]
+
+
+def test_kv_surface(stack):
+    raw, engine, storage, region = stack
+    storage.kv_put(region, [(b"a", b"1"), (b"b", b"2")])
+    assert storage.kv_get(region, b"a") == b"1"
+    assert storage.kv_put_if_absent(region, [(b"a", b"X"), (b"c", b"3")]) == [
+        False,
+        True,
+    ]
+    assert storage.kv_get(region, b"a") == b"1"
+    assert storage.kv_compare_and_set(region, b"b", b"2", b"20")
+    assert not storage.kv_compare_and_set(region, b"b", b"2", b"30")
+    assert storage.kv_get(region, b"b") == b"20"
+    storage.kv_batch_delete(region, [b"a"])
+    assert storage.kv_get(region, b"a") is None
+    got = storage.kv_scan(region, b"a", b"z")
+    assert [k for k, _ in got] == [b"b", b"c"]
+    storage.kv_delete_range(region, [(b"a", b"z")])
+    assert storage.kv_scan(region, b"a", b"z") == []
+
+
+def test_meta_manager_recovery(tmp_path):
+    raw = WalEngine(str(tmp_path / "meta"))
+    mm = StoreMetaManager(raw)
+    region = make_region()
+    mm.add_region(region)
+    raw.close()
+    raw2 = WalEngine(str(tmp_path / "meta"))
+    mm2 = StoreMetaManager(raw2)
+    assert mm2.recover() == 1
+    r = mm2.get_region(77)
+    assert r is not None and r.definition.partition_id == 1
+    raw2.close()
