@@ -1,0 +1,121 @@
+"""RaftStoreEngine: raft-replicated engine.
+
+Reference: src/engine/raft_store_engine.{h,cc} — one RaftNode per region
+(raft_node_manager_, raft_store_engine.cc:67,232); Write = propose + wait
+(:417-444); reads go straight to the RawEngine (:466+) since committed state
+is applied locally. The state machine callback dispatches committed payloads
+through the same apply handlers the mono engine uses
+(StoreStateMachine::on_apply -> RaftApplyHandlerFactory, §3.2).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Dict, Optional
+
+from dingo_tpu.engine.apply import apply_write
+from dingo_tpu.engine.raw_engine import RawEngine
+from dingo_tpu.engine.write_data import WriteData
+from dingo_tpu.index.vector_reader import ReaderContext, VectorReader
+from dingo_tpu.mvcc.codec import MAX_TS
+from dingo_tpu.raft.core import RaftNode
+from dingo_tpu.raft.transport import Transport
+from dingo_tpu.store.region import Region
+
+
+class RaftStoreEngine:
+    """Holds this store's raw engine + the raft node per hosted region."""
+
+    def __init__(self, raw_engine: RawEngine, store_id: str,
+                 transport: Transport):
+        self.raw = raw_engine
+        self.store_id = store_id
+        self.transport = transport
+        self._lock = threading.Lock()
+        self._nodes: Dict[int, RaftNode] = {}   # RaftNodeManager
+        self._regions: Dict[int, Region] = {}
+
+    # -- node management (RaftNodeManager / AddNode) -------------------------
+    def node_address(self, region_id: int) -> str:
+        return f"{self.store_id}/r{region_id}"
+
+    def add_node(self, region: Region, peer_store_ids, log=None,
+                 **raft_kw) -> RaftNode:
+        """AddNode (raft_store_engine.cc:232): start this region's raft
+        member on this store."""
+        region_id = region.id
+
+        def apply_fn(index: int, payload: bytes) -> None:
+            data = pickle.loads(payload)
+            apply_write(self.raw, region, data, index)
+
+        def snapshot_save() -> bytes:
+            # Region-scoped checkpoint: the reference streams RocksDB SSTs
+            # (DingoFileSystemAdaptor); here the engine state snapshot is the
+            # blob. Engine-wide for now (single-region-per-engine tests).
+            state = self.raw.snapshot_state()
+            return pickle.dumps(state, protocol=4)
+
+        def snapshot_install(blob: bytes) -> None:
+            self.raw.load_state(pickle.loads(blob))
+            # in-memory index must be rebuilt after a full state install
+            wrapper = region.vector_index_wrapper
+            if wrapper is not None:
+                wrapper.ready = False
+
+        node = RaftNode(
+            self.node_address(region_id),
+            [f"{sid}/r{region_id}" for sid in peer_store_ids],
+            self.transport,
+            log=log,
+            apply_fn=apply_fn,
+            snapshot_save_fn=snapshot_save,
+            snapshot_install_fn=snapshot_install,
+            **raft_kw,
+        )
+        with self._lock:
+            self._nodes[region_id] = node
+            self._regions[region_id] = region
+        node.start()
+        return node
+
+    def get_node(self, region_id: int) -> Optional[RaftNode]:
+        with self._lock:
+            return self._nodes.get(region_id)
+
+    def stop_node(self, region_id: int) -> None:
+        with self._lock:
+            node = self._nodes.pop(region_id, None)
+            self._regions.pop(region_id, None)
+        if node:
+            node.stop()
+
+    def stop(self) -> None:
+        with self._lock:
+            nodes = list(self._nodes.values())
+            self._nodes.clear()
+        for n in nodes:
+            n.stop()
+
+    # -- Engine::Writer (Write = propose + wait, raft_store_engine.cc:417) ---
+    def write(self, region: Region, data: WriteData, timeout: float = 5.0) -> int:
+        node = self.get_node(region.id)
+        if node is None:
+            raise RuntimeError(f"no raft node for region {region.id}")
+        payload = pickle.dumps(data, protocol=4)
+        return node.propose(payload, timeout=timeout)
+
+    # -- Engine::VectorReader -------------------------------------------------
+    def new_vector_reader(self, region: Region, read_ts: int = MAX_TS) -> VectorReader:
+        ctx = ReaderContext(
+            region_id=region.id,
+            partition_id=region.definition.partition_id,
+            start_key=region.definition.start_key,
+            end_key=region.definition.end_key,
+            index_wrapper=region.vector_index_wrapper,
+            engine=self.raw,
+            read_ts=read_ts,
+            parameter=region.definition.index_parameter,
+        )
+        return VectorReader(ctx)

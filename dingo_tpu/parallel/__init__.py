@@ -1,0 +1,15 @@
+"""Mesh sharding / collectives: the TPU-native distribution layer.
+
+The reference scales one logical dataset beyond a node via region split +
+client-side scatter-gather (SURVEY.md §5 'long-context' note) and
+parallelizes within a node via ThreadPools (vector_index.h:157-196
+*ByParallel). The TPU equivalents here:
+
+  sharded_store.py — one region's vectors sharded across a jax Mesh
+                     (row-sharded data parallel), per-device top-k +
+                     all-gather + merge in one shard_map program.
+  sharded_train.py — k-means training over the mesh (psum-reduced
+                     assignment statistics).
+"""
+
+from dingo_tpu.parallel.sharded_store import ShardedFlatStore  # noqa: F401

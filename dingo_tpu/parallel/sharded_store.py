@@ -1,0 +1,239 @@
+"""Region vectors sharded over a TPU mesh: distributed search + train.
+
+The TPU answer to the reference's cross-node scale story (regions +
+client-side scatter-gather; brpc fan-out): one region's vectors live in a
+jax.sharding.Mesh over a 2D ("data", "dim") layout —
+
+  data axis — rows (vectors) sharded, the DP analog of region shards;
+              per-device local top-k then all_gather + merge, the ICI
+              replacement for the reference's RPC scatter-gather.
+  dim axis  — feature dimension sharded (TP): each device holds a d/TP
+              column slice, partial dot products psum over the axis.
+
+Everything below runs in one jit'd shard_map program, so XLA inserts the
+collectives (psum for partial dots, all_gather for top-k merge) over ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dingo_tpu.ops.distance import Metric
+from dingo_tpu.ops.topk import merge_sharded_topk, topk_scores
+
+
+def make_mesh(n_devices: Optional[int] = None, data: Optional[int] = None,
+              dim: int = 1) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    data = data or (n // dim)
+    assert data * dim == n, f"mesh {data}x{dim} != {n} devices"
+    return Mesh(
+        np.asarray(devs[:n]).reshape(data, dim), axis_names=("data", "dim")
+    )
+
+
+def _local_search(vecs, sqnorm, valid, queries, k, ascending):
+    """Per-device block: partial dots psum'd over 'dim', local top-k over the
+    row shard, then all_gather + merge over 'data'. Runs inside shard_map."""
+    dots = jnp.einsum(
+        "bd,nd->bn", queries, vecs,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    dots = jax.lax.psum(dots, "dim")                    # TP partial sums
+    if ascending:  # L2: sqnorm is full-row norm (precomputed once, replicated
+        # over 'dim'); query norm also psum'd from the local slice
+        q_sq = jnp.einsum(
+            "bd,bd->b", queries, queries,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        q_sq = jax.lax.psum(q_sq, "dim")
+        scores = -(q_sq[:, None] - 2.0 * dots + sqnorm[None, :])
+    else:
+        scores = dots
+    vals, slots = topk_scores(scores, k, valid=valid)
+    # local slot -> global slot
+    shard = jax.lax.axis_index("data")
+    cap = vecs.shape[0]
+    gslots = jnp.where(slots >= 0, slots + shard * cap, -1)
+    all_vals = jax.lax.all_gather(vals, "data")         # [S, b, k]
+    all_slots = jax.lax.all_gather(gslots, "data")
+    return merge_sharded_topk(all_vals, all_slots, k)
+
+
+def _kmeans_step(vecs, valid, centroids):
+    """One sharded Lloyd iteration: assignment on row shards with psum'd
+    statistics over BOTH mesh axes. centroids replicated [k, d_local]."""
+    dots = jnp.einsum(
+        "nd,kd->nk", vecs, centroids,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    dots = jax.lax.psum(dots, "dim")
+    c_sq = jax.lax.psum(
+        jnp.einsum("kd,kd->k", centroids, centroids,
+                   precision=jax.lax.Precision.HIGHEST),
+        "dim",
+    )
+    x_sq = jax.lax.psum(
+        jnp.einsum("nd,nd->n", vecs, vecs,
+                   precision=jax.lax.Precision.HIGHEST),
+        "dim",
+    )
+    dist = x_sq[:, None] - 2.0 * dots + c_sq[None, :]
+    k = centroids.shape[0]
+    onehot = jax.nn.one_hot(jnp.argmin(dist, axis=1), k, dtype=jnp.float32)
+    onehot = onehot * valid[:, None]
+    sums = jnp.einsum("nk,nd->kd", onehot, vecs,
+                      precision=jax.lax.Precision.HIGHEST)
+    sums = jax.lax.psum(sums, "data")                   # DP reduce
+    counts = jax.lax.psum(onehot.sum(axis=0), "data")
+    new_c = jnp.where(
+        (counts > 0.5)[:, None], sums / jnp.maximum(counts, 1.0)[:, None],
+        centroids,
+    )
+    return new_c, counts
+
+
+class ShardedFlatStore:
+    """A region's vectors sharded [data, dim] with replicated metadata."""
+
+    def __init__(self, mesh: Mesh, dim: int, metric: Metric = Metric.L2):
+        if metric not in (Metric.L2, Metric.INNER_PRODUCT, Metric.COSINE):
+            raise ValueError(f"unsupported sharded metric {metric}")
+        self.mesh = mesh
+        self.dim = dim
+        self.metric = metric
+        self.n_data = mesh.shape["data"]
+        self.n_dim = mesh.shape["dim"]
+        assert dim % self.n_dim == 0, "dim must divide over mesh 'dim' axis"
+        self.cap_per_shard = 0
+        self.vecs = None       # [S*cap, d] sharded ('data', 'dim')
+        self.sqnorm = None     # [S*cap] sharded ('data',)
+        self.valid = None
+        self.ids_by_gslot: Optional[np.ndarray] = None  # host, int64
+        self._build_programs()
+
+    # -- data placement ------------------------------------------------------
+    def load(self, ids: np.ndarray, vectors: np.ndarray) -> None:
+        vectors = np.asarray(vectors, np.float32)
+        if self.metric is Metric.COSINE:
+            norms = np.linalg.norm(vectors, axis=1, keepdims=True)
+            vectors = vectors / np.maximum(norms, 1e-30)
+        n = vectors.shape[0]
+        cap = -(-n // self.n_data)          # ceil
+        cap = max(8, cap + (-cap) % 8)      # pad to sublane multiple
+        total = cap * self.n_data
+        pad = total - n
+        vecs = np.concatenate(
+            [vectors, np.zeros((pad, self.dim), np.float32)]
+        )
+        sqnorm = (vecs.astype(np.float64) ** 2).sum(1).astype(np.float32)
+        valid = np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])
+        self.ids_by_gslot = np.concatenate(
+            [np.asarray(ids, np.int64), np.full(pad, -1, np.int64)]
+        )
+        self.cap_per_shard = cap
+        self.vecs = jax.device_put(
+            vecs, NamedSharding(self.mesh, P("data", "dim"))
+        )
+        self.sqnorm = jax.device_put(
+            sqnorm, NamedSharding(self.mesh, P("data"))
+        )
+        self.valid = jax.device_put(
+            valid, NamedSharding(self.mesh, P("data"))
+        )
+
+    # -- jitted programs (built once per store; arrays are ARGUMENTS, never
+    # closed over — a jit cache keyed on static self would bake stale device
+    # arrays in after a reload) ----------------------------------------------
+    def _build_programs(self):
+        mesh = self.mesh
+        ascending = self.metric is Metric.L2
+
+        def search_fn(vecs, sqnorm, valid, queries, k):
+            f = shard_map(
+                functools.partial(_local_search, k=k, ascending=ascending),
+                mesh=mesh,
+                in_specs=(P("data", "dim"), P("data"), P("data"),
+                          P(None, "dim")),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+            return f(vecs, sqnorm, valid, queries)
+
+        self._search_jit = jax.jit(search_fn, static_argnames=("k",))
+
+        def train_fn(vecs, valid, centroids0, iters):
+            step = shard_map(
+                _kmeans_step,
+                mesh=mesh,
+                in_specs=(P("data", "dim"), P("data"), P(None, "dim")),
+                out_specs=(P(None, "dim"), P()),
+                check_vma=False,
+            )
+
+            def body(c, _):
+                c2, counts = step(vecs, valid, c)
+                return c2, counts
+
+            centroids, counts = jax.lax.scan(
+                body, centroids0, None, length=iters
+            )
+            return centroids, counts[-1]
+
+        self._train_jit = jax.jit(train_fn, static_argnames=("iters",))
+
+    def search(self, queries: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (ids [b, k] int64 with -1 padding, distances [b, k])."""
+        queries = np.asarray(queries, np.float32)
+        if self.metric is Metric.COSINE:
+            norms = np.linalg.norm(queries, axis=1, keepdims=True)
+            queries = queries / np.maximum(norms, 1e-30)
+        q = jax.device_put(
+            queries, NamedSharding(self.mesh, P(None, "dim"))
+        )
+        vals, gslots = self._search_jit(
+            self.vecs, self.sqnorm, self.valid, q, int(k)
+        )
+        vals_h, gslots_h = jax.device_get((vals, gslots))
+        safe = np.where(gslots_h >= 0, gslots_h, 0)
+        ids = np.where(gslots_h >= 0, self.ids_by_gslot[safe], -1)
+        dists = -vals_h if self.metric is Metric.L2 else vals_h
+        return ids, dists
+
+    # -- distributed k-means --------------------------------------------------
+    def train_kmeans(self, k: int, iters: int = 10, seed: int = 0):
+        """Distributed Lloyd iterations; returns (centroids [k, d], counts)."""
+        rng = np.random.default_rng(seed)
+        live = np.flatnonzero(self.ids_by_gslot >= 0)
+        # Farthest-first seeding on a host sample (random seeds collapse when
+        # a dense blob draws several — same fix as ops/kmeans.py).
+        sample_idx = (
+            live if len(live) <= 65536
+            else rng.choice(live, 65536, replace=False)
+        )
+        sample = np.asarray(jax.device_get(self.vecs))[sample_idx]
+        chosen = [int(rng.integers(len(sample)))]
+        min_d = np.full(len(sample), np.inf, np.float32)
+        for _ in range(k - 1):
+            c = sample[chosen[-1]]
+            d = ((sample - c) ** 2).sum(1)
+            np.minimum(min_d, d, out=min_d)
+            chosen.append(int(np.argmax(min_d)))
+        c0 = sample[chosen]
+        c0 = jax.device_put(
+            jnp.asarray(c0), NamedSharding(self.mesh, P(None, "dim"))
+        )
+        centroids, counts = self._train_jit(
+            self.vecs, self.valid, c0, int(iters)
+        )
+        return jax.device_get(centroids), jax.device_get(counts)

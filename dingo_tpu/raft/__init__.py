@@ -1,0 +1,13 @@
+"""Raft consensus layer.
+
+Mirrors reference src/raft/ (RaftNode over braft, raft_node.h;
+StoreStateMachine, store_state_machine.h) + src/log/ (RocksLogStorage /
+SegmentLogStorage). This is an original Raft implementation (leader election,
+log replication, commit, snapshot/compaction) with a pluggable transport:
+in-process LocalTransport for the reference-style single-process multi-peer
+tests (test_raft_node.cc:125-199), grpc for real deployments.
+"""
+
+from dingo_tpu.raft.core import RaftNode, NotLeader  # noqa: F401
+from dingo_tpu.raft.log import RaftLog  # noqa: F401
+from dingo_tpu.raft.transport import LocalTransport  # noqa: F401

@@ -1,0 +1,70 @@
+"""Raft message transport.
+
+The reference replicates over brpc (braft's TCP stack). Here the transport is
+pluggable: LocalTransport delivers RPCs in-process with optional fault
+injection (drop/partition/delay) — the single-process multi-peer topology the
+reference's raft tests use (test_raft_node.cc: 3 braft peers on one
+127.0.0.1 server distinguished by peer index). A grpc transport slots in for
+multi-process deployments (server/ layer).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Set, Tuple
+
+
+class Transport:
+    def send(self, target: str, method: str, msg: dict) -> Optional[dict]:
+        """Synchronous RPC; returns response dict or None on network error."""
+        raise NotImplementedError
+
+    def register(self, node_id: str, handler: Callable[[str, dict], dict]) -> None:
+        raise NotImplementedError
+
+
+class LocalTransport(Transport):
+    """In-process delivery with fault injection for tests."""
+
+    def __init__(self, seed: int = 0):
+        self._handlers: Dict[str, Callable[[str, dict], dict]] = {}
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+        self.drop_rate = 0.0
+        self._partitions: Set[Tuple[str, str]] = set()
+        self.delay_s = 0.0
+
+    def register(self, node_id: str, handler) -> None:
+        with self._lock:
+            self._handlers[node_id] = handler
+
+    def unregister(self, node_id: str) -> None:
+        with self._lock:
+            self._handlers.pop(node_id, None)
+
+    def partition(self, a: str, b: str) -> None:
+        """Cut the link a<->b (both directions)."""
+        self._partitions.add((a, b))
+        self._partitions.add((b, a))
+
+    def heal(self) -> None:
+        self._partitions.clear()
+
+    def send(self, target: str, method: str, msg: dict) -> Optional[dict]:
+        src = msg.get("from", "?")
+        if (src, target) in self._partitions:
+            return None
+        if self.drop_rate and self._rng.random() < self.drop_rate:
+            return None
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        with self._lock:
+            handler = self._handlers.get(target)
+        if handler is None:
+            return None
+        try:
+            return handler(method, msg)
+        except Exception:
+            return None

@@ -1,0 +1,119 @@
+"""Raft-replicated storage integration: 3 stores, one INDEX region, vector
+writes propose through raft and every replica's engine + vector index
+converge (§3.2 write path end-to-end, single process like the reference's
+raft tests)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from dingo_tpu.engine.raft_engine import RaftStoreEngine
+from dingo_tpu.engine.raw_engine import MemEngine
+from dingo_tpu.engine.storage import Storage
+from dingo_tpu.index import codec as vcodec
+from dingo_tpu.index.base import IndexParameter, IndexType
+from dingo_tpu.raft import LocalTransport
+from dingo_tpu.raft.core import NotLeader
+from dingo_tpu.store.region import Region, RegionDefinition, RegionType
+
+DIM = 8
+REGION_ID = 7
+
+
+def make_region():
+    definition = RegionDefinition(
+        region_id=REGION_ID,
+        start_key=vcodec.encode_vector_key(0, 0),
+        end_key=vcodec.encode_vector_key(0, 1 << 40),
+        partition_id=0,
+        region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(index_type=IndexType.FLAT, dimension=DIM),
+    )
+    region = Region(definition)
+    w = region.vector_index_wrapper
+    w.build_own()
+    w.set_own(w.own_index)
+    return region
+
+
+@pytest.fixture()
+def cluster():
+    transport = LocalTransport()
+    stores = {}
+    store_ids = ["s0", "s1", "s2"]
+    for sid in store_ids:
+        engine = RaftStoreEngine(MemEngine(), sid, transport)
+        region = make_region()
+        engine.add_node(region, store_ids, seed=int(sid[1]))
+        stores[sid] = (engine, region)
+    yield transport, stores
+    for engine, _ in stores.values():
+        engine.stop()
+
+
+def wait_leader(stores, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [
+            sid for sid, (e, _) in stores.items()
+            if e.get_node(REGION_ID).is_leader()
+        ]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.02)
+    raise AssertionError("no unique leader")
+
+
+def test_vector_write_replicates_to_all(cluster):
+    transport, stores = cluster
+    leader_id = wait_leader(stores)
+    engine, region = stores[leader_id]
+    storage = Storage(engine)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((50, DIM)).astype(np.float32)
+    ids = np.arange(50, dtype=np.int64)
+    storage.vector_add(region, ids, x, [{"i": int(i)} for i in ids])
+    storage.vector_delete(region, [0, 1])
+
+    time.sleep(0.4)  # let followers apply via heartbeats
+    for sid, (e, r) in stores.items():
+        s = Storage(e)
+        assert s.vector_count(r) == 48, sid
+        res = s.vector_batch_search(r, x[2:4], 3)
+        assert [row[0].id for row in res] == [2, 3], sid
+        # follower in-memory index converged too (apply-log contract)
+        assert r.vector_index_wrapper.get_count() == 48, sid
+        assert r.vector_index_wrapper.apply_log_id > 0, sid
+
+
+def test_write_on_follower_store_rejected(cluster):
+    transport, stores = cluster
+    leader_id = wait_leader(stores)
+    follower_id = next(s for s in stores if s != leader_id)
+    engine, region = stores[follower_id]
+    storage = Storage(engine)
+    with pytest.raises(NotLeader):
+        storage.kv_put(region, [(b"k", b"v")])
+
+
+def test_failover_preserves_data(cluster):
+    transport, stores = cluster
+    leader_id = wait_leader(stores)
+    engine, region = stores[leader_id]
+    storage = Storage(engine)
+    x = np.eye(DIM, dtype=np.float32)[:4]
+    storage.vector_add(region, np.arange(4, dtype=np.int64), x)
+    time.sleep(0.3)
+    # partition old leader away (raft nodes register as "<store>/r<region>")
+    for sid in stores:
+        if sid != leader_id:
+            transport.partition(f"{leader_id}/r{REGION_ID}", f"{sid}/r{REGION_ID}")
+    survivors = {k: v for k, v in stores.items() if k != leader_id}
+    new_leader = wait_leader(survivors)
+    e2, r2 = stores[new_leader]
+    s2 = Storage(e2)
+    s2.vector_add(r2, np.asarray([10], np.int64), x[:1] * 2)
+    res = s2.vector_batch_search(r2, x[:1] * 2, 1)
+    assert res[0][0].id == 10
+    assert s2.vector_count(r2) == 5
