@@ -1,0 +1,277 @@
+"""Percolator transaction tests (mirrors reference test/unit_test/txn/:
+prewrite/commit, conflicts, pessimistic locks, resolve, GC — directly against
+the txn engine + a raw engine, no RPC)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from dingo_tpu.engine.concurrency import ConcurrencyManager
+from dingo_tpu.engine.mono_engine import MonoStoreEngine
+from dingo_tpu.engine.raw_engine import MemEngine
+from dingo_tpu.engine.txn import (
+    KeyIsLocked,
+    Mutation,
+    Op,
+    TxnEngine,
+    TxnNotFound,
+    WriteConflict,
+)
+from dingo_tpu.store.region import Region, RegionDefinition
+
+
+def make_txn():
+    region = Region(RegionDefinition(
+        region_id=1, start_key=b"", end_key=b"\xff" * 8
+    ))
+    engine = MonoStoreEngine(MemEngine())
+    return TxnEngine(engine, region)
+
+
+def test_prewrite_commit_get():
+    t = make_txn()
+    t.prewrite([Mutation(Op.PUT, b"a", b"1"), Mutation(Op.PUT, b"b", b"2")],
+               primary=b"a", start_ts=10)
+    # uncommitted: reads at ts>=10 see the lock
+    with pytest.raises(KeyIsLocked):
+        t.get(b"a", 15)
+    assert t.get(b"a", 5) is None  # before the txn: no lock conflict
+    t.commit([b"a", b"b"], start_ts=10, commit_ts=20)
+    assert t.get(b"a", 25) == b"1"
+    assert t.get(b"a", 15) is None  # snapshot before commit
+    assert t.get(b"b", 25) == b"2"
+
+
+def test_delete_and_overwrite_versions():
+    t = make_txn()
+    t.prewrite([Mutation(Op.PUT, b"k", b"v1")], b"k", 10)
+    t.commit([b"k"], 10, 11)
+    t.prewrite([Mutation(Op.PUT, b"k", b"v2")], b"k", 20)
+    t.commit([b"k"], 20, 21)
+    t.prewrite([Mutation(Op.DELETE, b"k")], b"k", 30)
+    t.commit([b"k"], 30, 31)
+    assert t.get(b"k", 15) == b"v1"
+    assert t.get(b"k", 25) == b"v2"
+    assert t.get(b"k", 35) is None
+
+
+def test_write_conflict():
+    t = make_txn()
+    t.prewrite([Mutation(Op.PUT, b"k", b"x")], b"k", 10)
+    t.commit([b"k"], 10, 15)
+    # txn that started before the commit must conflict
+    with pytest.raises(WriteConflict):
+        t.prewrite([Mutation(Op.PUT, b"k", b"y")], b"k", 12)
+    # txn starting after is fine
+    t.prewrite([Mutation(Op.PUT, b"k", b"z")], b"k", 20)
+
+
+def test_lock_blocks_other_txn():
+    t = make_txn()
+    t.prewrite([Mutation(Op.PUT, b"k", b"x")], b"k", 10)
+    with pytest.raises(KeyIsLocked):
+        t.prewrite([Mutation(Op.PUT, b"k", b"y")], b"k", 11)
+    # same txn retries prewrite idempotently
+    t.prewrite([Mutation(Op.PUT, b"k", b"x")], b"k", 10)
+
+
+def test_rollback_then_late_prewrite_fails():
+    t = make_txn()
+    t.prewrite([Mutation(Op.PUT, b"k", b"x")], b"k", 10)
+    t.batch_rollback([b"k"], 10)
+    assert t.get(b"k", 20) is None
+    # the rollback tombstone blocks a late prewrite of the SAME txn
+    with pytest.raises(WriteConflict):
+        t.prewrite([Mutation(Op.PUT, b"k", b"x")], b"k", 10)
+
+
+def test_commit_idempotent_and_missing():
+    t = make_txn()
+    t.prewrite([Mutation(Op.PUT, b"k", b"x")], b"k", 10)
+    t.commit([b"k"], 10, 20)
+    t.commit([b"k"], 10, 20)  # idempotent
+    with pytest.raises(TxnNotFound):
+        t.commit([b"q"], 99, 100)
+
+
+def test_pessimistic_flow():
+    t = make_txn()
+    t.pessimistic_lock([b"k"], b"k", start_ts=10, for_update_ts=10)
+    # other txn blocked
+    with pytest.raises(KeyIsLocked):
+        t.pessimistic_lock([b"k"], b"k", start_ts=11, for_update_ts=11)
+    # reads are NOT blocked by a pessimistic lock
+    assert t.get(b"k", 15) is None
+    # convert to real write
+    t.prewrite([Mutation(Op.PUT, b"k", b"v")], b"k", 10)
+    t.commit([b"k"], 10, 20)
+    assert t.get(b"k", 25) == b"v"
+
+
+def test_pessimistic_rollback():
+    t = make_txn()
+    t.pessimistic_lock([b"k"], b"k", 10, 10)
+    t.pessimistic_rollback([b"k"], 10)
+    t.pessimistic_lock([b"k"], b"k", 11, 11)  # now free
+
+
+def test_check_txn_status_expired_lock():
+    t = make_txn()
+    t.prewrite([Mutation(Op.PUT, b"k", b"v")], b"k", 10, lock_ttl_ms=1)
+    time.sleep(0.01)
+    st = t.check_txn_status(b"k", 10, caller_start_ts=50)
+    assert st["action"] == "rolled_back"
+    # secondary resolution: txn rolled back everywhere
+    with pytest.raises(WriteConflict):
+        t.prewrite([Mutation(Op.PUT, b"k", b"v")], b"k", 10)
+
+
+def test_check_txn_status_committed():
+    t = make_txn()
+    t.prewrite([Mutation(Op.PUT, b"k", b"v")], b"k", 10)
+    t.commit([b"k"], 10, 20)
+    st = t.check_txn_status(b"k", 10, 50)
+    assert st == {"action": "committed", "commit_ts": 20}
+
+
+def test_resolve_lock_commits_secondaries():
+    t = make_txn()
+    t.prewrite(
+        [Mutation(Op.PUT, b"a", b"1"), Mutation(Op.PUT, b"b", b"2"),
+         Mutation(Op.PUT, b"c", b"3")],
+        b"a", 10,
+    )
+    t.commit([b"a"], 10, 20)       # primary committed, secondaries stranded
+    n = t.resolve_lock(10, 20)     # scans for leftover locks
+    assert n == 2
+    assert t.get(b"b", 25) == b"2" and t.get(b"c", 25) == b"3"
+
+
+def test_resolve_lock_rollback():
+    t = make_txn()
+    t.prewrite([Mutation(Op.PUT, b"a", b"1")], b"a", 10)
+    t.resolve_lock(10, 0)
+    assert t.get(b"a", 20) is None
+
+
+def test_heart_beat_extends_ttl():
+    t = make_txn()
+    t.prewrite([Mutation(Op.PUT, b"k", b"v")], b"k", 10, lock_ttl_ms=100)
+    ttl = t.heart_beat(b"k", 10, 60_000)
+    assert ttl == 60_000
+    st = t.check_txn_status(b"k", 10, 50)
+    assert st["action"] == "locked"
+
+
+def test_scan_snapshot():
+    t = make_txn()
+    for i, key in enumerate([b"a", b"b", b"c", b"d"]):
+        t.prewrite([Mutation(Op.PUT, key, b"v%d" % i)], key, 10 + i)
+        t.commit([key], 10 + i, 20 + i)
+    t.prewrite([Mutation(Op.DELETE, b"b")], b"b", 40)
+    t.commit([b"b"], 40, 41)
+    got = t.scan(b"a", b"z", read_ts=50)
+    assert [k for k, _ in got] == [b"a", b"c", b"d"]
+    got25 = t.scan(b"a", b"z", read_ts=22)
+    assert [k for k, _ in got25] == [b"a", b"b", b"c"]
+    got_lim = t.scan(b"a", b"z", read_ts=50, limit=2)
+    assert len(got_lim) == 2
+
+
+def test_scan_hits_lock():
+    t = make_txn()
+    t.prewrite([Mutation(Op.PUT, b"a", b"1")], b"a", 10)
+    t.commit([b"a"], 10, 20)
+    t.prewrite([Mutation(Op.PUT, b"b", b"2")], b"b", 30)
+    with pytest.raises(KeyIsLocked):
+        t.scan(b"a", b"z", read_ts=35)
+    # read below the lock ts is fine
+    assert [k for k, _ in t.scan(b"a", b"z", read_ts=25)] == [b"a"]
+
+
+def test_gc_drops_old_versions():
+    t = make_txn()
+    for ts in (10, 20, 30):
+        t.prewrite([Mutation(Op.PUT, b"k", b"v%d" % ts)], b"k", ts)
+        t.commit([b"k"], ts, ts + 1)
+    t.prewrite([Mutation(Op.PUT, b"dead", b"x")], b"dead", 40)
+    t.commit([b"dead"], 40, 41)
+    t.prewrite([Mutation(Op.DELETE, b"dead")], b"dead", 50)
+    t.commit([b"dead"], 50, 51)
+    removed = t.gc(safe_ts=60)
+    assert removed > 0
+    # newest version of k survives; old ones gone
+    assert t.get(b"k", 100) == b"v30"
+    assert t.get(b"k", 25) is None  # history below safe point dropped
+    # fully-deleted key wiped
+    assert t.get(b"dead", 100) is None
+
+
+def test_latches_serialize():
+    cm = ConcurrencyManager()
+    order = []
+    import threading
+
+    def worker(tag):
+        with cm.with_keys([b"x", b"y"]):
+            order.append(f"{tag}-in")
+            time.sleep(0.02)
+            order.append(f"{tag}-out")
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    # no interleaving inside the critical section
+    for i in range(0, 6, 2):
+        assert order[i].endswith("-in") and order[i + 1].endswith("-out")
+        assert order[i].split("-")[0] == order[i + 1].split("-")[0]
+
+
+def test_commit_bare_pessimistic_lock_rejected():
+    """Regression: a pessimistic lock with no prewrite has no data row —
+    committing it must not fabricate a phantom PUT."""
+    from dingo_tpu.engine.txn import LockTypeMismatch
+
+    t = make_txn()
+    t.prewrite([Mutation(Op.PUT, b"k", b"old")], b"k", 5)
+    t.commit([b"k"], 5, 6)
+    t.pessimistic_lock([b"k"], b"k", 10, 10)
+    with pytest.raises(LockTypeMismatch):
+        t.commit([b"k"], 10, 20)
+    # resolve_lock rolls the bare pessimistic lock back, old value survives
+    t.resolve_lock(10, 20)
+    assert t.get(b"k", 30) == b"old"
+
+
+def test_pessimistic_conflict_behind_rollback_record():
+    """Regression: a newest ROLLBACK record must not hide a real committed
+    write from the for_update_ts conflict check."""
+    t = make_txn()
+    t.prewrite([Mutation(Op.PUT, b"k", b"v")], b"k", 80)
+    t.commit([b"k"], 80, 90)
+    t.batch_rollback([b"k"], 100)  # rollback tombstone at ts 100
+    with pytest.raises(WriteConflict):
+        t.pessimistic_lock([b"k"], b"k", start_ts=55, for_update_ts=50)
+
+
+def test_concurrent_prewrite_same_key_excluded():
+    import threading
+
+    t = make_txn()
+    errors = []
+
+    def worker(ts):
+        try:
+            t.prewrite([Mutation(Op.PUT, b"k", b"v%d" % ts)], b"k", ts)
+        except KeyIsLocked as e:
+            errors.append(e)
+
+    ths = [threading.Thread(target=worker, args=(ts,)) for ts in (10, 11)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join()
+    assert len(errors) == 1  # exactly one lost the race
