@@ -1,0 +1,11 @@
+"""Coordinator: cluster control plane.
+
+Mirrors reference src/coordinator/ — CoordinatorControl (region CRUD, store
+registry, jobs), TsoControl (timestamp oracle), KvControl (etcd-like KV +
+lease + watch), AutoIncrementControl, balance schedulers.
+"""
+
+from dingo_tpu.coordinator.control import CoordinatorControl  # noqa: F401
+from dingo_tpu.coordinator.tso import TsoControl  # noqa: F401
+from dingo_tpu.coordinator.kv_control import KvControl  # noqa: F401
+from dingo_tpu.coordinator.auto_increment import AutoIncrementControl  # noqa: F401

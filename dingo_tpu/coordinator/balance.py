@@ -1,0 +1,109 @@
+"""Balance schedulers: leader-count and region-count balancing.
+
+Reference: src/coordinator/balance_leader.{h,cc} + balance_region.{h,cc}
+(~2.6K LoC) — periodic crontab schedulers that inspect the store/region maps
+and emit transfer-leader / change-peer jobs. Filters (balance_leader.h:98-
+123) skip unhealthy stores/regions; an inspection time window gates when
+balancing may run (config_helper.h:46-48).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from dingo_tpu.coordinator.control import CoordinatorControl, StoreState
+
+
+@dataclasses.dataclass
+class TransferLeaderOp:
+    region_id: int
+    from_store: str
+    to_store: str
+
+
+@dataclasses.dataclass
+class MoveRegionOp:
+    region_id: int
+    from_store: str
+    to_store: str
+
+
+class BalanceLeaderScheduler:
+    """Move leaders from the most-loaded store to the least-loaded one when
+    the imbalance exceeds the ratio gate (BalanceLeaderScheduler)."""
+
+    def __init__(self, control: CoordinatorControl, ratio_gate: float = 1.2):
+        self.control = control
+        self.ratio_gate = ratio_gate
+
+    def plan(self) -> List[TransferLeaderOp]:
+        stores = self.control.alive_stores()
+        if len(stores) < 2:
+            return []
+        by_leaders = sorted(stores, key=lambda s: len(s.leader_region_ids))
+        least, most = by_leaders[0], by_leaders[-1]
+        n_least = len(least.leader_region_ids)
+        n_most = len(most.leader_region_ids)
+        if n_most <= n_least + 1:
+            return []
+        if n_least > 0 and n_most / max(n_least, 1) < self.ratio_gate:
+            return []
+        ops = []
+        movable = [
+            rid for rid in most.leader_region_ids
+            # target must already host a replica to receive leadership
+            if least.store_id in
+            (self.control.regions.get(rid).peers
+             if self.control.regions.get(rid) else [])
+        ]
+        to_move = (n_most - n_least) // 2
+        for rid in movable[:to_move]:
+            ops.append(TransferLeaderOp(rid, most.store_id, least.store_id))
+        return ops
+
+    def dispatch(self) -> int:
+        ops = self.plan()
+        for op in ops:
+            self.control.transfer_leader(op.region_id, op.to_store)
+        return len(ops)
+
+
+class BalanceRegionScheduler:
+    """Move replicas from crowded stores to empty ones (BalanceRegion)."""
+
+    def __init__(self, control: CoordinatorControl, ratio_gate: float = 1.3):
+        self.control = control
+        self.ratio_gate = ratio_gate
+
+    def plan(self) -> List[MoveRegionOp]:
+        stores = self.control.alive_stores()
+        if len(stores) < 2:
+            return []
+        by_regions = sorted(stores, key=lambda s: len(s.region_ids))
+        least, most = by_regions[0], by_regions[-1]
+        n_least, n_most = len(least.region_ids), len(most.region_ids)
+        if n_most <= n_least + 1:
+            return []
+        if n_least > 0 and n_most / max(n_least, 1) < self.ratio_gate:
+            return []
+        ops = []
+        for rid in most.region_ids:
+            definition = self.control.regions.get(rid)
+            if definition is None or least.store_id in definition.peers:
+                continue
+            ops.append(MoveRegionOp(rid, most.store_id, least.store_id))
+            if len(ops) >= (n_most - n_least) // 2:
+                break
+        return ops
+
+    def dispatch(self) -> int:
+        ops = self.plan()
+        for op in ops:
+            definition = self.control.regions[op.region_id]
+            new_peers = [
+                op.to_store if p == op.from_store else p
+                for p in definition.peers
+            ]
+            self.control.change_peer(op.region_id, new_peers)
+        return len(ops)

@@ -1,0 +1,382 @@
+"""CoordinatorControl: the cluster brain.
+
+Reference: src/coordinator/coordinator_control.{h,cc} + _coor/_fsm/_meta/
+_watch.cc (~14K LoC) — id epochs, store/executor registry, region CRUD
+(CreateRegionFinal coordinator_control.h:263, SplitRegionWithJob :304,
+MergeRegionWithJob :309, ChangePeerRegionWithJob :313,
+TransferLeaderRegionWithJob :319), store-operation queues pushed to stores
+(RpcSendPushStoreOperation :547, AddRegionCmd :565), orphan recycling, and
+heartbeat-driven store state (UpdateStoreState crontab; CheckRegionAllPeerOnline
+:597-599).
+
+State mutations go through MetaIncrement records persisted to the meta CF
+(the reference replicates them via MetaStateMachine raft; the same
+CoordinatorControl can sit behind a RaftNode by routing _persist through
+propose — single-coordinator deployments write directly).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import pickle
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dingo_tpu.engine.raw_engine import CF_META, RawEngine
+from dingo_tpu.index.base import IndexParameter
+from dingo_tpu.store.region import (
+    RegionDefinition,
+    RegionEpoch,
+    RegionType,
+)
+
+_PREFIX_STORE = b"COOR_STORE_"
+_PREFIX_REGION = b"COOR_REGION_"
+_PREFIX_IDS = b"COOR_IDS_"
+
+
+class StoreState(enum.Enum):
+    """pb::common::StoreState."""
+
+    NORMAL = "normal"
+    OFFLINE = "offline"
+
+
+class RegionCmdType(enum.Enum):
+    """pb::coordinator::RegionCmdType subset (region_controller.h:40-314)."""
+
+    CREATE = "create"
+    DELETE = "delete"
+    SPLIT = "split"
+    MERGE = "merge"
+    CHANGE_PEER = "change_peer"
+    TRANSFER_LEADER = "transfer_leader"
+    SNAPSHOT = "snapshot"
+    PURGE = "purge"
+    STOP = "stop"
+    HOLD_VECTOR_INDEX = "hold_vector_index"
+    SNAPSHOT_VECTOR_INDEX = "snapshot_vector_index"
+
+
+@dataclasses.dataclass
+class RegionCmd:
+    cmd_id: int
+    region_id: int
+    cmd_type: RegionCmdType
+    definition: Optional[RegionDefinition] = None
+    split_key: bytes = b""
+    child_region_id: int = 0
+    target_store_id: str = ""
+    status: str = "pending"
+
+
+@dataclasses.dataclass
+class StoreInfo:
+    store_id: str
+    address: str = ""
+    state: StoreState = StoreState.NORMAL
+    last_heartbeat_ms: int = 0
+    region_ids: List[int] = dataclasses.field(default_factory=list)
+    leader_region_ids: List[int] = dataclasses.field(default_factory=list)
+    capacity_bytes: int = 0
+    used_bytes: int = 0
+
+
+class CoordinatorControl:
+    #: stores missing heartbeats longer than this go OFFLINE
+    #: (server.heartbeat_interval_s based; UpdateStoreState crontab)
+    OFFLINE_AFTER_MS = 30_000
+
+    def __init__(self, engine: RawEngine, replication: int = 3):
+        self.engine = engine
+        self.replication = replication
+        self._lock = threading.RLock()
+        self.stores: Dict[str, StoreInfo] = {}
+        self.regions: Dict[int, RegionDefinition] = {}
+        self.region_leaders: Dict[int, str] = {}
+        #: per-store command queues (store operations pushed/pulled)
+        self.store_ops: Dict[str, List[RegionCmd]] = {}
+        self.jobs: List[RegionCmd] = []
+        self._next_region_id = 1000
+        self._next_cmd_id = 1
+        self._recover()
+
+    # ---------------- persistence (MetaIncrement analog) -------------------
+    def _persist(self, key: bytes, value) -> None:
+        self.engine.put(CF_META, key, pickle.dumps(value, protocol=4))
+
+    def _recover(self) -> None:
+        for k, v in self.engine.scan(CF_META, _PREFIX_STORE,
+                                     _PREFIX_STORE + b"\xff"):
+            info: StoreInfo = pickle.loads(v)
+            self.stores[info.store_id] = info
+            self.store_ops.setdefault(info.store_id, [])
+        for k, v in self.engine.scan(CF_META, _PREFIX_REGION,
+                                     _PREFIX_REGION + b"\xff"):
+            definition: RegionDefinition = pickle.loads(v)
+            self.regions[definition.region_id] = definition
+        blob = self.engine.get(CF_META, _PREFIX_IDS)
+        if blob:
+            self._next_region_id, self._next_cmd_id = pickle.loads(blob)
+
+    def _persist_ids(self) -> None:
+        self._persist(_PREFIX_IDS, (self._next_region_id, self._next_cmd_id))
+
+    # ---------------- store registry ----------------------------------------
+    def register_store(self, store_id: str, address: str = "") -> None:
+        with self._lock:
+            info = self.stores.get(store_id) or StoreInfo(store_id, address)
+            info.address = address or info.address
+            info.state = StoreState.NORMAL
+            info.last_heartbeat_ms = int(time.time() * 1000)
+            self.stores[store_id] = info
+            self.store_ops.setdefault(store_id, [])
+            self._persist(_PREFIX_STORE + store_id.encode(), info)
+
+    def store_heartbeat(
+        self,
+        store_id: str,
+        region_ids: Sequence[int] = (),
+        leader_region_ids: Sequence[int] = (),
+        capacity_bytes: int = 0,
+        used_bytes: int = 0,
+        region_defs: Sequence[RegionDefinition] = (),
+    ) -> List[RegionCmd]:
+        """StoreHeartbeat: record metrics, reconcile region topology from the
+        store's reported definitions (splits survive leader crashes this
+        way — the immediate split-done report is only a latency optimization),
+        and return pending region commands (HandleStoreHeartbeatResponse
+        flow, store/heartbeat.cc:294)."""
+        with self._lock:
+            for rd in region_defs:
+                known = self.regions.get(rd.region_id)
+                if known is None or rd.epoch.as_tuple() > known.epoch.as_tuple():
+                    self.regions[rd.region_id] = rd
+                    self._persist(
+                        _PREFIX_REGION + str(rd.region_id).encode(), rd
+                    )
+            info = self.stores.get(store_id)
+            if info is None:
+                self.register_store(store_id)
+                info = self.stores[store_id]
+            info.last_heartbeat_ms = int(time.time() * 1000)
+            info.region_ids = list(region_ids)
+            info.leader_region_ids = list(leader_region_ids)
+            info.capacity_bytes = capacity_bytes
+            info.used_bytes = used_bytes
+            for rid in leader_region_ids:
+                self.region_leaders[rid] = store_id
+            self._persist(_PREFIX_STORE + store_id.encode(), info)
+            ops = self.store_ops.get(store_id, [])
+            pending = [c for c in ops if c.status == "pending"]
+            for c in pending:
+                c.status = "sent"
+            return pending
+
+    def update_store_states(self) -> List[str]:
+        """UpdateStoreState crontab: mark silent stores OFFLINE; returns the
+        newly-offline store ids (region health checks follow)."""
+        now = int(time.time() * 1000)
+        newly = []
+        with self._lock:
+            for info in self.stores.values():
+                if (
+                    info.state is StoreState.NORMAL
+                    and now - info.last_heartbeat_ms > self.OFFLINE_AFTER_MS
+                ):
+                    info.state = StoreState.OFFLINE
+                    newly.append(info.store_id)
+                    self._persist(_PREFIX_STORE + info.store_id.encode(), info)
+        return newly
+
+    def alive_stores(self) -> List[StoreInfo]:
+        with self._lock:
+            return [
+                s for s in self.stores.values()
+                if s.state is StoreState.NORMAL
+            ]
+
+    # ---------------- id allocation -----------------------------------------
+    def next_region_id(self) -> int:
+        with self._lock:
+            rid = self._next_region_id
+            self._next_region_id += 1
+            self._persist_ids()
+            return rid
+
+    def _next_cmd(self) -> int:
+        cid = self._next_cmd_id
+        self._next_cmd_id += 1
+        self._persist_ids()
+        return cid
+
+    # ---------------- region CRUD -------------------------------------------
+    def create_region(
+        self,
+        start_key: bytes,
+        end_key: bytes,
+        partition_id: int = 0,
+        region_type: RegionType = RegionType.STORE,
+        index_parameter: Optional[IndexParameter] = None,
+        replication: Optional[int] = None,
+    ) -> RegionDefinition:
+        """CreateRegionFinal (coordinator_control.h:263): allocate id, place
+        peers on the least-loaded alive stores, queue CREATE commands."""
+        with self._lock:
+            peers = self._place_peers(replication or self.replication)
+            if not peers:
+                raise RuntimeError("no alive stores to place region")
+            definition = RegionDefinition(
+                region_id=self.next_region_id(),
+                start_key=start_key,
+                end_key=end_key,
+                partition_id=partition_id,
+                peers=peers,
+                region_type=region_type,
+                index_parameter=index_parameter,
+            )
+            self.regions[definition.region_id] = definition
+            self._persist(
+                _PREFIX_REGION + str(definition.region_id).encode(), definition
+            )
+            for sid in peers:
+                self._queue_cmd(sid, RegionCmd(
+                    cmd_id=self._next_cmd(),
+                    region_id=definition.region_id,
+                    cmd_type=RegionCmdType.CREATE,
+                    definition=definition,
+                ))
+            return definition
+
+    def _place_peers(self, n: int) -> List[str]:
+        alive = sorted(
+            self.alive_stores(), key=lambda s: len(s.region_ids)
+        )
+        return [s.store_id for s in alive[:n]]
+
+    def _queue_cmd(self, store_id: str, cmd: RegionCmd) -> None:
+        self.store_ops.setdefault(store_id, []).append(cmd)
+        self.jobs.append(cmd)
+
+    def requeue_cmd(self, cmd: RegionCmd, store_id: str,
+                    from_store: Optional[str] = None) -> None:
+        """Re-dispatch a command to another store (e.g. the store executing
+        a SPLIT discovered it is not the raft leader and reports the hint).
+        The command MOVES queues — leaving it in the source would re-deliver
+        it on every heartbeat and eventually double-execute."""
+        with self._lock:
+            if from_store is not None:
+                src = self.store_ops.get(from_store, [])
+                if cmd in src:
+                    src.remove(cmd)
+            cmd.status = "pending"
+            q = self.store_ops.setdefault(store_id, [])
+            if cmd not in q:
+                q.append(cmd)
+
+    def drop_region(self, region_id: int) -> None:
+        with self._lock:
+            definition = self.regions.pop(region_id, None)
+            if definition is None:
+                return
+            self.engine.delete(CF_META, _PREFIX_REGION + str(region_id).encode())
+            for sid in definition.peers:
+                self._queue_cmd(sid, RegionCmd(
+                    cmd_id=self._next_cmd(), region_id=region_id,
+                    cmd_type=RegionCmdType.DELETE,
+                ))
+
+    # ---------------- split / merge / peers ---------------------------------
+    def split_region(self, region_id: int, split_key: bytes) -> int:
+        """SplitRegionWithJob (:304): allocate a child id and push SPLIT to
+        the leader store; the split itself replicates through region raft."""
+        with self._lock:
+            parent = self.regions.get(region_id)
+            if parent is None:
+                raise KeyError(f"region {region_id}")
+            if not (parent.start_key < split_key < parent.end_key):
+                raise ValueError("split key outside region range")
+            child_id = self.next_region_id()
+            leader = self.region_leaders.get(region_id, parent.peers[0])
+            self._queue_cmd(leader, RegionCmd(
+                cmd_id=self._next_cmd(), region_id=region_id,
+                cmd_type=RegionCmdType.SPLIT, split_key=split_key,
+                child_region_id=child_id,
+            ))
+            return child_id
+
+    def on_region_split_done(
+        self, parent_id: int, child: RegionDefinition
+    ) -> None:
+        """Store reports the applied split; update metadata + epochs."""
+        with self._lock:
+            parent = self.regions.get(parent_id)
+            if parent is not None:
+                parent.end_key = child.start_key
+                parent.epoch.version += 1
+                self._persist(_PREFIX_REGION + str(parent_id).encode(), parent)
+            self.regions[child.region_id] = child
+            self._persist(
+                _PREFIX_REGION + str(child.region_id).encode(), child
+            )
+
+    def transfer_leader(self, region_id: int, target_store: str) -> None:
+        with self._lock:
+            leader = self.region_leaders.get(region_id)
+            if leader is None:
+                raise KeyError(f"no leader known for region {region_id}")
+            self._queue_cmd(leader, RegionCmd(
+                cmd_id=self._next_cmd(), region_id=region_id,
+                cmd_type=RegionCmdType.TRANSFER_LEADER,
+                target_store_id=target_store,
+            ))
+
+    def change_peer(self, region_id: int, new_peers: List[str]) -> None:
+        """ChangePeerRegionWithJob (:313)."""
+        with self._lock:
+            definition = self.regions.get(region_id)
+            if definition is None:
+                raise KeyError(f"region {region_id}")
+            old = set(definition.peers)
+            new = set(new_peers)
+            definition.peers = list(new_peers)
+            definition.epoch.conf_version += 1
+            self._persist(_PREFIX_REGION + str(region_id).encode(), definition)
+            for sid in new - old:   # additions get CREATE
+                self._queue_cmd(sid, RegionCmd(
+                    cmd_id=self._next_cmd(), region_id=region_id,
+                    cmd_type=RegionCmdType.CREATE, definition=definition,
+                ))
+            for sid in old & new:   # survivors update raft membership
+                self._queue_cmd(sid, RegionCmd(
+                    cmd_id=self._next_cmd(), region_id=region_id,
+                    cmd_type=RegionCmdType.CHANGE_PEER, definition=definition,
+                ))
+            for sid in old - new:   # removals get DELETE
+                self._queue_cmd(sid, RegionCmd(
+                    cmd_id=self._next_cmd(), region_id=region_id,
+                    cmd_type=RegionCmdType.DELETE,
+                ))
+
+    # ---------------- failure handling --------------------------------------
+    def check_region_health(self) -> List[Tuple[int, List[str]]]:
+        """CheckRegionAllPeerOnline (:597-599): regions with offline peers,
+        with a proposed replacement peer set."""
+        out = []
+        with self._lock:
+            alive = {s.store_id for s in self.alive_stores()}
+            for rid, definition in self.regions.items():
+                dead = [p for p in definition.peers if p not in alive]
+                if not dead:
+                    continue
+                candidates = [
+                    s.store_id for s in sorted(
+                        self.alive_stores(), key=lambda s: len(s.region_ids)
+                    ) if s.store_id not in definition.peers
+                ]
+                replacement = [p for p in definition.peers if p in alive]
+                replacement += candidates[: len(dead)]
+                out.append((rid, replacement))
+        return out

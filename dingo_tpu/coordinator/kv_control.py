@@ -1,0 +1,217 @@
+"""KvControl: etcd-compatible revisioned KV with leases and one-time watches.
+
+Reference: src/coordinator/kv_control.{h,cc} + _fsm/_kv/_lease/_watch.cc
+(~6K LoC) — KvRange/KvPut/KvDeleteRange/KvCompaction (kv_control.h:252-291),
+revision model (main revision per raft term + sub revision), LeaseGrant/
+LeaseRevoke (:221-225) with TTL-attached keys, and one-time watches with a
+KvWatchNode closure queue (:47-113).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from dingo_tpu.engine.raw_engine import CF_META, RawEngine
+
+_PREFIX_KV = b"VKV_"
+_PREFIX_LEASE = b"VLEASE_"
+_KEY_REVISION = b"VKV_REVISION__"  # sorts inside no KV prefix scan range
+
+
+@dataclasses.dataclass
+class KvItem:
+    key: bytes
+    value: bytes
+    create_revision: int
+    mod_revision: int
+    version: int
+    lease_id: int = 0
+
+
+@dataclasses.dataclass
+class Lease:
+    lease_id: int
+    ttl_s: int
+    granted_ms: int
+    keys: List[bytes] = dataclasses.field(default_factory=list)
+
+    def expired(self, now_ms: Optional[int] = None) -> bool:
+        now_ms = now_ms or int(time.time() * 1000)
+        return now_ms > self.granted_ms + self.ttl_s * 1000
+
+
+class KvControl:
+    def __init__(self, engine: RawEngine):
+        self.engine = engine
+        self._lock = threading.RLock()
+        self._revision = 1
+        self._kv: Dict[bytes, KvItem] = {}
+        self._leases: Dict[int, Lease] = {}
+        self._next_lease = 1
+        #: one-time watches: key -> [(watch_revision, callback)]
+        self._watches: Dict[bytes, List[Tuple[int, Callable]]] = {}
+        self._recover()
+
+    # ---------------- persistence -------------------------------------------
+    def _recover(self) -> None:
+        blob = self.engine.get(CF_META, _KEY_REVISION)
+        if blob:
+            self._revision = pickle.loads(blob)
+        for k, v in self.engine.scan(CF_META, _PREFIX_KV, _PREFIX_KV + b"\xff"):
+            if k == _KEY_REVISION:
+                continue
+            item: KvItem = pickle.loads(v)
+            self._kv[item.key] = item
+            self._revision = max(self._revision, item.mod_revision)
+        for k, v in self.engine.scan(CF_META, _PREFIX_LEASE,
+                                     _PREFIX_LEASE + b"\xff"):
+            lease: Lease = pickle.loads(v)
+            self._leases[lease.lease_id] = lease
+            self._next_lease = max(self._next_lease, lease.lease_id + 1)
+
+    def _bump_revision(self) -> int:
+        """Monotonic across restarts: deletes advance it too, so issued
+        revisions are never reused (etcd contract)."""
+        self._revision += 1
+        self.engine.put(CF_META, _KEY_REVISION, pickle.dumps(self._revision))
+        return self._revision
+
+    def _persist_kv(self, item: KvItem) -> None:
+        self.engine.put(CF_META, _PREFIX_KV + item.key, pickle.dumps(item))
+
+    def _persist_lease(self, lease: Lease) -> None:
+        self.engine.put(
+            CF_META, _PREFIX_LEASE + str(lease.lease_id).encode(),
+            pickle.dumps(lease),
+        )
+
+    # ---------------- KV ------------------------------------------------------
+    def kv_put(self, key: bytes, value: bytes, lease_id: int = 0) -> int:
+        """Returns the new revision (KvPut, kv_control.h:263)."""
+        with self._lock:
+            if lease_id:
+                lease = self._leases.get(lease_id)
+                if lease is None or lease.expired():
+                    raise KeyError(f"lease {lease_id} not found/expired")
+                if key not in lease.keys:
+                    lease.keys.append(key)
+                    self._persist_lease(lease)
+            self._bump_revision()
+            old = self._kv.get(key)
+            item = KvItem(
+                key=key,
+                value=value,
+                create_revision=old.create_revision if old else self._revision,
+                mod_revision=self._revision,
+                version=(old.version + 1) if old else 1,
+                lease_id=lease_id,
+            )
+            self._kv[key] = item
+            self._persist_kv(item)
+            self._fire_watches(key, "put", item)
+            return self._revision
+
+    def kv_range(self, start: bytes, end: Optional[bytes] = None,
+                 limit: int = 0) -> Tuple[List[KvItem], int]:
+        """KvRange: [start, end) or exact key when end is None."""
+        with self._lock:
+            self._expire_leases()
+            if end is None:
+                item = self._kv.get(start)
+                return ([item] if item else [], self._revision)
+            out = [
+                item for k, item in sorted(self._kv.items())
+                if start <= k < end
+            ]
+            if limit:
+                out = out[:limit]
+            return out, self._revision
+
+    def kv_delete_range(self, start: bytes, end: Optional[bytes] = None) -> int:
+        """Returns number deleted."""
+        with self._lock:
+            doomed = (
+                [start] if end is None
+                else [k for k in list(self._kv) if start <= k < end]
+            )
+            n = 0
+            for k in doomed:
+                item = self._kv.pop(k, None)
+                if item is None:
+                    continue
+                self._bump_revision()
+                n += 1
+                self.engine.delete(CF_META, _PREFIX_KV + k)
+                self._fire_watches(k, "delete", item)
+            return n
+
+    def kv_compaction(self, revision: int) -> int:
+        """KvCompaction (kv_control.h:291): our store keeps only the latest
+        version per key, so compaction just reports the floor."""
+        with self._lock:
+            return self._revision
+
+    # ---------------- leases --------------------------------------------------
+    def lease_grant(self, ttl_s: int, lease_id: int = 0) -> Lease:
+        with self._lock:
+            lid = lease_id or self._next_lease
+            self._next_lease = max(self._next_lease, lid + 1)
+            lease = Lease(lease_id=lid, ttl_s=ttl_s,
+                          granted_ms=int(time.time() * 1000))
+            self._leases[lid] = lease
+            self._persist_lease(lease)
+            return lease
+
+    def lease_renew(self, lease_id: int) -> Lease:
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None or lease.expired():
+                raise KeyError(f"lease {lease_id} not found/expired")
+            lease.granted_ms = int(time.time() * 1000)
+            self._persist_lease(lease)
+            return lease
+
+    def lease_revoke(self, lease_id: int) -> int:
+        """Revoke + delete attached keys; returns deleted count."""
+        with self._lock:
+            lease = self._leases.pop(lease_id, None)
+            if lease is None:
+                return 0
+            self.engine.delete(CF_META, _PREFIX_LEASE + str(lease_id).encode())
+            n = 0
+            for key in lease.keys:
+                n += self.kv_delete_range(key)
+            return n
+
+    def _expire_leases(self) -> None:
+        for lid, lease in list(self._leases.items()):
+            if lease.expired():
+                self.lease_revoke(lid)
+
+    def lease_gc(self) -> None:
+        """Crontab entry point (lease expiry sweep)."""
+        with self._lock:
+            self._expire_leases()
+
+    # ---------------- watches -------------------------------------------------
+    def watch(self, key: bytes, start_revision: int,
+              callback: Callable[[str, KvItem], None]) -> None:
+        """One-time watch (kv_control.h:47-113): callback fires once on the
+        next event for `key` at/after start_revision, then unregisters."""
+        with self._lock:
+            item = self._kv.get(key)
+            if item is not None and item.mod_revision >= start_revision:
+                callback("put", item)   # immediate catch-up fire
+                return
+            self._watches.setdefault(key, []).append((start_revision, callback))
+
+    def _fire_watches(self, key: bytes, event: str, item: KvItem) -> None:
+        for rev, cb in self._watches.pop(key, []):
+            try:
+                cb(event, item)
+            except Exception:
+                pass

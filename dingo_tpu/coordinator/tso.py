@@ -1,0 +1,61 @@
+"""TsoControl: the cluster timestamp oracle.
+
+Reference: src/coordinator/tso_control.{h,cc} — TsoTimestamp is physical
+milliseconds + an 18-bit logical counter (tso_control.h:92,173-175),
+raft-replicated; it leases BatchTs blocks to stores' TsProviders. The safety
+invariant: after failover the new oracle must never re-issue timestamps, so
+the high-water physical mark persists ahead of issuance (save_interval
+semantics).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Tuple
+
+from dingo_tpu.engine.raw_engine import CF_META, RawEngine
+from dingo_tpu.mvcc.ts_provider import TSO_LOGICAL_BITS, compose_ts
+
+_KEY = b"TSO_HIGH_WATER"
+#: persist the physical watermark this far ahead (ms)
+SAVE_AHEAD_MS = 3000
+
+
+class TsoControl:
+    def __init__(self, engine: RawEngine):
+        self.engine = engine
+        self._lock = threading.Lock()
+        blob = engine.get(CF_META, _KEY)
+        persisted = pickle.loads(blob) if blob else 0
+        # never go below the persisted watermark (failover safety)
+        self._physical = max(persisted, int(time.time() * 1000))
+        self._logical = 0
+        self._persisted_until = persisted
+        self._save_ahead()
+
+    def _save_ahead(self) -> None:
+        target = self._physical + SAVE_AHEAD_MS
+        if target > self._persisted_until:
+            self.engine.put(CF_META, _KEY, pickle.dumps(target))
+            self._persisted_until = target
+
+    def gen_ts(self, count: int = 1) -> Tuple[int, int]:
+        """GenerateTso: a contiguous block [first, first+count)."""
+        with self._lock:
+            now = int(time.time() * 1000)
+            if now > self._physical:
+                self._physical = now
+                self._logical = 0
+            first = compose_ts(self._physical, self._logical)
+            self._logical += count
+            while self._logical >= (1 << TSO_LOGICAL_BITS):
+                self._physical += 1
+                self._logical -= 1 << TSO_LOGICAL_BITS
+            self._save_ahead()
+            return first, count
+
+    def current(self) -> int:
+        with self._lock:
+            return compose_ts(self._physical, self._logical)

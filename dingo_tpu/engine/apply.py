@@ -32,9 +32,22 @@ from dingo_tpu.store.region import Region
 
 
 def apply_write(
-    engine: RawEngine, region: Region, data: wd.WriteData, log_id: int = 0
+    engine: RawEngine, region: Region, data: wd.WriteData, log_id: int = 0,
+    context=None,
 ) -> None:
-    """Dispatch one committed payload (RaftApplyHandlerFactory equivalent)."""
+    """Dispatch one committed payload (RaftApplyHandlerFactory equivalent).
+
+    `context` (optional) is the hosting StoreNode for handlers that touch
+    region topology (SplitHandler needs to create the child region and its
+    raft member on EVERY replica applying the entry)."""
+    if isinstance(data, wd.SplitRegionData):
+        if context is None:
+            raise NotImplementedError(
+                "region split needs a StoreNode context (mono engines do "
+                "not host split topology)"
+            )
+        context.handle_split(region, data, log_id)
+        return
     if isinstance(data, wd.KvPutData):
         _apply_kv_put(engine, data)
     elif isinstance(data, wd.KvDeleteData):

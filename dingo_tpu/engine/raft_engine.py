@@ -15,8 +15,10 @@ import threading
 from typing import Dict, Optional
 
 from dingo_tpu.engine.apply import apply_write
-from dingo_tpu.engine.raw_engine import RawEngine
+from dingo_tpu.engine.raw_engine import ALL_CFS, CF_META, RawEngine, WriteBatch
 from dingo_tpu.engine.write_data import WriteData
+from dingo_tpu.index import codec as vcodec
+from dingo_tpu.mvcc.codec import Codec
 from dingo_tpu.index.vector_reader import ReaderContext, VectorReader
 from dingo_tpu.mvcc.codec import MAX_TS
 from dingo_tpu.raft.core import RaftNode
@@ -24,14 +26,50 @@ from dingo_tpu.raft.transport import Transport
 from dingo_tpu.store.region import Region
 
 
+def _region_bounds(region: Region):
+    """Encoded key range of a region in the mvcc-encoded CFs."""
+    start = Codec.encode_bytes(region.definition.start_key)
+    end = Codec.encode_bytes(region.definition.end_key)
+    return start, end
+
+
+def region_snapshot(raw: RawEngine, region: Region) -> dict:
+    """{cf: [(k, v)]} for this region's range only (meta CF excluded —
+    store-local, never replicated)."""
+    start, end = _region_bounds(region)
+    out = {}
+    for cf in ALL_CFS:
+        if cf == CF_META:
+            continue
+        pairs = raw.scan(cf, start, end)
+        if pairs:
+            out[cf] = pairs
+    return out
+
+
+def region_install(raw: RawEngine, region: Region, state: dict) -> None:
+    start, end = _region_bounds(region)
+    batch = WriteBatch()
+    for cf in ALL_CFS:
+        if cf == CF_META:
+            continue
+        batch.delete_range(cf, start, end)
+    for cf, pairs in state.items():
+        for k, v in pairs:
+            batch.put(cf, k, v)
+    raw.write(batch)
+
+
 class RaftStoreEngine:
     """Holds this store's raw engine + the raft node per hosted region."""
 
     def __init__(self, raw_engine: RawEngine, store_id: str,
-                 transport: Transport):
+                 transport: Transport, context=None):
         self.raw = raw_engine
         self.store_id = store_id
         self.transport = transport
+        #: hosting StoreNode (split handler + topology callbacks)
+        self.context = context
         self._lock = threading.Lock()
         self._nodes: Dict[int, RaftNode] = {}   # RaftNodeManager
         self._regions: Dict[int, Region] = {}
@@ -48,18 +86,20 @@ class RaftStoreEngine:
 
         def apply_fn(index: int, payload: bytes) -> None:
             data = pickle.loads(payload)
-            apply_write(self.raw, region, data, index)
+            apply_write(self.raw, region, data, index, context=self.context)
 
         def snapshot_save() -> bytes:
-            # Region-scoped checkpoint: the reference streams RocksDB SSTs
-            # (DingoFileSystemAdaptor); here the engine state snapshot is the
-            # blob. Engine-wide for now (single-region-per-engine tests).
-            state = self.raw.snapshot_state()
-            return pickle.dumps(state, protocol=4)
+            # REGION-scoped checkpoint (the reference streams per-region
+            # RocksDB SSTs through DingoFileSystemAdaptor): only this
+            # region's key range, across all CFs — a store hosts many
+            # regions on one raw engine and must not ship the others.
+            return pickle.dumps(
+                region_snapshot(self.raw, region), protocol=4
+            )
 
         def snapshot_install(blob: bytes) -> None:
-            self.raw.load_state(pickle.loads(blob))
-            # in-memory index must be rebuilt after a full state install
+            region_install(self.raw, region, pickle.loads(blob))
+            # in-memory index must be rebuilt after a state install
             wrapper = region.vector_index_wrapper
             if wrapper is not None:
                 wrapper.ready = False

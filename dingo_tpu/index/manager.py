@@ -1,0 +1,222 @@
+"""VectorIndexManager: background build / rebuild / save / catch-up.
+
+Reference: src/vector/vector_index_manager.{h,cc} (1,762 LoC) — task types
+RebuildVectorIndexTask / SaveVectorIndexTask / LoadOrBuildVectorIndexTask
+(vector_index_manager.h:35-131); BuildVectorIndex full scan build (:864)
+with TrainForBuild (:1365); ReplayWalToVectorIndex raft-log catch-up (:763-
+861); CatchUpLogToVectorIndex multi-round catch-up then atomic switch
+(:1149); SaveVectorIndex (:1245); ScrubVectorIndex periodic check (:175).
+
+Lifecycle (§3.4): a rebuild scans the engine's data CF into a FRESH index,
+then replays raft-log entries that committed during the scan (possibly
+several rounds), and finally swaps the wrapper's own_index under the
+switching flag. The index is always reconstructible because the engine is
+the source of truth and every index tracks apply_log_id.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Optional
+
+import numpy as np
+
+from dingo_tpu.engine import write_data as wd
+from dingo_tpu.engine.raw_engine import RawEngine
+from dingo_tpu.index.base import IndexParameter, VectorIndex
+from dingo_tpu.index.factory import new_index
+from dingo_tpu.index.vector_reader import ReaderContext, VectorReader
+from dingo_tpu.index.wrapper import VectorIndexWrapper
+from dingo_tpu.raft.log import RaftLog
+from dingo_tpu.store.region import Region
+
+#: kBuildVectorIndexBatchSize analog (reference scans in fixed batches)
+BUILD_BATCH = 4096
+#: max catch-up rounds before the final locked round (reference loops until
+#: the lag is small, then swaps under SetIsSwitchingVectorIndex)
+MAX_CATCHUP_ROUNDS = 8
+
+
+class VectorIndexManager:
+    def __init__(self, engine: RawEngine, snapshot_root: Optional[str] = None):
+        self.engine = engine
+        self.snapshot_root = snapshot_root
+        self._lock = threading.Lock()
+        self.rebuild_running = 0     # bvar task counters (manager.h:177-208)
+        self.rebuild_total = 0
+        self.save_total = 0
+
+    # ---------------- build ----------------
+    def build_index(self, region: Region,
+                    raft_log: Optional[RaftLog] = None) -> VectorIndex:
+        """BuildVectorIndex (vector_index_manager.cc:864): full scan of the
+        region data CF -> fresh index (+train for IVF types)."""
+        wrapper = region.vector_index_wrapper
+        assert wrapper is not None
+        param = region.definition.index_parameter
+        index = new_index(region.id, param)
+        reader = self._reader(region)
+
+        ids_batch, vec_batch = [], []
+        train_sample = []
+
+        def flush():
+            if ids_batch:
+                index.upsert(
+                    np.asarray(ids_batch, np.int64), np.stack(vec_batch)
+                )
+                ids_batch.clear()
+                vec_batch.clear()
+
+        rows = reader.vector_scan_query(0, limit=1 << 62, with_vector_data=True)
+        if index.need_train():
+            # TrainForBuild (:1365): train on the scanned sample first
+            sample = [r.vector for r in rows]
+            if sample:
+                try:
+                    index.train(np.stack(sample))
+                except Exception:
+                    pass  # too little data: stays untrained (hybrid/fallback)
+        for r in rows:
+            ids_batch.append(r.id)
+            vec_batch.append(r.vector)
+            if len(ids_batch) >= BUILD_BATCH:
+                flush()
+        flush()
+        return index
+
+    # ---------------- catch-up + switch ----------------
+    def rebuild(self, region: Region, raft_log: Optional[RaftLog] = None) -> None:
+        """LaunchRebuildVectorIndex -> RebuildVectorIndex (:1062):
+        build + multi-round WAL catch-up + atomic switch (:1149)."""
+        wrapper = region.vector_index_wrapper
+        assert wrapper is not None
+        with self._lock:
+            self.rebuild_running += 1
+            self.rebuild_total += 1
+        try:
+            if raft_log is None:
+                # No WAL to replay: hold the wrapper lock across scan+swap so
+                # no write lands between the scan and the switch (otherwise
+                # the fresh index would silently miss it forever).
+                with wrapper._lock:
+                    start_log_id = wrapper.apply_log_id
+                    index = self.build_index(region, raft_log)
+                    index.apply_log_id = wrapper.apply_log_id
+                    wrapper.own_index = index
+                    wrapper.ready = True
+                    wrapper.build_error = False
+                    wrapper.share_index = None
+                return
+            start_log_id = wrapper.apply_log_id
+            index = self.build_index(region, raft_log)
+            index.apply_log_id = start_log_id
+            if raft_log is not None:
+                # non-final rounds: replay without blocking writes
+                for _ in range(MAX_CATCHUP_ROUNDS):
+                    target = wrapper.apply_log_id
+                    if index.apply_log_id >= target:
+                        break
+                    self.replay_wal(index, region, raft_log,
+                                    index.apply_log_id + 1, target)
+                # final round under the switching flag (writes serialized by
+                # the wrapper lock during swap)
+                with wrapper._lock:
+                    wrapper.is_switching = True
+                    try:
+                        self.replay_wal(index, region, raft_log,
+                                        index.apply_log_id + 1,
+                                        wrapper.apply_log_id)
+                        wrapper.own_index = index
+                        wrapper.ready = True
+                        wrapper.build_error = False
+                        wrapper.share_index = None
+                    finally:
+                        wrapper.is_switching = False
+        except Exception:
+            wrapper.build_error = True
+            raise
+        finally:
+            with self._lock:
+                self.rebuild_running -= 1
+
+    def replay_wal(self, index: VectorIndex, region: Region,
+                   raft_log: RaftLog, start: int, end: int) -> int:
+        """ReplayWalToVectorIndex (:763-861): read committed data entries
+        from the raft log and re-apply VECTOR_ADD/VECTOR_DELETE."""
+        if end < start:
+            return 0
+        n = 0
+        for log_id, _term, payload in raft_log.get_data_entries(start, end):
+            data = pickle.loads(payload)
+            if isinstance(data, wd.VectorAddData):
+                index.upsert(data.ids, data.vectors)
+            elif isinstance(data, wd.VectorDeleteData):
+                index.delete(data.ids)
+            index.apply_log_id = log_id
+            n += 1
+        return n
+
+    # ---------------- save / load (snapshots) ----------------
+    def snapshot_path(self, region_id: int) -> str:
+        assert self.snapshot_root, "manager has no snapshot_root"
+        return os.path.join(self.snapshot_root, f"index_{region_id}")
+
+    def save_index(self, region: Region) -> str:
+        """SaveVectorIndex (:1245): serialize the index + snapshot_log_id."""
+        wrapper = region.vector_index_wrapper
+        assert wrapper is not None and wrapper.own_index is not None
+        path = self.snapshot_path(region.id)
+        with wrapper._lock:
+            wrapper.own_index.save(path)
+            wrapper.snapshot_log_id = wrapper.apply_log_id
+            wrapper.write_count = 0
+        with self._lock:
+            self.save_total += 1
+        return path
+
+    def load_index(self, region: Region,
+                   raft_log: Optional[RaftLog] = None) -> bool:
+        """LoadOrBuild: try snapshot + WAL replay; False -> caller rebuilds."""
+        wrapper = region.vector_index_wrapper
+        assert wrapper is not None
+        path = self.snapshot_path(region.id)
+        if not os.path.isdir(path):
+            return False
+        index = new_index(region.id, region.definition.index_parameter)
+        try:
+            index.load(path)
+        except Exception:
+            return False
+        if raft_log is not None and wrapper.apply_log_id > index.apply_log_id:
+            self.replay_wal(index, region, raft_log,
+                            index.apply_log_id + 1, wrapper.apply_log_id)
+        wrapper.set_own(index)
+        return True
+
+    # ---------------- scrub ----------------
+    def scrub(self, region: Region) -> dict:
+        """ScrubVectorIndex (manager.h:175): periodic health check deciding
+        rebuild/save needs (driven by the crontab layer)."""
+        wrapper = region.vector_index_wrapper
+        if wrapper is None:
+            return {}
+        actions = {
+            "need_rebuild": wrapper.need_to_rebuild(),
+            "need_save": wrapper.need_to_save(),
+        }
+        return actions
+
+    # ---------------- helpers ----------------
+    def _reader(self, region: Region) -> VectorReader:
+        return VectorReader(ReaderContext(
+            region_id=region.id,
+            partition_id=region.definition.partition_id,
+            start_key=region.definition.start_key,
+            end_key=region.definition.end_key,
+            index_wrapper=None,          # scan must not consult the index
+            engine=self.engine,
+            parameter=region.definition.index_parameter,
+        ))

@@ -99,7 +99,12 @@ class VectorIndexWrapper:
         (VectorAddHandler guard: 'if log_id > ApplyLogId',
         raft_apply_handler.cc:1115)."""
         with self._lock:
-            idx = self.own_index
+            idx = self.own_index if self.ready else None
+            if idx is None:
+                # split child before rebuild: writes land in the SHARED
+                # parent index (same physical keyspace; both sides filter
+                # searches by their own id range) — SetShareVectorIndex flow
+                idx = self.active()
             if idx is None or self.stopped:
                 return
             if log_id != 0 and log_id <= self.apply_log_id:
@@ -110,12 +115,15 @@ class VectorIndexWrapper:
                 idx.add(ids, vectors)
             if log_id:
                 self.apply_log_id = log_id
-                idx.apply_log_id = log_id
+                if idx is self.own_index:
+                    idx.apply_log_id = log_id
             self.write_count += len(ids)
 
     def delete(self, ids: np.ndarray, log_id: int) -> None:
         with self._lock:
-            idx = self.own_index
+            idx = self.own_index if self.ready else None
+            if idx is None:
+                idx = self.active()
             if idx is None or self.stopped:
                 return
             if log_id != 0 and log_id <= self.apply_log_id:
@@ -123,7 +131,8 @@ class VectorIndexWrapper:
             idx.delete(ids)
             if log_id:
                 self.apply_log_id = log_id
-                idx.apply_log_id = log_id
+                if idx is self.own_index:
+                    idx.apply_log_id = log_id
             self.write_count += len(ids)
 
     # -- reads ---------------------------------------------------------------

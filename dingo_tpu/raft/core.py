@@ -101,6 +101,34 @@ class RaftNode:
         with self._lock:
             return self.role == LEADER
 
+    # ------------- public: membership -------------
+    def update_peers(self, peer_ids) -> None:
+        """Single-step membership change (braft ChangePeers analog; the
+        coordinator changes one server at a time, which keeps single-step
+        reconfiguration safe). New peers start from next_index=1 and catch
+        up via normal replication / snapshot install."""
+        with self._lock:
+            new_peers = [p for p in peer_ids if p != self.id]
+            for p in new_peers:
+                if p not in self.next_index:
+                    self.next_index[p] = self.log.last_index() + 1
+                    self.match_index[p] = 0
+            for p in list(self.next_index):
+                if p not in new_peers and p != self.id:
+                    self.next_index.pop(p, None)
+                    self.match_index.pop(p, None)
+            self.peers = new_peers
+
+    # ------------- public: leadership transfer -------------
+    def transfer_leadership(self, target: str) -> bool:
+        """Ask `target` to campaign now; we step down on its higher term
+        (RaftNode transfer-leader, raft_node.h)."""
+        with self._lock:
+            if self.role != LEADER or target not in self.peers:
+                return False
+        resp = self.transport.send(target, "timeout_now", {"from": self.id})
+        return resp is not None and resp.get("ok", False)
+
     # ------------- public: propose (RaftNode::Commit) -------------
     def propose(self, payload: bytes, timeout: float = 5.0) -> int:
         """Append to the replicated log; blocks until applied locally.
@@ -303,6 +331,11 @@ class RaftNode:
     def _handle_rpc(self, method: str, msg: dict) -> dict:
         if method == "request_vote":
             return self._on_request_vote(msg)
+        if method == "timeout_now":
+            # leadership transfer: start an election immediately (braft
+            # TransferLeadership analog)
+            threading.Thread(target=self._start_election, daemon=True).start()
+            return {"term": self.current_term, "ok": True}
         if method == "append_entries":
             return self._on_append_entries(msg)
         if method == "install_snapshot":

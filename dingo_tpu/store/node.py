@@ -1,0 +1,260 @@
+"""StoreNode: one store process — engine + regions + controller + heartbeat.
+
+Ties together what reference main.cc wires at startup (§3.3): raw engine,
+raft store engine, store meta manager, vector index manager, storage facade,
+region controller, heartbeat. Also hosts the SplitHandler context: a raft-
+committed split creates the child region on every replica and shares the
+parent's vector index until the child's own rebuild completes
+(raft_apply_handler.cc:702, SetShareVectorIndex :372,630).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dingo_tpu.coordinator.control import (
+    CoordinatorControl,
+    RegionCmd,
+    RegionCmdType,
+)
+from dingo_tpu.engine import write_data as wd
+from dingo_tpu.engine.raft_engine import RaftStoreEngine
+from dingo_tpu.engine.raw_engine import MemEngine, RawEngine
+from dingo_tpu.engine.storage import Storage
+from dingo_tpu.index.manager import VectorIndexManager
+from dingo_tpu.store.region import (
+    Region,
+    RegionDefinition,
+    RegionState,
+    RegionType,
+    StoreMetaManager,
+)
+
+
+class StoreNode:
+    def __init__(
+        self,
+        store_id: str,
+        transport,
+        coordinator: Optional[CoordinatorControl] = None,
+        raw_engine: Optional[RawEngine] = None,
+        snapshot_root: Optional[str] = None,
+        raft_kw: Optional[dict] = None,
+    ):
+        self.store_id = store_id
+        self.coordinator = coordinator
+        self.raw = raw_engine or MemEngine()
+        self.engine = RaftStoreEngine(self.raw, store_id, transport,
+                                      context=self)
+        self.meta = StoreMetaManager(self.raw)
+        self.index_manager = VectorIndexManager(self.raw, snapshot_root)
+        self.storage = Storage(self.engine)
+        self.raft_kw = raft_kw or {}
+        self._lock = threading.RLock()
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        if coordinator is not None:
+            coordinator.register_store(store_id)
+
+    # ---------------- region lifecycle (RegionController tasks) -------------
+    def create_region(self, definition: RegionDefinition) -> Region:
+        """CreateRegionTask: materialize a region + its raft member."""
+        with self._lock:
+            existing = self.meta.get_region(definition.region_id)
+            if existing is not None:
+                return existing
+            region = Region(copy.deepcopy(definition))
+            wrapper = region.vector_index_wrapper
+            if wrapper is not None:
+                wrapper.build_own()
+                wrapper.set_own(wrapper.own_index)
+            self.meta.add_region(region)
+            self.engine.add_node(region, definition.peers, **self.raft_kw)
+            region.set_state(RegionState.NORMAL, "created")
+            return region
+
+    def delete_region(self, region_id: int) -> None:
+        """DeleteRegionTask + purge."""
+        with self._lock:
+            region = self.meta.get_region(region_id)
+            self.engine.stop_node(region_id)
+            if region is not None:
+                region.set_state(RegionState.DELETING, "coordinator cmd")
+                if region.vector_index_wrapper:
+                    region.vector_index_wrapper.stop()
+            self.meta.delete_region(region_id)
+
+    def get_region(self, region_id: int) -> Optional[Region]:
+        return self.meta.get_region(region_id)
+
+    # ---------------- split (raft-replicated) -------------------------------
+    def propose_split(self, region_id: int, split_key: bytes,
+                      child_region_id: int) -> None:
+        """SplitRegionTask: leader proposes; SplitHandler applies on every
+        replica via handle_split below."""
+        region = self.meta.get_region(region_id)
+        if region is None:
+            raise KeyError(f"region {region_id} not hosted")
+        self.engine.write(region, wd.SplitRegionData(
+            child_region_id=child_region_id, split_key=split_key,
+        ))
+
+    def handle_split(self, parent: Region, data: wd.SplitRegionData,
+                     log_id: int) -> None:
+        """SplitHandler::Handle (raft_apply_handler.cc:702), applied on every
+        replica: shrink parent, create child with the SAME peers, share the
+        parent's vector index with the child until its own build finishes."""
+        with self._lock:
+            if self.meta.get_region(data.child_region_id) is not None:
+                return  # replayed entry
+            child_def = RegionDefinition(
+                region_id=data.child_region_id,
+                start_key=data.split_key,
+                end_key=parent.definition.end_key,
+                partition_id=parent.definition.partition_id,
+                peers=list(parent.definition.peers),
+                region_type=parent.definition.region_type,
+                index_parameter=parent.definition.index_parameter,
+            )
+            child_def.epoch.version = parent.definition.epoch.version + 1
+            parent.definition.end_key = data.split_key
+            parent.definition.epoch.version += 1
+            self.meta.update_region(parent)
+
+            child = Region(child_def)
+            if child.vector_index_wrapper is not None and \
+                    parent.vector_index_wrapper is not None:
+                # child serves from the parent's index (filtered by its own
+                # range) until rebuilt — SetShareVectorIndex semantics
+                child.vector_index_wrapper.set_share(
+                    parent.vector_index_wrapper
+                )
+            self.meta.add_region(child)
+            self.engine.add_node(child, child_def.peers, **self.raft_kw)
+            child.set_state(RegionState.NORMAL, f"split from {parent.id}")
+        # leader reports the new topology to the coordinator
+        node = self.engine.get_node(parent.id)
+        if self.coordinator is not None and node is not None and node.is_leader():
+            self.coordinator.on_region_split_done(parent.id, child_def)
+
+    def finish_child_index(self, child_region_id: int) -> None:
+        """Post-split rebuild: give the child its own index and drop the
+        share (reference: child rebuild task then UpdateVectorIndex)."""
+        child = self.meta.get_region(child_region_id)
+        if child is None or child.vector_index_wrapper is None:
+            return
+        self.index_manager.rebuild(child)  # clears the share on swap
+
+    # ---------------- heartbeat --------------------------------------------
+    def heartbeat_once(self) -> List[RegionCmd]:
+        """StoreHeartbeat (store/heartbeat.cc:61): send region metrics, then
+        execute the returned region commands."""
+        if self.coordinator is None:
+            return []
+        regions = self.meta.get_all_regions()
+        leader_ids = [
+            r.id for r in regions
+            if (n := self.engine.get_node(r.id)) is not None and n.is_leader()
+        ]
+        cmds = self.coordinator.store_heartbeat(
+            self.store_id,
+            region_ids=[r.id for r in regions],
+            leader_region_ids=leader_ids,
+            region_defs=[r.definition for r in regions
+                         if r.id in leader_ids],
+        )
+        from dingo_tpu.raft.core import NotLeader
+
+        for cmd in cmds:
+            try:
+                self.execute_region_cmd(cmd)
+                cmd.status = "done"
+            except NotLeader as e:
+                # leadership moved: hand the command to the hinted leader
+                # ("<store>/r<region>" address) or back to the queue
+                if e.leader_hint:
+                    hinted_store = e.leader_hint.split("/")[0]
+                    self.coordinator.requeue_cmd(
+                        cmd, hinted_store, from_store=self.store_id
+                    )
+                else:
+                    cmd.status = "pending"
+            except Exception as e:  # noqa: BLE001
+                cmd.status = f"error: {e}"
+        return cmds
+
+    def start_heartbeat(self, interval_s: float = 1.0) -> None:
+        def loop():
+            while not self._hb_stop.wait(interval_s):
+                try:
+                    self.heartbeat_once()
+                except Exception:
+                    pass
+
+        self._hb_thread = threading.Thread(target=loop, daemon=True)
+        self._hb_thread.start()
+
+    # ---------------- region command execution ------------------------------
+    def execute_region_cmd(self, cmd: RegionCmd) -> None:
+        """RegionController::DispatchRegionControlCommand
+        (region_controller.h:406) — tasks :40-314."""
+        t = cmd.cmd_type
+        if t is RegionCmdType.CREATE:
+            assert cmd.definition is not None
+            self.create_region(cmd.definition)
+        elif t is RegionCmdType.DELETE:
+            self.delete_region(cmd.region_id)
+        elif t is RegionCmdType.SPLIT:
+            self.propose_split(cmd.region_id, cmd.split_key,
+                               cmd.child_region_id)
+        elif t is RegionCmdType.CHANGE_PEER:
+            # ChangePeerRegionTask: refresh the raft member list so the
+            # leader replicates to added peers and drops removed ones
+            assert cmd.definition is not None
+            region = self.meta.get_region(cmd.region_id)
+            node = self.engine.get_node(cmd.region_id)
+            if region is not None:
+                region.definition.peers = list(cmd.definition.peers)
+                region.definition.epoch.conf_version = \
+                    cmd.definition.epoch.conf_version
+                self.meta.update_region(region)
+            if node is not None:
+                node.update_peers([
+                    f"{sid}/r{cmd.region_id}" for sid in cmd.definition.peers
+                ])
+        elif t is RegionCmdType.TRANSFER_LEADER:
+            node = self.engine.get_node(cmd.region_id)
+            if node is not None:
+                node.transfer_leadership(
+                    f"{cmd.target_store_id}/r{cmd.region_id}"
+                )
+        elif t is RegionCmdType.SNAPSHOT:
+            self.raw.checkpoint(f"/tmp/dingo_ckpt_{self.store_id}")
+        elif t is RegionCmdType.HOLD_VECTOR_INDEX:
+            region = self.meta.get_region(cmd.region_id)
+            w = region.vector_index_wrapper if region is not None else None
+            # build the region's OWN index when absent — is_ready() can be
+            # true via a post-split share, which must not suppress the build
+            if w is not None and (w.own_index is None or not w.ready
+                                  or w.share_index is not None):
+                self.index_manager.rebuild(region)
+        elif t is RegionCmdType.SNAPSHOT_VECTOR_INDEX:
+            region = self.meta.get_region(cmd.region_id)
+            if region is not None:
+                self.index_manager.save_index(region)
+        elif t in (RegionCmdType.STOP, RegionCmdType.PURGE):
+            self.engine.stop_node(cmd.region_id)
+        else:
+            raise ValueError(f"unhandled region cmd {t}")
+
+    # ---------------- shutdown ----------------------------------------------
+    def stop(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+        self.engine.stop()
+        self.raw.close()

@@ -1,0 +1,151 @@
+"""VectorIndexManager: build / rebuild+catch-up / save+load / scrub
+(reference vector_index_manager.cc §3.4 lifecycle)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from dingo_tpu.engine import write_data as wd
+from dingo_tpu.engine.mono_engine import MonoStoreEngine
+from dingo_tpu.engine.raw_engine import MemEngine
+from dingo_tpu.engine.storage import Storage
+from dingo_tpu.index import codec as vcodec
+from dingo_tpu.index.base import IndexParameter, IndexType
+from dingo_tpu.index.manager import VectorIndexManager
+from dingo_tpu.raft.log import RaftLog
+from dingo_tpu.store.region import Region, RegionDefinition, RegionType
+
+DIM = 8
+
+
+def make_stack(index_type=IndexType.FLAT):
+    raw = MemEngine()
+    engine = MonoStoreEngine(raw)
+    storage = Storage(engine)
+    region = Region(RegionDefinition(
+        region_id=5,
+        start_key=vcodec.encode_vector_key(0, 0),
+        end_key=vcodec.encode_vector_key(0, 1 << 40),
+        region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(index_type=index_type, dimension=DIM,
+                                       ncentroids=4, default_nprobe=4),
+    ))
+    w = region.vector_index_wrapper
+    w.build_own()
+    w.set_own(w.own_index)
+    return raw, engine, storage, region
+
+
+def test_build_from_engine_scan(tmp_path):
+    raw, engine, storage, region = make_stack()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((300, DIM)).astype(np.float32)
+    storage.vector_add(region, np.arange(300, dtype=np.int64), x)
+    mgr = VectorIndexManager(raw, str(tmp_path))
+    index = mgr.build_index(region)
+    assert index.get_count() == 300
+    res = index.search(x[:2], 1)
+    assert [r.ids[0] for r in res] == [0, 1]
+
+
+def test_replay_wal_catchup(tmp_path):
+    """ReplayWalToVectorIndex: entries committed after the scan's floor are
+    re-applied from the raft log (adds + deletes, idempotent on overlap)."""
+    raw, engine, storage, region = make_stack()
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((100, DIM)).astype(np.float32)
+    storage.vector_add(region, np.arange(50, dtype=np.int64), x[:50])
+    mgr = VectorIndexManager(raw, str(tmp_path))
+    index = mgr.build_index(region)
+    log = RaftLog()
+    for i in range(50, 60):
+        log.append(1, pickle.dumps(wd.VectorAddData(
+            ts=1, ids=np.asarray([i], np.int64), vectors=x[i:i + 1],
+        )))
+    log.append(1, pickle.dumps(wd.VectorDeleteData(
+        ts=2, ids=np.asarray([0, 1], np.int64),
+    )))
+    # overlap: replaying an add the scan already saw must be harmless
+    log.append(1, pickle.dumps(wd.VectorAddData(
+        ts=3, ids=np.asarray([10], np.int64), vectors=x[10:11],
+    )))
+    n = mgr.replay_wal(index, region, log, 1, log.last_index())
+    assert n == 12
+    assert index.get_count() == 58          # +10 adds, -2 deletes
+    assert index.apply_log_id == log.last_index()
+    assert index.search(x[55][None, :], 1)[0].ids[0] == 55
+
+
+def test_rebuild_switches_atomically(tmp_path):
+    raw, engine, storage, region = make_stack()
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((60, DIM)).astype(np.float32)
+    storage.vector_add(region, np.arange(60, dtype=np.int64), x)
+    w = region.vector_index_wrapper
+    old_index = w.own_index
+    log = RaftLog()
+    mgr = VectorIndexManager(raw, str(tmp_path))
+    mgr.rebuild(region, raft_log=log)
+    assert w.own_index is not old_index
+    assert w.own_index.get_count() == 60
+    assert not w.is_switching
+
+
+def test_rebuild_trains_ivf(tmp_path):
+    raw, engine, storage, region = make_stack(IndexType.IVF_FLAT)
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((200, DIM)).astype(np.float32)
+    storage.vector_add(region, np.arange(200, dtype=np.int64), x)
+    mgr = VectorIndexManager(raw, str(tmp_path))
+    mgr.rebuild(region)
+    w = region.vector_index_wrapper
+    assert w.own_index.is_trained()
+    res = w.search(x[:2], 3, nprobe=4)
+    assert [r.ids[0] for r in res] == [0, 1]
+
+
+def test_save_load_snapshot_with_wal_replay(tmp_path):
+    raw, engine, storage, region = make_stack()
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((80, DIM)).astype(np.float32)
+    storage.vector_add(region, np.arange(80, dtype=np.int64), x)
+    mgr = VectorIndexManager(raw, str(tmp_path))
+    mgr.rebuild(region)
+    w = region.vector_index_wrapper
+    w.apply_log_id = 7
+    w.own_index.apply_log_id = 7
+    mgr.save_index(region)
+    assert w.snapshot_log_id == 7
+
+    # fresh wrapper (restart): load snapshot + replay the log tail
+    log = RaftLog()
+    for _ in range(7):
+        log.append(1, pickle.dumps(wd.KvPutData(cf="default", ts=1, kvs=[])))
+    extra = pickle.dumps(wd.VectorAddData(
+        ts=2, ids=np.asarray([999], np.int64),
+        vectors=rng.standard_normal((1, DIM)).astype(np.float32),
+    ))
+    log.append(1, extra)
+    region2 = Region(region.definition)
+    w2 = region2.vector_index_wrapper
+    w2.apply_log_id = 8
+    assert mgr.load_index(region2, raft_log=log)
+    assert w2.own_index.get_count() == 81
+    assert w2.own_index.apply_log_id == 8
+
+
+def test_load_missing_snapshot_returns_false(tmp_path):
+    raw, engine, storage, region = make_stack()
+    mgr = VectorIndexManager(raw, str(tmp_path))
+    assert not mgr.load_index(region)
+
+
+def test_scrub_reports_needs(tmp_path):
+    raw, engine, storage, region = make_stack()
+    mgr = VectorIndexManager(raw, str(tmp_path))
+    w = region.vector_index_wrapper
+    actions = mgr.scrub(region)
+    assert actions == {"need_rebuild": False, "need_save": False}
+    w.write_count = 1_000_000
+    assert mgr.scrub(region)["need_save"]
