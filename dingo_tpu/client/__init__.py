@@ -1,0 +1,3 @@
+"""Client SDK (the reference's java/dingo-sdk role, in Python)."""
+
+from dingo_tpu.client.client import DingoClient  # noqa: F401

@@ -1,0 +1,228 @@
+"""DingoClient: cluster-aware SDK over the grpc services.
+
+Plays the role of the reference's Java SDK (java/dingo-sdk — "C++ provides
+distributed storage and computing, Java layer provides basic API interfaces",
+README.md:41): keeps a region map from the coordinator, routes requests to
+region leaders, retries on NotLeader errors, and scatter-gathers multi-region
+vector searches client-side (the server returns per-region results only —
+SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import grpc
+import numpy as np
+
+from dingo_tpu.index import codec as vcodec
+from dingo_tpu.server import pb
+from dingo_tpu.server.convert import region_def_from_pb, scalar_from_pb
+from dingo_tpu.server.rpc import ServiceStub
+
+
+class ClientError(RuntimeError):
+    pass
+
+
+class DingoClient:
+    def __init__(self, coordinator_addr: str,
+                 store_addrs: Dict[str, str]):
+        """store_addrs: store_id -> grpc address."""
+        self._coord_channel = grpc.insecure_channel(coordinator_addr)
+        self.coordinator = ServiceStub(self._coord_channel, "CoordinatorService")
+        self.version = ServiceStub(self._coord_channel, "VersionService")
+        self._store_addrs = dict(store_addrs)
+        self._channels: Dict[str, grpc.Channel] = {}
+        self._regions: List = []           # RegionDefinition list
+        self._leader_hint: Dict[int, str] = {}
+
+    # ---------------- plumbing ----------------
+    def _stub(self, store_id: str, service: str) -> ServiceStub:
+        chan = self._channels.get(store_id)
+        if chan is None:
+            chan = grpc.insecure_channel(self._store_addrs[store_id])
+            self._channels[store_id] = chan
+        return ServiceStub(chan, service)
+
+    def refresh_region_map(self) -> None:
+        resp = self.coordinator.GetRegionMap(pb.GetRegionMapRequest())
+        self._regions = [region_def_from_pb(d) for d in resp.regions]
+
+    def _regions_for_vector_ids(self, partition_id: int, refresh: bool = True):
+        if refresh or not self._regions:
+            self.refresh_region_map()
+        return [
+            d for d in self._regions
+            if d.partition_id == partition_id and d.index_parameter is not None
+        ]
+
+    def _region_for_id(self, partition_id: int, vector_id: int,
+                       regions=None):
+        key = vcodec.encode_vector_key(partition_id, vector_id)
+        for d in (regions if regions is not None
+                  else self._regions_for_vector_ids(partition_id)):
+            if d.start_key <= key < d.end_key:
+                return d
+        raise ClientError(f"no region covers vector id {vector_id}")
+
+    def _call_leader(self, definition, service: str, method: str, req,
+                     retries: int = 4):
+        """Leader routing with NotLeader retry (SDK behavior)."""
+        order = [self._leader_hint.get(definition.region_id)] if \
+            self._leader_hint.get(definition.region_id) else []
+        order += [p for p in definition.peers if p not in order]
+        last_err = None
+        for _ in range(retries):
+            for store_id in order:
+                stub = self._stub(store_id, service)
+                resp = getattr(stub, method)(req)
+                code = resp.error.errcode
+                if code == 0:
+                    self._leader_hint[definition.region_id] = store_id
+                    return resp
+                last_err = resp.error.errmsg
+                if code == 20001 and ":" in resp.error.errmsg:
+                    hint = resp.error.errmsg.split(":")[-1].strip()
+                    if "/" in hint:
+                        self._leader_hint[definition.region_id] = \
+                            hint.split("/")[0]
+            time.sleep(0.1)
+        raise ClientError(f"no leader accepted {method}: {last_err}")
+
+    # ---------------- admin ----------------
+    def create_index_region(self, partition_id: int, id_lo: int, id_hi: int,
+                            index_parameter: pb.VectorIndexParameter,
+                            replication: int = 0):
+        req = pb.CreateRegionRequest()
+        req.range.start_key = vcodec.encode_vector_key(partition_id, id_lo)
+        req.range.end_key = vcodec.encode_vector_key(partition_id, id_hi)
+        req.partition_id = partition_id
+        req.region_type = 1
+        req.index_parameter.CopyFrom(index_parameter)
+        req.replication = replication
+        resp = self.coordinator.CreateRegion(req)
+        if resp.error.errcode:
+            raise ClientError(resp.error.errmsg)
+        return region_def_from_pb(resp.definition)
+
+    def split_region(self, region_id: int, split_vector_id: int,
+                     partition_id: int = 0) -> int:
+        req = pb.SplitRegionRequest()
+        req.region_id = region_id
+        req.split_key = vcodec.encode_vector_key(partition_id, split_vector_id)
+        resp = self.coordinator.SplitRegion(req)
+        if resp.error.errcode:
+            raise ClientError(resp.error.errmsg)
+        return resp.child_region_id
+
+    def tso(self, count: int = 1) -> int:
+        resp = self.coordinator.Tso(pb.TsoRequest(count=count))
+        return resp.first_ts
+
+    # ---------------- vectors ----------------
+    def vector_add(self, partition_id: int, ids: Sequence[int],
+                   vectors: np.ndarray,
+                   scalars: Optional[List[Dict[str, Any]]] = None) -> None:
+        """Batch add routed per owning region."""
+        groups: Dict[int, List[int]] = {}
+        regions = self._regions_for_vector_ids(partition_id)  # ONE refresh
+        for i, vid in enumerate(ids):
+            d = self._region_for_id(partition_id, int(vid), regions)
+            groups.setdefault(d.region_id, []).append(i)
+        by_region = {d.region_id: d for d in self._regions}
+        for rid, idxs in groups.items():
+            d = by_region[rid]
+            req = pb.VectorAddRequest()
+            req.context.region_id = rid
+            for i in idxs:
+                v = req.vectors.add()
+                v.vector.id = int(ids[i])
+                v.vector.values.extend(np.asarray(vectors[i], np.float32).tolist())
+                if scalars is not None:
+                    for k, val in scalars[i].items():
+                        e = v.scalar_data.add()
+                        e.key = k
+                        e.value = pickle.dumps(val)
+            self._call_leader(d, "IndexService", "VectorAdd", req)
+
+    def vector_search(
+        self, partition_id: int, queries: np.ndarray, topk: int = 10,
+        with_scalar_data: bool = False, **params,
+    ) -> List[List[Tuple[int, float]]]:
+        """Scatter to every region of the partition, gather + merge top-k
+        client-side (the reference SDK's cross-region story)."""
+        regions = self._regions_for_vector_ids(partition_id)
+        if not regions:
+            raise ClientError("no index regions")
+        queries = np.asarray(queries, np.float32)
+        merged: List[List[Tuple[int, float]]] = [[] for _ in queries]
+        # wire convention: L2/HAMMING distances ascend, IP/COSINE similarity
+        # descends (ops/distance.py metric_ascending) — merge accordingly
+        from dingo_tpu.ops.distance import Metric, metric_ascending
+
+        metric = (regions[0].index_parameter.metric
+                  if regions[0].index_parameter else Metric.L2)
+        ascending = metric_ascending(metric)
+        for d in regions:
+            req = pb.VectorSearchRequest()
+            req.context.region_id = d.region_id
+            for q in queries:
+                v = req.vectors.add()
+                v.values.extend(q.tolist())
+            req.parameter.top_n = topk
+            req.parameter.with_scalar_data = with_scalar_data
+            if "nprobe" in params:
+                req.parameter.nprobe = params["nprobe"]
+            if "ef_search" in params:
+                req.parameter.ef_search = params["ef_search"]
+            resp = self._call_leader(d, "IndexService", "VectorSearch", req)
+            for qi, row in enumerate(resp.batch_results):
+                for item in row.results:
+                    merged[qi].append((item.vector.id, item.distance))
+        out = []
+        for row in merged:
+            row.sort(key=lambda t: t[1], reverse=not ascending)
+            out.append(row[:topk])
+        return out
+
+    def vector_count(self, partition_id: int) -> int:
+        total = 0
+        for d in self._regions_for_vector_ids(partition_id):
+            req = pb.VectorCountRequest()
+            req.context.region_id = d.region_id
+            resp = self._call_leader(d, "IndexService", "VectorCount", req)
+            total += resp.count
+        return total
+
+    # ---------------- kv ----------------
+    def _region_for_key(self, key: bytes):
+        self.refresh_region_map()
+        for d in self._regions:
+            if d.start_key <= key < d.end_key:
+                return d
+        raise ClientError(f"no region covers key {key!r}")
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        d = self._region_for_key(key)
+        req = pb.KvBatchPutRequest()
+        req.context.region_id = d.region_id
+        kv = req.kvs.add()
+        kv.key = key
+        kv.value = value
+        self._call_leader(d, "StoreService", "KvBatchPut", req)
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        d = self._region_for_key(key)
+        req = pb.KvGetRequest()
+        req.context.region_id = d.region_id
+        req.key = key
+        resp = self._call_leader(d, "StoreService", "KvGet", req)
+        return resp.value if resp.found else None
+
+    def close(self) -> None:
+        self._coord_channel.close()
+        for chan in self._channels.values():
+            chan.close()

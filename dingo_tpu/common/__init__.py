@@ -1,0 +1,3 @@
+"""Common runtime: config, crontab, failpoints, request tracking, metrics,
+stream paging, worker pools. Mirrors reference src/common/, src/config/,
+src/crontab/, src/metrics/."""

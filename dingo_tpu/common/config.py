@@ -1,0 +1,145 @@
+"""Two-tier configuration: per-role config file + flag registry.
+
+Reference: src/config/ — YamlConfig (yaml-cpp) loaded per role at boot,
+ConfigManager singleton, ConfigHelper typed accessors with defaults
+(config_helper.h:25-53), plus gflags for every tunable; yaml values override
+gflag defaults at boot (server.cc:500-512).
+
+No yaml parser is baked into this image, so config files are TOML-like
+`section.key = value` lines (plus JSON support); the Flag registry plays the
+gflags role with runtime mutability for the hot-changeable set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_UNSET = object()
+
+
+class Flag:
+    def __init__(self, name: str, default: Any, help_: str = "",
+                 mutable: bool = False):
+        self.name = name
+        self.default = default
+        self.help = help_
+        self.mutable = mutable
+        self.value = default
+
+
+class FlagRegistry:
+    """DEFINE_*/FLAGS_* analog with optional hot changes
+    (BRPC_VALIDATE_GFLAG pattern, vector_reader.cc:72)."""
+
+    def __init__(self):
+        self._flags: Dict[str, Flag] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, default: Any, help_: str = "",
+               mutable: bool = False) -> None:
+        with self._lock:
+            if name not in self._flags:
+                self._flags[name] = Flag(name, default, help_, mutable)
+
+    def get(self, name: str) -> Any:
+        return self._flags[name].value
+
+    def set(self, name: str, value: Any, boot: bool = False) -> None:
+        with self._lock:
+            flag = self._flags[name]
+            if not boot and not flag.mutable:
+                raise PermissionError(f"flag {name} is not hot-changeable")
+            flag.value = type(flag.default)(value) if flag.default is not None \
+                else value
+
+    def all(self) -> Dict[str, Any]:
+        return {k: f.value for k, f in self._flags.items()}
+
+
+FLAGS = FlagRegistry()
+
+# reference limits (index_service.cc:50-51,206; vector_reader.cc:60-61)
+FLAGS.define("vector_max_batch_count", 4096)
+FLAGS.define("vector_max_request_size", 32 * 1024 * 1024)
+FLAGS.define("vector_index_bruteforce_batch_count", 2048, mutable=True)
+FLAGS.define("vector_max_range_search_result_count", 1024, mutable=True)
+FLAGS.define("enable_async_vector_search", True, mutable=True)
+FLAGS.define("server_heartbeat_interval_s", 10, mutable=True)
+FLAGS.define("raft_snapshot_threshold", 10000, mutable=True)
+FLAGS.define("region_max_size_bytes", 256 * 1024 * 1024, mutable=True)
+FLAGS.define("split_check_approximate_keys", 1_000_000, mutable=True)
+
+
+class Config:
+    """Per-role config (ConfigManager + YamlConfig analog)."""
+
+    def __init__(self, values: Optional[Dict[str, Any]] = None):
+        self._values = dict(values or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        with open(path) as f:
+            text = f.read()
+        if path.endswith(".json"):
+            return cls(_flatten(json.loads(text)))
+        values: Dict[str, Any] = {}
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                continue
+            key, _, raw = line.partition("=")
+            values[key.strip()] = _parse_scalar(raw.strip())
+        return cls(values)
+
+    def get(self, key: str, default: Any = _UNSET) -> Any:
+        if key in self._values:
+            return self._values[key]
+        if default is _UNSET:
+            raise KeyError(key)
+        return default
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        return int(self.get(key, default))
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key, default)
+        if isinstance(v, str):
+            return v.lower() in ("1", "true", "yes", "on")
+        return bool(v)
+
+    def apply_flag_overrides(self, flags: FlagRegistry = FLAGS) -> int:
+        """Boot-time yaml-overrides-gflags behavior (server.cc:500-512)."""
+        n = 0
+        for key, value in self._values.items():
+            name = key.replace(".", "_")
+            if name in flags._flags:
+                flags.set(name, value, boot=True)
+                n += 1
+        return n
+
+
+def _parse_scalar(raw: str) -> Any:
+    for caster in (int, float):
+        try:
+            return caster(raw)
+        except ValueError:
+            pass
+    if raw.lower() in ("true", "false"):
+        return raw.lower() == "true"
+    return raw.strip("\"'")
+
+
+def _flatten(obj: Dict, prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in obj.items():
+        key = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out

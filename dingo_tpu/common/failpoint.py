@@ -1,0 +1,104 @@
+"""Failpoint: runtime fault injection.
+
+Reference: src/common/failpoint.{h,cc} — named failpoints configured at
+runtime (via DebugService) with actions panic/sleep/print/yield/delay
+(failpoint.h:44-141), compiled in behind ENABLE_FAILPOINT. Here failpoints
+are always available (no compile gate) and applied with `apply("name")` at
+the instrumented site.
+
+Config string format (reference-compatible spirit):
+    "<percent>%<count>*<action>(<arg>)"
+e.g. "100%10*sleep(50)" = always fire, first 10 times, sleep 50ms.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+import threading
+import time
+from typing import Dict, Optional
+
+
+class FailPointError(RuntimeError):
+    """Raised by the `panic` action."""
+
+
+class _FailPoint:
+    def __init__(self, name: str, percent: int, count: int, action: str,
+                 arg: str):
+        self.name = name
+        self.percent = percent
+        self.count = count          # -1 = unlimited
+        self.action = action
+        self.arg = arg
+        self.hits = 0
+
+
+_CFG_RE = re.compile(
+    r"^(?:(?P<pct>\d+)%)?(?:(?P<cnt>\d+)\*)?(?P<act>\w+)(?:\((?P<arg>[^)]*)\))?$"
+)
+
+
+class FailPointManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._points: Dict[str, _FailPoint] = {}
+        self._rng = random.Random(0xFA11)
+
+    def configure(self, name: str, config: str) -> None:
+        """e.g. configure("before_raft_commit", "50%3*sleep(100)")."""
+        m = _CFG_RE.match(config.strip())
+        if not m:
+            raise ValueError(f"bad failpoint config {config!r}")
+        point = _FailPoint(
+            name,
+            int(m.group("pct") or 100),
+            int(m.group("cnt") or -1),
+            m.group("act"),
+            m.group("arg") or "",
+        )
+        with self._lock:
+            self._points[name] = point
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._points.pop(name, None)
+
+    def list(self) -> Dict[str, str]:
+        with self._lock:
+            return {
+                n: f"{p.percent}%{p.count}*{p.action}({p.arg})"
+                for n, p in self._points.items()
+            }
+
+    def apply(self, name: str) -> None:
+        """Call at the instrumented site; may sleep/raise per config."""
+        with self._lock:
+            point = self._points.get(name)
+            if point is None:
+                return
+            if point.count == 0:
+                return
+            if self._rng.random() * 100 >= point.percent:
+                return
+            if point.count > 0:
+                point.count -= 1
+            point.hits += 1
+            action, arg = point.action, point.arg
+        if action == "panic":
+            raise FailPointError(f"failpoint {name} panic")
+        if action == "sleep" or action == "delay":
+            time.sleep(float(arg or 0) / 1000.0)
+        elif action == "print":
+            print(f"[failpoint] {name}: {arg}")
+        elif action == "yield":
+            time.sleep(0)
+
+
+#: process-global manager (the reference's singleton)
+FAILPOINTS = FailPointManager()
+
+
+def failpoint(name: str) -> None:
+    FAILPOINTS.apply(name)

@@ -1,0 +1,146 @@
+"""Metrics: counters, gauges, latency recorders with percentile windows.
+
+Reference: bvar everywhere — multi-dimension per-region metrics
+(store_bvar_metrics.h:86-89), task counters (vector_index_manager.h:177-199),
+ad-hoc bvar::LatencyRecorder at each layer (vector_reader.cc:64-65,
+raft_store_engine.cc:418,450), exposed via brpc /vars and the metrics
+services. Here: a process-global registry the server layer dumps as JSON.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class Counter:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    def get(self) -> int:
+        return self._value
+
+
+class Gauge:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    def get(self) -> float:
+        return self._value
+
+
+class LatencyRecorder:
+    """bvar::LatencyRecorder analog: ring of recent samples with
+    qps estimation and percentile queries."""
+
+    def __init__(self, window: int = 4096):
+        self._window = window
+        self._samples: List[float] = []
+        self._pos = 0
+        self._count = 0
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+
+    def observe_us(self, us: float) -> None:
+        with self._lock:
+            if len(self._samples) < self._window:
+                self._samples.append(us)
+            else:
+                self._samples[self._pos] = us
+                self._pos = (self._pos + 1) % self._window
+            self._count += 1
+
+    class _Timer:
+        __slots__ = ("rec", "t0")
+
+        def __init__(self, rec):
+            self.rec = rec
+
+        def __enter__(self):
+            self.t0 = time.perf_counter_ns()
+            return self
+
+        def __exit__(self, *exc):
+            self.rec.observe_us((time.perf_counter_ns() - self.t0) / 1000.0)
+            return False
+
+    def time(self) -> "_Timer":
+        return self._Timer(self)
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            ordered = sorted(self._samples)
+            i = min(len(ordered) - 1, int(p / 100.0 * len(ordered)))
+            return ordered[i]
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            n = len(self._samples)
+            avg = sum(self._samples) / n if n else 0.0
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        return {
+            "count": self._count,
+            "qps": self._count / elapsed,
+            "avg_us": avg,
+            "p50_us": self.percentile(50),
+            "p99_us": self.percentile(99),
+        }
+
+
+class MetricsRegistry:
+    """Named metrics with optional region dimension
+    (StoreBvarMetrics multi-dimension pattern)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._latencies: Dict[str, LatencyRecorder] = {}
+
+    def counter(self, name: str, region_id: Optional[int] = None) -> Counter:
+        key = f"{name}{{region={region_id}}}" if region_id else name
+        with self._lock:
+            return self._counters.setdefault(key, Counter())
+
+    def gauge(self, name: str, region_id: Optional[int] = None) -> Gauge:
+        key = f"{name}{{region={region_id}}}" if region_id else name
+        with self._lock:
+            return self._gauges.setdefault(key, Gauge())
+
+    def latency(self, name: str, region_id: Optional[int] = None) -> LatencyRecorder:
+        key = f"{name}{{region={region_id}}}" if region_id else name
+        with self._lock:
+            return self._latencies.setdefault(key, LatencyRecorder())
+
+    def dump(self) -> Dict[str, object]:
+        """/vars-style dump."""
+        with self._lock:
+            out: Dict[str, object] = {}
+            for k, c in self._counters.items():
+                out[k] = c.get()
+            for k, g in self._gauges.items():
+                out[k] = g.get()
+            for k, lr in self._latencies.items():
+                out[k] = lr.stats()
+            return out
+
+
+METRICS = MetricsRegistry()

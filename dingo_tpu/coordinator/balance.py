@@ -101,9 +101,15 @@ class BalanceRegionScheduler:
         ops = self.plan()
         for op in ops:
             definition = self.control.regions[op.region_id]
-            new_peers = [
-                op.to_store if p == op.from_store else p
-                for p in definition.peers
-            ]
-            self.control.change_peer(op.region_id, new_peers)
+            # Two-phase: add the new peer, then remove the old one — raft
+            # single-step membership changes stay safe only one server at a
+            # time (simultaneous add+remove can elect two leaders).
+            self.control.change_peer(
+                op.region_id, definition.peers + [op.to_store]
+            )
+            self.control.change_peer(
+                op.region_id,
+                [p for p in self.control.regions[op.region_id].peers
+                 if p != op.from_store],
+            )
         return len(ops)

@@ -35,6 +35,7 @@ from dingo_tpu.store.region import (
 _PREFIX_STORE = b"COOR_STORE_"
 _PREFIX_REGION = b"COOR_REGION_"
 _PREFIX_IDS = b"COOR_IDS_"
+_KEY_OPS = b"COOR_OPS__"
 
 
 class StoreState(enum.Enum):
@@ -70,6 +71,7 @@ class RegionCmd:
     child_region_id: int = 0
     target_store_id: str = ""
     status: str = "pending"
+    retries: int = 0
 
 
 @dataclasses.dataclass
@@ -120,9 +122,22 @@ class CoordinatorControl:
         blob = self.engine.get(CF_META, _PREFIX_IDS)
         if blob:
             self._next_region_id, self._next_cmd_id = pickle.loads(blob)
+        blob = self.engine.get(CF_META, _KEY_OPS)
+        if blob:
+            self.store_ops, self.region_leaders = pickle.loads(blob)
+            # undelivered-but-marked-sent commands are re-sent after a crash
+            for q in self.store_ops.values():
+                for c in q:
+                    if c.status == "sent":
+                        c.status = "pending"
 
     def _persist_ids(self) -> None:
         self._persist(_PREFIX_IDS, (self._next_region_id, self._next_cmd_id))
+
+    def _persist_ops(self) -> None:
+        """Pending region commands + leadership map survive coordinator
+        restart (the reference replicates these through MetaStateMachine)."""
+        self._persist(_KEY_OPS, (self.store_ops, self.region_leaders))
 
     # ---------------- store registry ----------------------------------------
     def register_store(self, store_id: str, address: str = "") -> None:
@@ -173,6 +188,8 @@ class CoordinatorControl:
             pending = [c for c in ops if c.status == "pending"]
             for c in pending:
                 c.status = "sent"
+            if pending:
+                self._persist_ops()
             return pending
 
     def update_store_states(self) -> List[str]:
@@ -259,6 +276,7 @@ class CoordinatorControl:
     def _queue_cmd(self, store_id: str, cmd: RegionCmd) -> None:
         self.store_ops.setdefault(store_id, []).append(cmd)
         self.jobs.append(cmd)
+        self._persist_ops()
 
     def requeue_cmd(self, cmd: RegionCmd, store_id: str,
                     from_store: Optional[str] = None) -> None:
@@ -275,6 +293,7 @@ class CoordinatorControl:
             q = self.store_ops.setdefault(store_id, [])
             if cmd not in q:
                 q.append(cmd)
+            self._persist_ops()
 
     def drop_region(self, region_id: int) -> None:
         with self._lock:

@@ -19,7 +19,7 @@ from dingo_tpu.engine.raw_engine import CF_META, RawEngine
 
 _PREFIX_KV = b"VKV_"
 _PREFIX_LEASE = b"VLEASE_"
-_KEY_REVISION = b"VKV_REVISION__"  # sorts inside no KV prefix scan range
+_KEY_REVISION = b"VKVREV__"  # NOT under VKV_: user keys cannot collide
 
 
 @dataclasses.dataclass
@@ -210,8 +210,14 @@ class KvControl:
             self._watches.setdefault(key, []).append((start_revision, callback))
 
     def _fire_watches(self, key: bytes, event: str, item: KvItem) -> None:
+        keep = []
         for rev, cb in self._watches.pop(key, []):
+            if item.mod_revision < rev:
+                keep.append((rev, cb))   # event predates the watch window
+                continue
             try:
                 cb(event, item)
             except Exception:
                 pass
+        if keep:
+            self._watches[key] = keep

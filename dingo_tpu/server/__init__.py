@@ -1,0 +1,16 @@
+"""Server layer: grpc services over protobuf (proto/dingo.proto).
+
+Mirrors reference src/server/ — one process can host any role
+(`dingodb_server --role=...`, main.cc:530-541): coordinator services
+(CoordinatorService/MetaService/VersionService) or store/index services
+(StoreService/IndexService/NodeService/DebugService/UtilService).
+"""
+
+import os
+import sys
+
+# protoc --python_out generates a flat module; make it importable as
+# dingo_tpu.server.dingo_pb2 regardless of cwd.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from dingo_tpu.server import dingo_pb2 as pb  # noqa: F401,E402

@@ -1,0 +1,144 @@
+"""protobuf <-> internal object conversions."""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from dingo_tpu.coprocessor.scalar_filter import CmpOp, ScalarFilter, ScalarPredicate
+from dingo_tpu.index.base import IndexParameter, IndexType
+from dingo_tpu.index.vector_reader import VectorFilterMode, VectorFilterType
+from dingo_tpu.ops.distance import Metric
+from dingo_tpu.server import pb
+from dingo_tpu.store.region import RegionDefinition, RegionEpoch, RegionType
+
+_METRIC_TO_PB = {
+    Metric.L2: pb.METRIC_TYPE_L2,
+    Metric.INNER_PRODUCT: pb.METRIC_TYPE_INNER_PRODUCT,
+    Metric.COSINE: pb.METRIC_TYPE_COSINE,
+    Metric.HAMMING: pb.METRIC_TYPE_HAMMING,
+}
+_PB_TO_METRIC = {v: k for k, v in _METRIC_TO_PB.items()}
+
+_ITYPE_TO_PB = {
+    IndexType.FLAT: pb.VECTOR_INDEX_TYPE_FLAT,
+    IndexType.IVF_FLAT: pb.VECTOR_INDEX_TYPE_IVF_FLAT,
+    IndexType.IVF_PQ: pb.VECTOR_INDEX_TYPE_IVF_PQ,
+    IndexType.HNSW: pb.VECTOR_INDEX_TYPE_HNSW,
+    IndexType.DISKANN: pb.VECTOR_INDEX_TYPE_DISKANN,
+    IndexType.BRUTEFORCE: pb.VECTOR_INDEX_TYPE_BRUTEFORCE,
+    IndexType.BINARY_FLAT: pb.VECTOR_INDEX_TYPE_BINARY_FLAT,
+}
+_PB_TO_ITYPE = {v: k for k, v in _ITYPE_TO_PB.items()}
+
+_FILTER_TO_MODE = {
+    pb.VECTOR_FILTER_NONE: VectorFilterMode.NONE,
+    pb.SCALAR_FILTER: VectorFilterMode.SCALAR,
+    pb.TABLE_FILTER: VectorFilterMode.TABLE,
+    pb.VECTOR_ID_FILTER: VectorFilterMode.VECTOR_ID,
+}
+
+
+def index_parameter_to_pb(p: Optional[IndexParameter]) -> pb.VectorIndexParameter:
+    out = pb.VectorIndexParameter()
+    if p is None:
+        return out
+    out.index_type = _ITYPE_TO_PB[p.index_type]
+    out.dimension = p.dimension
+    out.metric_type = _METRIC_TO_PB[p.metric]
+    out.ncentroids = p.ncentroids
+    out.nsubvector = p.nsubvector
+    out.nbits_per_idx = p.nbits_per_idx
+    out.default_nprobe = p.default_nprobe
+    out.efconstruction = p.efconstruction
+    out.nlinks = p.nlinks
+    return out
+
+
+def index_parameter_from_pb(m: pb.VectorIndexParameter) -> Optional[IndexParameter]:
+    if m.index_type == pb.VECTOR_INDEX_TYPE_NONE:
+        return None
+    return IndexParameter(
+        index_type=_PB_TO_ITYPE[m.index_type],
+        dimension=m.dimension,
+        metric=_PB_TO_METRIC.get(m.metric_type, Metric.L2),
+        ncentroids=m.ncentroids or 2048,
+        nsubvector=m.nsubvector or 64,
+        nbits_per_idx=m.nbits_per_idx or 8,
+        default_nprobe=m.default_nprobe or 80,
+        efconstruction=m.efconstruction or 200,
+        nlinks=m.nlinks or 32,
+    )
+
+
+def region_def_to_pb(d: RegionDefinition) -> pb.RegionDefinition:
+    out = pb.RegionDefinition()
+    out.region_id = d.region_id
+    out.epoch.conf_version = d.epoch.conf_version
+    out.epoch.version = d.epoch.version
+    out.range.start_key = d.start_key
+    out.range.end_key = d.end_key
+    out.partition_id = d.partition_id
+    out.peers.extend(d.peers)
+    out.region_type = {"store": 0, "index": 1, "document": 2}[d.region_type.value]
+    out.index_parameter.CopyFrom(index_parameter_to_pb(d.index_parameter))
+    return out
+
+
+def region_def_from_pb(m: pb.RegionDefinition) -> RegionDefinition:
+    return RegionDefinition(
+        region_id=m.region_id,
+        start_key=m.range.start_key,
+        end_key=m.range.end_key,
+        partition_id=m.partition_id,
+        peers=list(m.peers),
+        epoch=RegionEpoch(m.epoch.conf_version or 1, m.epoch.version or 1),
+        region_type=[RegionType.STORE, RegionType.INDEX,
+                     RegionType.DOCUMENT][m.region_type],
+        index_parameter=index_parameter_from_pb(m.index_parameter),
+    )
+
+
+def scalar_to_pb(entries, scalar: Optional[Dict[str, Any]]) -> None:
+    for k, v in (scalar or {}).items():
+        e = entries.add()
+        e.key = k
+        e.value = pickle.dumps(v)
+
+
+def scalar_from_pb(entries) -> Dict[str, Any]:
+    return {e.key: pickle.loads(e.value) for e in entries}
+
+
+def predicates_from_pb(preds) -> Optional[ScalarFilter]:
+    if not preds:
+        return None
+    return ScalarFilter([
+        ScalarPredicate(p.field, CmpOp(p.op), pickle.loads(p.value))
+        for p in preds
+    ])
+
+
+def search_kwargs_from_pb(param: pb.VectorSearchParameter) -> dict:
+    kw: dict = {
+        "filter_mode": _FILTER_TO_MODE.get(param.filter, VectorFilterMode.NONE),
+        "filter_type": (
+            VectorFilterType.QUERY_PRE
+            if param.filter_type == pb.QUERY_PRE
+            else VectorFilterType.QUERY_POST
+        ),
+        "with_vector_data": param.with_vector_data,
+        "with_scalar_data": param.with_scalar_data,
+    }
+    if param.vector_ids:
+        kw["vector_ids"] = list(param.vector_ids)
+    sf = predicates_from_pb(param.predicates)
+    if sf is not None:
+        kw["scalar_filter"] = sf
+    return kw
+
+
+def queries_from_pb(vectors) -> np.ndarray:
+    return np.asarray([list(v.values) for v in vectors], np.float32)

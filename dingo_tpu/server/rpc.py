@@ -1,0 +1,140 @@
+"""grpc server plumbing: hand-written method handler registration.
+
+One DingoServer can host store-role services (Index/Store/Node/Debug/Util —
+the reference's dingodb_server --role=index|store) and/or coordinator-role
+services (Coordinator/Version) in one process, like the reference binary.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Dict, Optional, Tuple
+
+import grpc
+
+from dingo_tpu.server import pb
+from dingo_tpu.server.services import (
+    CoordinatorService,
+    DebugService,
+    IndexService,
+    NodeService,
+    StoreService,
+    UtilService,
+    VersionService,
+)
+
+#: service -> method -> (request type, response type)
+SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
+    "IndexService": {
+        "VectorSearch": (pb.VectorSearchRequest, pb.VectorSearchResponse),
+        "VectorAdd": (pb.VectorAddRequest, pb.VectorAddResponse),
+        "VectorDelete": (pb.VectorDeleteRequest, pb.VectorDeleteResponse),
+        "VectorBatchQuery": (pb.VectorBatchQueryRequest, pb.VectorBatchQueryResponse),
+        "VectorGetBorderId": (pb.VectorGetBorderIdRequest, pb.VectorGetBorderIdResponse),
+        "VectorScanQuery": (pb.VectorScanQueryRequest, pb.VectorScanQueryResponse),
+        "VectorCount": (pb.VectorCountRequest, pb.VectorCountResponse),
+    },
+    "StoreService": {
+        "KvGet": (pb.KvGetRequest, pb.KvGetResponse),
+        "KvBatchPut": (pb.KvBatchPutRequest, pb.KvBatchPutResponse),
+        "KvBatchDelete": (pb.KvBatchDeleteRequest, pb.KvBatchDeleteResponse),
+        "KvScan": (pb.KvScanRequest, pb.KvScanResponse),
+        "TxnPrewrite": (pb.TxnPrewriteRequest, pb.TxnPrewriteResponse),
+        "TxnCommit": (pb.TxnCommitRequest, pb.TxnCommitResponse),
+        "TxnGet": (pb.TxnGetRequest, pb.TxnGetResponse),
+        "TxnScan": (pb.TxnScanRequest, pb.TxnScanResponse),
+        "TxnBatchRollback": (pb.TxnBatchRollbackRequest, pb.TxnBatchRollbackResponse),
+        "TxnCheckStatus": (pb.TxnCheckStatusRequest, pb.TxnCheckStatusResponse),
+    },
+    "UtilService": {
+        "VectorCalcDistance": (pb.VectorCalcDistanceRequest, pb.VectorCalcDistanceResponse),
+    },
+    "NodeService": {
+        "NodeInfo": (pb.NodeInfoRequest, pb.NodeInfoResponse),
+    },
+    "DebugService": {
+        "MetricsDump": (pb.MetricsDumpRequest, pb.MetricsDumpResponse),
+        "FailPoint": (pb.FailPointRequest, pb.FailPointResponse),
+    },
+    "CoordinatorService": {
+        "Hello": (pb.HelloRequest, pb.HelloResponse),
+        "StoreHeartbeat": (pb.StoreHeartbeatRequest, pb.StoreHeartbeatResponse),
+        "CreateRegion": (pb.CreateRegionRequest, pb.CreateRegionResponse),
+        "SplitRegion": (pb.SplitRegionRequest, pb.SplitRegionResponse),
+        "GetRegionMap": (pb.GetRegionMapRequest, pb.GetRegionMapResponse),
+        "Tso": (pb.TsoRequest, pb.TsoResponse),
+    },
+    "VersionService": {
+        "VKvPut": (pb.VKvPutRequest, pb.VKvPutResponse),
+        "VKvRange": (pb.VKvRangeRequest, pb.VKvRangeResponse),
+        "LeaseGrant": (pb.LeaseGrantRequest, pb.LeaseGrantResponse),
+    },
+}
+
+
+def _register(server: grpc.Server, service_name: str, impl) -> None:
+    schema = SERVICE_SCHEMA[service_name]
+    handlers = {}
+    for method, (req_t, resp_t) in schema.items():
+        fn = getattr(impl, method)
+
+        def make(fn, req_t):
+            def handler(request, context):
+                return fn(request)
+
+            return handler
+
+        handlers[method] = grpc.unary_unary_rpc_method_handler(
+            make(fn, req_t),
+            request_deserializer=req_t.FromString,
+            response_serializer=resp_t.SerializeToString,
+        )
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(
+            f"dingo_tpu.{service_name}", handlers
+        ),
+    ))
+
+
+class DingoServer:
+    def __init__(self, port: int = 0, max_workers: int = 16):
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+
+    def host_store_role(self, node) -> None:
+        """--role=store|index service set (main.cc:681+)."""
+        _register(self._server, "IndexService", IndexService(node))
+        _register(self._server, "StoreService", StoreService(node))
+        _register(self._server, "NodeService", NodeService(node))
+        _register(self._server, "DebugService", DebugService())
+        _register(self._server, "UtilService", UtilService())
+
+    def host_coordinator_role(self, control, tso, kv_control) -> None:
+        """--role=coordinator service set."""
+        _register(self._server, "CoordinatorService",
+                  CoordinatorService(control, tso))
+        _register(self._server, "VersionService", VersionService(kv_control))
+        _register(self._server, "DebugService", DebugService())
+
+    def start(self) -> int:
+        self._server.start()
+        return self.port
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+
+class ServiceStub:
+    """Minimal client-side stub (the grpc codegen plugin is absent)."""
+
+    def __init__(self, channel: grpc.Channel, service_name: str):
+        self._channel = channel
+        self._service = service_name
+        for method, (req_t, resp_t) in SERVICE_SCHEMA[service_name].items():
+            setattr(self, method, channel.unary_unary(
+                f"/dingo_tpu.{service_name}/{method}",
+                request_serializer=req_t.SerializeToString,
+                response_deserializer=resp_t.FromString,
+            ))

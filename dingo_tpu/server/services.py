@@ -1,0 +1,513 @@
+"""grpc service implementations.
+
+Reference service registry (src/server/main.cc:681-1360, per role):
+  INDEX/STORE roles — IndexServiceImpl (index_service.h), StoreServiceImpl,
+      NodeService, DebugService, UtilService
+  COORDINATOR role — CoordinatorServiceImpl, MetaService, VersionService
+
+Handlers are hand-written over the protoc-generated messages (no grpc
+codegen plugin in this image); registration uses generic method handlers.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import time
+from typing import Dict, Optional
+
+import grpc
+import numpy as np
+
+from dingo_tpu.common.failpoint import FAILPOINTS
+from dingo_tpu.common.metrics import METRICS
+from dingo_tpu.coordinator.control import CoordinatorControl, RegionCmd, RegionCmdType
+from dingo_tpu.coordinator.kv_control import KvControl
+from dingo_tpu.coordinator.tso import TsoControl
+from dingo_tpu.engine.txn import Mutation, Op, TxnEngine, TxnError
+from dingo_tpu.index.base import VectorIndexError
+from dingo_tpu.ops.distance import Metric
+from dingo_tpu.raft.core import NotLeader
+from dingo_tpu.server import convert, pb
+from dingo_tpu.store.node import StoreNode
+from dingo_tpu.store.region import Region, RegionType
+
+
+def _err(resp, code: int, msg: str):
+    resp.error.errcode = code
+    resp.error.errmsg = msg
+    return resp
+
+
+def _region_or_err(node: StoreNode, context_pb, resp) -> Optional[Region]:
+    region = node.get_region(context_pb.region_id)
+    if region is None:
+        _err(resp, 10001, f"region {context_pb.region_id} not found")
+        return None
+    # epoch check (reference validates region epoch on every request)
+    if (
+        context_pb.region_epoch.version
+        and context_pb.region_epoch.version != region.epoch.version
+    ):
+        _err(resp, 10002,
+             f"epoch mismatch {context_pb.region_epoch.version} != "
+             f"{region.epoch.version}")
+        return None
+    return region
+
+
+class IndexService:
+    """Vector RPCs (index_service.h:92+)."""
+
+    def __init__(self, node: StoreNode):
+        self.node = node
+
+    def VectorSearch(self, req: pb.VectorSearchRequest) -> pb.VectorSearchResponse:
+        resp = pb.VectorSearchResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        lat = METRICS.latency("vector_search", region.id)
+        t0 = time.perf_counter_ns()
+        try:
+            queries = convert.queries_from_pb(req.vectors)
+            kw = convert.search_kwargs_from_pb(req.parameter)
+            if req.parameter.nprobe:
+                kw["nprobe"] = req.parameter.nprobe
+            if req.parameter.ef_search:
+                kw["ef"] = req.parameter.ef_search
+            results = self.node.storage.vector_batch_search(
+                region, queries, req.parameter.top_n or 10, **kw
+            )
+        except (VectorIndexError, ValueError) as e:
+            return _err(resp, 30001, str(e))
+        for row in results:
+            r = resp.batch_results.add()
+            for v in row:
+                item = r.results.add()
+                item.vector.id = v.id
+                item.distance = v.distance
+                if v.vector is not None:
+                    item.vector.values.extend(v.vector.tolist())
+                if v.scalar:
+                    convert.scalar_to_pb(item.scalar_data, v.scalar)
+        lat.observe_us((time.perf_counter_ns() - t0) / 1000.0)
+        return resp
+
+    def VectorAdd(self, req: pb.VectorAddRequest) -> pb.VectorAddResponse:
+        resp = pb.VectorAddResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        try:
+            ids = np.asarray([v.vector.id for v in req.vectors], np.int64)
+            vectors = np.asarray(
+                [list(v.vector.values) for v in req.vectors], np.float32
+            )
+            scalars = [convert.scalar_from_pb(v.scalar_data) for v in req.vectors]
+            ts = self.node.storage.vector_add(
+                region, ids, vectors, scalars,
+                is_update=req.is_update, ttl_ms=req.ttl_ms,
+            )
+        except NotLeader as e:
+            return _err(resp, 20001, f"not leader: {e.leader_hint}")
+        except (VectorIndexError, ValueError) as e:
+            return _err(resp, 30001, str(e))
+        resp.ts = ts
+        resp.key_states.extend([True] * len(req.vectors))
+        METRICS.counter("vector_add", region.id).add(len(req.vectors))
+        return resp
+
+    def VectorDelete(self, req: pb.VectorDeleteRequest) -> pb.VectorDeleteResponse:
+        resp = pb.VectorDeleteResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        try:
+            self.node.storage.vector_delete(region, list(req.ids))
+        except NotLeader as e:
+            return _err(resp, 20001, f"not leader: {e.leader_hint}")
+        resp.key_states.extend([True] * len(req.ids))
+        return resp
+
+    def VectorBatchQuery(self, req: pb.VectorBatchQueryRequest):
+        resp = pb.VectorBatchQueryResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        rows = self.node.storage.vector_batch_query(
+            region, list(req.vector_ids),
+            with_vector_data=req.with_vector_data,
+            with_scalar_data=req.with_scalar_data,
+        )
+        for row in rows:
+            out = resp.vectors.add()
+            if row is None:
+                out.vector.id = -1
+                continue
+            out.vector.id = row.id
+            if row.vector is not None:
+                out.vector.values.extend(row.vector.tolist())
+            if row.scalar:
+                convert.scalar_to_pb(out.scalar_data, row.scalar)
+        return resp
+
+    def VectorGetBorderId(self, req: pb.VectorGetBorderIdRequest):
+        resp = pb.VectorGetBorderIdResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        border = self.node.storage.vector_get_border_id(region, req.get_min)
+        resp.id = border if border is not None else -1
+        return resp
+
+    def VectorScanQuery(self, req: pb.VectorScanQueryRequest):
+        resp = pb.VectorScanQueryResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        rows = self.node.storage.vector_scan_query(
+            region,
+            start_id=req.vector_id_start,
+            end_id=req.vector_id_end or None,
+            limit=req.max_scan_count or 1000,
+            is_reverse=req.is_reverse,
+            with_vector_data=req.with_vector_data,
+            with_scalar_data=req.with_scalar_data,
+        )
+        for row in rows:
+            out = resp.vectors.add()
+            out.vector.id = row.id
+            if row.vector is not None:
+                out.vector.values.extend(row.vector.tolist())
+            if row.scalar:
+                convert.scalar_to_pb(out.scalar_data, row.scalar)
+        return resp
+
+    def VectorCount(self, req: pb.VectorCountRequest):
+        resp = pb.VectorCountResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        resp.count = self.node.storage.vector_count(region)
+        return resp
+
+
+class UtilService:
+    """VectorCalcDistance (util service exposure of CalcDistanceEntry,
+    vector_index_utils.h:43-160)."""
+
+    def VectorCalcDistance(self, req: pb.VectorCalcDistanceRequest):
+        from dingo_tpu.ops.distance import (
+            pairwise_cosine,
+            pairwise_inner_product,
+            pairwise_l2sqr,
+        )
+        import jax.numpy as jnp
+
+        resp = pb.VectorCalcDistanceResponse()
+        left = convert.queries_from_pb(req.op_left_vectors)
+        right = convert.queries_from_pb(req.op_right_vectors)
+        if left.size == 0 or right.size == 0:
+            return _err(resp, 30001, "empty operands")
+        metric = {
+            pb.METRIC_TYPE_L2: pairwise_l2sqr,
+            pb.METRIC_TYPE_INNER_PRODUCT: pairwise_inner_product,
+            pb.METRIC_TYPE_COSINE: pairwise_cosine,
+        }.get(req.metric_type, pairwise_l2sqr)
+        d = np.asarray(metric(jnp.asarray(left), jnp.asarray(right)))
+        for row in d:
+            resp.distances.add().values.extend(row.tolist())
+        return resp
+
+
+class StoreService:
+    """KV + txn RPCs (store_service.h)."""
+
+    def __init__(self, node: StoreNode):
+        self.node = node
+
+    def _txn(self, region: Region) -> TxnEngine:
+        return TxnEngine(self.node.engine, region)
+
+    def KvGet(self, req: pb.KvGetRequest) -> pb.KvGetResponse:
+        resp = pb.KvGetResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        value = self.node.storage.kv_get(region, req.key)
+        resp.found = value is not None
+        resp.value = value or b""
+        return resp
+
+    def KvBatchPut(self, req: pb.KvBatchPutRequest) -> pb.KvBatchPutResponse:
+        resp = pb.KvBatchPutResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        try:
+            resp.ts = self.node.storage.kv_put(
+                region, [(kv.key, kv.value) for kv in req.kvs],
+                ttl_ms=req.ttl_ms,
+            )
+        except NotLeader as e:
+            return _err(resp, 20001, f"not leader: {e.leader_hint}")
+        return resp
+
+    def KvBatchDelete(self, req: pb.KvBatchDeleteRequest):
+        resp = pb.KvBatchDeleteResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        try:
+            self.node.storage.kv_batch_delete(region, list(req.keys))
+        except NotLeader as e:
+            return _err(resp, 20001, f"not leader: {e.leader_hint}")
+        return resp
+
+    def KvScan(self, req: pb.KvScanRequest) -> pb.KvScanResponse:
+        resp = pb.KvScanResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        pairs = self.node.storage.kv_scan(
+            region, req.range.start_key, req.range.end_key,
+            limit=req.limit, keys_only=req.keys_only,
+        )
+        for k, v in pairs:
+            kv = resp.kvs.add()
+            kv.key = k
+            kv.value = v
+        return resp
+
+    # ---- txn ----
+    def TxnPrewrite(self, req: pb.TxnPrewriteRequest):
+        resp = pb.TxnPrewriteResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        muts = [
+            Mutation(Op(m.op), m.key, m.value) for m in req.mutations
+        ]
+        try:
+            self._txn(region).prewrite(
+                muts, req.primary_lock, req.start_ts,
+                lock_ttl_ms=req.lock_ttl_ms or 3000,
+                for_update_ts=req.for_update_ts,
+            )
+        except TxnError as e:
+            return _err(resp, 40001, str(e))
+        return resp
+
+    def TxnCommit(self, req: pb.TxnCommitRequest):
+        resp = pb.TxnCommitResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        try:
+            self._txn(region).commit(list(req.keys), req.start_ts, req.commit_ts)
+        except TxnError as e:
+            return _err(resp, 40001, str(e))
+        return resp
+
+    def TxnGet(self, req: pb.TxnGetRequest):
+        resp = pb.TxnGetResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        try:
+            value = self._txn(region).get(req.key, req.start_ts)
+        except TxnError as e:
+            return _err(resp, 40001, str(e))
+        resp.found = value is not None
+        resp.value = value or b""
+        return resp
+
+    def TxnScan(self, req: pb.TxnScanRequest):
+        resp = pb.TxnScanResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        try:
+            pairs = self._txn(region).scan(
+                req.range.start_key, req.range.end_key, req.start_ts,
+                limit=req.limit,
+            )
+        except TxnError as e:
+            return _err(resp, 40001, str(e))
+        for k, v in pairs:
+            kv = resp.kvs.add()
+            kv.key = k
+            kv.value = v
+        return resp
+
+    def TxnBatchRollback(self, req: pb.TxnBatchRollbackRequest):
+        resp = pb.TxnBatchRollbackResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        try:
+            self._txn(region).batch_rollback(list(req.keys), req.start_ts)
+        except TxnError as e:
+            return _err(resp, 40001, str(e))
+        return resp
+
+    def TxnCheckStatus(self, req: pb.TxnCheckStatusRequest):
+        resp = pb.TxnCheckStatusResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        st = self._txn(region).check_txn_status(
+            req.primary_key, req.lock_ts, req.caller_start_ts
+        )
+        resp.action = st["action"]
+        resp.commit_ts = st["commit_ts"]
+        return resp
+
+
+class NodeService:
+    def __init__(self, node: StoreNode):
+        self.node = node
+
+    def NodeInfo(self, req: pb.NodeInfoRequest) -> pb.NodeInfoResponse:
+        resp = pb.NodeInfoResponse()
+        resp.store_id = self.node.store_id
+        regions = self.node.meta.get_all_regions()
+        resp.region_ids.extend(r.id for r in regions)
+        resp.leader_region_ids.extend(
+            r.id for r in regions
+            if (n := self.node.engine.get_node(r.id)) is not None
+            and n.is_leader()
+        )
+        return resp
+
+
+class DebugService:
+    def MetricsDump(self, req: pb.MetricsDumpRequest) -> pb.MetricsDumpResponse:
+        resp = pb.MetricsDumpResponse()
+        resp.json = json.dumps(METRICS.dump())
+        return resp
+
+    def FailPoint(self, req: pb.FailPointRequest) -> pb.FailPointResponse:
+        resp = pb.FailPointResponse()
+        try:
+            if req.remove:
+                FAILPOINTS.remove(req.name)
+            else:
+                FAILPOINTS.configure(req.name, req.config)
+        except ValueError as e:
+            return _err(resp, 50001, str(e))
+        return resp
+
+
+class CoordinatorService:
+    def __init__(self, control: CoordinatorControl, tso: TsoControl):
+        self.control = control
+        self.tso = tso
+
+    def Hello(self, req: pb.HelloRequest) -> pb.HelloResponse:
+        resp = pb.HelloResponse()
+        resp.store_count = len(self.control.stores)
+        resp.region_count = len(self.control.regions)
+        return resp
+
+    def StoreHeartbeat(self, req: pb.StoreHeartbeatRequest):
+        resp = pb.StoreHeartbeatResponse()
+        cmds = self.control.store_heartbeat(
+            req.store_id,
+            region_ids=list(req.region_ids),
+            leader_region_ids=list(req.leader_region_ids),
+            capacity_bytes=req.capacity_bytes,
+            used_bytes=req.used_bytes,
+            region_defs=[
+                convert.region_def_from_pb(d) for d in req.region_definitions
+            ],
+        )
+        for c in cmds:
+            out = resp.commands.add()
+            out.cmd_id = c.cmd_id
+            out.region_id = c.region_id
+            out.cmd_type = c.cmd_type.value
+            out.split_key = c.split_key
+            out.child_region_id = c.child_region_id
+            out.target_store_id = c.target_store_id
+            if c.definition is not None:
+                out.definition.CopyFrom(convert.region_def_to_pb(c.definition))
+        return resp
+
+    def CreateRegion(self, req: pb.CreateRegionRequest):
+        resp = pb.CreateRegionResponse()
+        try:
+            d = self.control.create_region(
+                start_key=req.range.start_key,
+                end_key=req.range.end_key,
+                partition_id=req.partition_id,
+                region_type=[RegionType.STORE, RegionType.INDEX,
+                             RegionType.DOCUMENT][req.region_type],
+                index_parameter=convert.index_parameter_from_pb(
+                    req.index_parameter
+                ),
+                replication=req.replication or None,
+            )
+        except RuntimeError as e:
+            return _err(resp, 60001, str(e))
+        resp.definition.CopyFrom(convert.region_def_to_pb(d))
+        return resp
+
+    def SplitRegion(self, req: pb.SplitRegionRequest):
+        resp = pb.SplitRegionResponse()
+        try:
+            resp.child_region_id = self.control.split_region(
+                req.region_id, req.split_key
+            )
+        except (KeyError, ValueError) as e:
+            return _err(resp, 60002, str(e))
+        return resp
+
+    def GetRegionMap(self, req: pb.GetRegionMapRequest):
+        resp = pb.GetRegionMapResponse()
+        for d in self.control.regions.values():
+            resp.regions.add().CopyFrom(convert.region_def_to_pb(d))
+        return resp
+
+    def Tso(self, req: pb.TsoRequest) -> pb.TsoResponse:
+        resp = pb.TsoResponse()
+        first, count = self.tso.gen_ts(req.count or 1)
+        resp.first_ts = first
+        resp.count = count
+        return resp
+
+
+class VersionService:
+    """etcd-like KV (version_service.cc analog over KvControl)."""
+
+    def __init__(self, kv: KvControl):
+        self.kv = kv
+
+    def VKvPut(self, req: pb.VKvPutRequest) -> pb.VKvPutResponse:
+        resp = pb.VKvPutResponse()
+        try:
+            resp.revision = self.kv.kv_put(req.key, req.value, req.lease_id)
+        except KeyError as e:
+            return _err(resp, 70001, str(e))
+        return resp
+
+    def VKvRange(self, req: pb.VKvRangeRequest) -> pb.VKvRangeResponse:
+        resp = pb.VKvRangeResponse()
+        items, rev = self.kv.kv_range(
+            req.start, req.end or None, limit=req.limit
+        )
+        resp.revision = rev
+        for it in items:
+            o = resp.items.add()
+            o.key = it.key
+            o.value = it.value
+            o.create_revision = it.create_revision
+            o.mod_revision = it.mod_revision
+            o.version = it.version
+        return resp
+
+    def LeaseGrant(self, req: pb.LeaseGrantRequest) -> pb.LeaseGrantResponse:
+        resp = pb.LeaseGrantResponse()
+        resp.lease_id = self.kv.lease_grant(req.ttl_s).lease_id
+        return resp

@@ -184,7 +184,10 @@ class StoreNode:
                 else:
                     cmd.status = "pending"
             except Exception as e:  # noqa: BLE001
-                cmd.status = f"error: {e}"
+                # transient failures retry on later heartbeats; give up
+                # after a budget so poison commands don't loop forever
+                cmd.retries += 1
+                cmd.status = "pending" if cmd.retries < 5 else f"error: {e}"
         return cmds
 
     def start_heartbeat(self, interval_s: float = 1.0) -> None:

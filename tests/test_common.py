@@ -1,0 +1,157 @@
+"""Common runtime tests: config/flags, crontab, failpoints, tracker,
+metrics, streams, worker sets (reference test/unit_test/common + misc)."""
+
+import time
+
+import pytest
+
+from dingo_tpu.common.config import FLAGS, Config, FlagRegistry
+from dingo_tpu.common.crontab import CrontabManager
+from dingo_tpu.common.failpoint import (
+    FailPointError,
+    FailPointManager,
+)
+from dingo_tpu.common.metrics import MetricsRegistry
+from dingo_tpu.common.runnable import WorkerSet
+from dingo_tpu.common.stream import StreamManager
+from dingo_tpu.common.tracker import Tracker
+
+
+def test_flags_defaults_and_mutability():
+    flags = FlagRegistry()
+    flags.define("a", 5)
+    flags.define("b", 10, mutable=True)
+    assert flags.get("a") == 5
+    with pytest.raises(PermissionError):
+        flags.set("a", 6)
+    flags.set("b", 20)
+    assert flags.get("b") == 20
+    flags.set("a", 7, boot=True)  # boot-time override allowed
+    assert flags.get("a") == 7
+
+
+def test_reference_limit_flags_present():
+    assert FLAGS.get("vector_max_batch_count") == 4096
+    assert FLAGS.get("vector_index_bruteforce_batch_count") == 2048
+
+
+def test_config_file_and_overrides(tmp_path):
+    p = tmp_path / "index.conf"
+    p.write_text(
+        "# role config\n"
+        "server.heartbeat_interval_s = 3\n"
+        "vector.index_path = /tmp/idx\n"
+        "raft.snapshot_threshold = 500\n"
+        "flag.bool = true\n"
+    )
+    cfg = Config.load(str(p))
+    assert cfg.get_int("server.heartbeat_interval_s") == 3
+    assert cfg.get("vector.index_path") == "/tmp/idx"
+    assert cfg.get_bool("flag.bool")
+    assert cfg.get("missing", "dflt") == "dflt"
+    flags = FlagRegistry()
+    flags.define("server_heartbeat_interval_s", 10)
+    n = cfg.apply_flag_overrides(flags)
+    assert n >= 1 and flags.get("server_heartbeat_interval_s") == 3
+
+
+def test_crontab_runs_and_counts():
+    mgr = CrontabManager(tick_s=0.01)
+    hits = []
+    mgr.add("fast", 0.02, lambda: hits.append(1), immediately=True)
+    mgr.add("boom", 0.02, lambda: 1 / 0, immediately=True)
+    for _ in range(5):
+        mgr.run_pending()
+        time.sleep(0.025)
+    stats = mgr.stats()
+    assert stats["fast"]["runs"] >= 3
+    assert stats["boom"]["errors"] >= 3
+    mgr.remove("fast")
+    assert "fast" not in mgr.stats()
+
+
+def test_failpoint_actions():
+    fps = FailPointManager()
+    fps.configure("p1", "panic")
+    with pytest.raises(FailPointError):
+        fps.apply("p1")
+    fps.configure("limited", "100%2*panic")
+    for _ in range(2):
+        with pytest.raises(FailPointError):
+            fps.apply("limited")
+    fps.apply("limited")  # budget exhausted: no-op
+    fps.configure("never", "0%panic")
+    fps.apply("never")
+    fps.remove("p1")
+    fps.apply("p1")
+    assert "limited" in fps.list()
+
+
+def test_tracker_spans():
+    t = Tracker()
+    with t.span("raft_commit"):
+        time.sleep(0.01)
+    with t.span("store_write"):
+        time.sleep(0.005)
+    rep = t.report()
+    assert rep["raft_commit"] >= 9_000       # us
+    assert rep["store_write"] >= 4_000
+    assert rep["total_us"] >= rep["raft_commit"]
+
+
+def test_metrics_registry():
+    m = MetricsRegistry()
+    m.counter("req", region_id=7).add(3)
+    m.gauge("cap").set(0.5)
+    with m.latency("search", region_id=7).time():
+        time.sleep(0.002)
+    dump = m.dump()
+    assert dump["req{region=7}"] == 3
+    assert dump["cap"] == 0.5
+    assert dump["search{region=7}"]["count"] == 1
+    assert dump["search{region=7}"]["p99_us"] >= 1500
+
+
+def test_stream_paging():
+    sm = StreamManager(idle_timeout_s=0.05)
+    s = sm.open(iter(range(25)), limit=10)
+    page1, more1 = s.next_page()
+    assert page1 == list(range(10)) and more1
+    page2, more2 = s.next_page()
+    page3, more3 = s.next_page()
+    assert page3 == list(range(20, 25)) and not more3
+    assert sm.get(s.id) is s
+    # finished streams are recycled
+    assert sm.recycle_idle() == 1
+    assert sm.get(s.id) is None
+    # idle timeout recycles unfinished streams
+    s2 = sm.open(iter(range(100)), limit=1)
+    time.sleep(0.07)
+    assert sm.recycle_idle() == 1
+
+
+def test_worker_set_policies():
+    ws = WorkerSet("t", workers=3)
+    import threading
+
+    done = []
+    lock = threading.Lock()
+
+    def task(i):
+        def run():
+            with lock:
+                done.append(i)
+        return run
+
+    for i in range(30):
+        ws.execute_least_queue(task(i))
+    for i in range(30, 40):
+        ws.execute_hash(7, task(i))   # same key -> same worker, ordered
+    deadline = time.monotonic() + 3
+    while len(done) < 40 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(done) == 40
+    # hash dispatch preserved ordering for the same key
+    hash_part = [i for i in done if i >= 30]
+    assert hash_part == sorted(hash_part)
+    ws.stop()

@@ -1,0 +1,172 @@
+"""End-to-end grpc: coordinator server + 3 store servers + client SDK —
+the full reference topology (client -> brpc -> services -> storage) in one
+process over real sockets."""
+
+import time
+
+import numpy as np
+import pytest
+
+from dingo_tpu.coordinator.control import CoordinatorControl
+from dingo_tpu.coordinator.kv_control import KvControl
+from dingo_tpu.coordinator.tso import TsoControl
+from dingo_tpu.client import DingoClient
+from dingo_tpu.engine.raw_engine import MemEngine
+from dingo_tpu.raft import LocalTransport
+from dingo_tpu.server import pb
+from dingo_tpu.server.rpc import DingoServer
+from dingo_tpu.store.node import StoreNode
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    transport = LocalTransport()
+    meta_engine = MemEngine()
+    control = CoordinatorControl(meta_engine, replication=3)
+    tso = TsoControl(meta_engine)
+    kv_control = KvControl(meta_engine)
+
+    coord_server = DingoServer()
+    coord_server.host_coordinator_role(control, tso, kv_control)
+    coord_port = coord_server.start()
+
+    nodes, servers, addrs = {}, [], {}
+    for i, sid in enumerate(["s0", "s1", "s2"]):
+        node = StoreNode(sid, transport, control, raft_kw={"seed": i})
+        server = DingoServer()
+        server.host_store_role(node)
+        port = server.start()
+        node.start_heartbeat(0.1)
+        nodes[sid] = node
+        servers.append(server)
+        addrs[sid] = f"127.0.0.1:{port}"
+
+    client = DingoClient(f"127.0.0.1:{coord_port}", addrs)
+    yield client, control, nodes
+    client.close()
+    for s in servers:
+        s.stop()
+    coord_server.stop()
+    for n in nodes.values():
+        n.stop()
+
+
+def test_hello_and_region_lifecycle(cluster):
+    client, control, nodes = cluster
+    resp = client.coordinator.Hello(pb.HelloRequest())
+    assert resp.store_count == 3
+
+    param = pb.VectorIndexParameter(
+        index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=16,
+        metric_type=pb.METRIC_TYPE_L2,
+    )
+    definition = client.create_index_region(0, 0, 1 << 40, param)
+    time.sleep(1.0)  # heartbeats create + elect
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((200, 16)).astype(np.float32)
+    client.vector_add(0, list(range(200)), x,
+                      [{"tag": i % 3} for i in range(200)])
+    assert client.vector_count(0) == 200
+
+    res = client.vector_search(0, x[:4], topk=5)
+    assert [row[0][0] for row in res] == [0, 1, 2, 3]
+    assert res[0][0][1] == pytest.approx(0.0, abs=1e-3)
+
+
+def test_search_across_split_regions(cluster):
+    client, control, nodes = cluster
+    # split the partition's region; scatter-gather must still find everything
+    client.refresh_region_map()
+    region = next(d for d in client._regions if d.index_parameter is not None)
+    client.split_region(region.region_id, 100)
+    time.sleep(1.2)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((200, 16)).astype(np.float32)
+    res = client.vector_search(0, x[[50, 150]], topk=3)
+    assert res[0][0][0] == 50
+    assert res[1][0][0] == 150
+    assert client.vector_count(0) == 200
+
+
+def test_kv_and_tso_and_version(cluster):
+    client, control, nodes = cluster
+    # KV region over raw byte keyspace
+    req = pb.CreateRegionRequest()
+    req.range.start_key = b"a"
+    req.range.end_key = b"z"
+    resp = client.coordinator.CreateRegion(req)
+    assert resp.error.errcode == 0
+    time.sleep(1.0)
+    client.kv_put(b"hello", b"world")
+    assert client.kv_get(b"hello") == b"world"
+    assert client.kv_get(b"missing") is None
+
+    ts1, ts2 = client.tso(), client.tso()
+    assert ts2 > ts1
+
+    r = client.version.VKvPut(pb.VKvPutRequest(key=b"/cfg", value=b"1"))
+    assert r.revision > 0
+    rng_resp = client.version.VKvRange(pb.VKvRangeRequest(start=b"/cfg"))
+    assert rng_resp.items[0].value == b"1"
+
+
+def test_node_and_debug_services(cluster):
+    client, control, nodes = cluster
+    stub = client._stub("s0", "NodeService")
+    info = stub.NodeInfo(pb.NodeInfoRequest())
+    assert info.store_id == "s0" and len(info.region_ids) >= 1
+
+    dbg = client._stub("s0", "DebugService")
+    dump = dbg.MetricsDump(pb.MetricsDumpRequest())
+    assert "vector_add" in dump.json
+    fp = dbg.FailPoint(pb.FailPointRequest(name="x", config="panic"))
+    assert fp.error.errcode == 0
+    fp2 = dbg.FailPoint(pb.FailPointRequest(name="x", remove=True))
+    assert fp2.error.errcode == 0
+
+
+def test_txn_over_grpc(cluster):
+    client, control, nodes = cluster
+    client.refresh_region_map()
+    kv_region = next(d for d in client._regions
+                     if d.start_key == b"a" and d.index_parameter is None)
+    stub_owner = None
+    start_ts = client.tso()
+    req = pb.TxnPrewriteRequest()
+    req.context.region_id = kv_region.region_id
+    m = req.mutations.add()
+    m.op = "put"
+    m.key = b"txnkey"
+    m.value = b"txnval"
+    req.primary_lock = b"txnkey"
+    req.start_ts = start_ts
+    resp = client._call_leader(kv_region, "StoreService", "TxnPrewrite", req)
+    assert resp.error.errcode == 0
+
+    commit = pb.TxnCommitRequest()
+    commit.context.region_id = kv_region.region_id
+    commit.keys.append(b"txnkey")
+    commit.start_ts = start_ts
+    commit.commit_ts = client.tso()
+    resp = client._call_leader(kv_region, "StoreService", "TxnCommit", commit)
+    assert resp.error.errcode == 0
+
+    get = pb.TxnGetRequest()
+    get.context.region_id = kv_region.region_id
+    get.key = b"txnkey"
+    get.start_ts = client.tso()
+    resp = client._call_leader(kv_region, "StoreService", "TxnGet", get)
+    assert resp.found and resp.value == b"txnval"
+
+
+def test_calc_distance_util(cluster):
+    client, control, nodes = cluster
+    stub = client._stub("s0", "UtilService")
+    req = pb.VectorCalcDistanceRequest(metric_type=pb.METRIC_TYPE_L2)
+    a = req.op_left_vectors.add()
+    a.values.extend([1.0, 0.0])
+    b = req.op_right_vectors.add()
+    b.values.extend([0.0, 1.0])
+    resp = stub.VectorCalcDistance(req)
+    assert resp.distances[0].values[0] == pytest.approx(2.0, abs=1e-4)
