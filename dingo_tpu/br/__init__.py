@@ -1,0 +1,5 @@
+"""BR: backup & restore tool (reference src/br/, 20.7K LoC — backs up
+coordinator meta + per-region data via SST export, restores via ingest,
+fanning RPCs to all stores through an InteractionManager)."""
+
+from dingo_tpu.br.backup import backup_cluster, restore_cluster  # noqa: F401

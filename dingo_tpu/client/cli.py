@@ -1,0 +1,205 @@
+"""Operator CLI.
+
+Reference: src/client_v2/ (25K LoC CLI11-based interactive CLI with
+subcommand groups coordinator/meta/kv/store/vector_index/document_index/
+dump/restore/tools) + src/client/ (legacy). This covers the operator
+surface over the grpc services: cluster introspection, region ops, vector
+and kv exercisers, debug (metrics, failpoints), with an interactive REPL.
+
+Usage:
+    python -m dingo_tpu.client.cli --coordinator HOST:PORT \
+        --store s0=HOST:PORT [--store s1=...] <group> <command> [args]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from dingo_tpu.client.client import DingoClient
+from dingo_tpu.server import pb
+
+_ITYPES = {
+    "flat": pb.VECTOR_INDEX_TYPE_FLAT,
+    "ivf_flat": pb.VECTOR_INDEX_TYPE_IVF_FLAT,
+    "ivf_pq": pb.VECTOR_INDEX_TYPE_IVF_PQ,
+    "hnsw": pb.VECTOR_INDEX_TYPE_HNSW,
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dingo-cli")
+    p.add_argument("--coordinator", default="127.0.0.1:20001")
+    p.add_argument("--store", action="append", default=[],
+                   help="store_id=host:port (repeatable)")
+    sub = p.add_subparsers(dest="group")
+
+    coord = sub.add_parser("coordinator").add_subparsers(dest="cmd")
+    coord.add_parser("hello")
+    coord.add_parser("region-map")
+    tso = coord.add_parser("tso")
+    tso.add_argument("--count", type=int, default=1)
+
+    region = sub.add_parser("region").add_subparsers(dest="cmd")
+    create = region.add_parser("create-index")
+    create.add_argument("--partition", type=int, default=0)
+    create.add_argument("--id-lo", type=int, default=0)
+    create.add_argument("--id-hi", type=int, default=1 << 40)
+    create.add_argument("--type", choices=sorted(_ITYPES), default="flat")
+    create.add_argument("--dim", type=int, required=True)
+    split = region.add_parser("split")
+    split.add_argument("--region", type=int, required=True)
+    split.add_argument("--at", type=int, required=True)
+    split.add_argument("--partition", type=int, default=0)
+
+    vec = sub.add_parser("vector").add_subparsers(dest="cmd")
+    vadd = vec.add_parser("add-random")
+    vadd.add_argument("--partition", type=int, default=0)
+    vadd.add_argument("--count", type=int, default=100)
+    vadd.add_argument("--dim", type=int, required=True)
+    vadd.add_argument("--start-id", type=int, default=0)
+    vsearch = vec.add_parser("search-random")
+    vsearch.add_argument("--partition", type=int, default=0)
+    vsearch.add_argument("--dim", type=int, required=True)
+    vsearch.add_argument("--topk", type=int, default=5)
+    vcount = vec.add_parser("count")
+    vcount.add_argument("--partition", type=int, default=0)
+
+    kv = sub.add_parser("kv").add_subparsers(dest="cmd")
+    kput = kv.add_parser("put")
+    kput.add_argument("key")
+    kput.add_argument("value")
+    kget = kv.add_parser("get")
+    kget.add_argument("key")
+
+    dbg = sub.add_parser("debug").add_subparsers(dest="cmd")
+    met = dbg.add_parser("metrics")
+    met.add_argument("--store", dest="target_store", required=True)
+    fp = dbg.add_parser("failpoint")
+    fp.add_argument("--store", dest="target_store", required=True)
+    fp.add_argument("name")
+    fp.add_argument("config", nargs="?", default="")
+    fp.add_argument("--remove", action="store_true")
+
+    node = sub.add_parser("node").add_subparsers(dest="cmd")
+    ninfo = node.add_parser("info")
+    ninfo.add_argument("--store", dest="target_store", required=True)
+
+    sub.add_parser("repl")
+    return p
+
+
+def run_command(client: DingoClient, args) -> int:
+    g, c = args.group, getattr(args, "cmd", None)
+    if g == "coordinator" and c == "hello":
+        r = client.coordinator.Hello(pb.HelloRequest())
+        print(json.dumps({"stores": r.store_count, "regions": r.region_count}))
+    elif g == "coordinator" and c == "region-map":
+        client.refresh_region_map()
+        for d in client._regions:
+            print(json.dumps({
+                "region_id": d.region_id,
+                "partition": d.partition_id,
+                "peers": d.peers,
+                "epoch": d.epoch.as_tuple(),
+                "index": d.index_parameter.index_type.value
+                if d.index_parameter else None,
+            }))
+    elif g == "coordinator" and c == "tso":
+        print(client.tso(args.count))
+    elif g == "region" and c == "create-index":
+        param = pb.VectorIndexParameter(
+            index_type=_ITYPES[args.type], dimension=args.dim,
+            metric_type=pb.METRIC_TYPE_L2,
+        )
+        d = client.create_index_region(args.partition, args.id_lo,
+                                       args.id_hi, param)
+        print(json.dumps({"region_id": d.region_id, "peers": d.peers}))
+    elif g == "region" and c == "split":
+        child = client.split_region(args.region, args.at, args.partition)
+        print(json.dumps({"child_region_id": child}))
+    elif g == "vector" and c == "add-random":
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((args.count, args.dim)).astype(np.float32)
+        ids = list(range(args.start_id, args.start_id + args.count))
+        client.vector_add(args.partition, ids, x)
+        print(json.dumps({"added": args.count}))
+    elif g == "vector" and c == "search-random":
+        rng = np.random.default_rng(1)
+        q = rng.standard_normal((1, args.dim)).astype(np.float32)
+        res = client.vector_search(args.partition, q, topk=args.topk)
+        print(json.dumps([[int(i), float(d)] for i, d in res[0]]))
+    elif g == "vector" and c == "count":
+        print(client.vector_count(args.partition))
+    elif g == "kv" and c == "put":
+        client.kv_put(args.key.encode(), args.value.encode())
+        print("OK")
+    elif g == "kv" and c == "get":
+        v = client.kv_get(args.key.encode())
+        print(v.decode() if v is not None else "(nil)")
+    elif g == "debug" and c == "metrics":
+        stub = client._stub(args.target_store, "DebugService")
+        print(stub.MetricsDump(pb.MetricsDumpRequest()).json)
+    elif g == "debug" and c == "failpoint":
+        stub = client._stub(args.target_store, "DebugService")
+        r = stub.FailPoint(pb.FailPointRequest(
+            name=args.name, config=args.config, remove=args.remove))
+        print("OK" if r.error.errcode == 0 else r.error.errmsg)
+    elif g == "node" and c == "info":
+        stub = client._stub(args.target_store, "NodeService")
+        r = stub.NodeInfo(pb.NodeInfoRequest())
+        print(json.dumps({
+            "store_id": r.store_id,
+            "regions": list(r.region_ids),
+            "leader_regions": list(r.leader_region_ids),
+        }))
+    elif g == "repl":
+        return run_repl(client)
+    else:
+        print("unknown command", file=sys.stderr)
+        return 2
+    return 0
+
+
+def run_repl(client: DingoClient) -> int:
+    """Interactive mode (client_v2 REPL analog)."""
+    parser = build_parser()
+    print("dingo-cli repl — 'exit' to quit")
+    while True:
+        try:
+            line = input("dingo> ").strip()
+        except EOFError:
+            return 0
+        if line in ("exit", "quit"):
+            return 0
+        if not line:
+            continue
+        try:
+            args = parser.parse_args(shlex.split(line))
+            run_command(client, args)
+        except SystemExit:
+            pass
+        except Exception as e:  # noqa: BLE001
+            print(f"error: {e}")
+
+
+def main(argv: List[str] = None) -> int:
+    args = build_parser().parse_args(argv)
+    stores: Dict[str, str] = {}
+    for spec in args.store:
+        sid, _, addr = spec.partition("=")
+        stores[sid] = addr
+    client = DingoClient(args.coordinator, stores)
+    try:
+        return run_command(client, args)
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -326,6 +326,42 @@ class CoordinatorControl:
             ))
             return child_id
 
+    def merge_region(self, target_region_id: int,
+                     source_region_id: int) -> None:
+        """MergeRegionWithJob (:309): queue MERGE to the target's leader
+        (regions must be adjacent with co-located peers)."""
+        with self._lock:
+            target = self.regions.get(target_region_id)
+            source = self.regions.get(source_region_id)
+            if target is None or source is None:
+                raise KeyError("unknown region")
+            if target.end_key != source.start_key:
+                raise ValueError("regions not adjacent (target must precede)")
+            if set(target.peers) != set(source.peers):
+                raise ValueError("merge requires co-located peers")
+            leader = self.region_leaders.get(target_region_id,
+                                             target.peers[0])
+            cmd = RegionCmd(
+                cmd_id=self._next_cmd(), region_id=target_region_id,
+                cmd_type=RegionCmdType.MERGE,
+                child_region_id=source_region_id,
+            )
+            self._queue_cmd(leader, cmd)
+
+    def on_region_merge_done(self, target_id: int, source_id: int,
+                             target_def) -> None:
+        with self._lock:
+            self.regions.pop(source_id, None)
+            self.region_leaders.pop(source_id, None)
+            for q in self.store_ops.values():
+                q[:] = [c for c in q if c.region_id != source_id]
+            self.engine.delete(
+                CF_META, _PREFIX_REGION + str(source_id).encode()
+            )
+            self.regions[target_id] = target_def
+            self._persist(_PREFIX_REGION + str(target_id).encode(), target_def)
+            self._persist_ops()
+
     def on_region_split_done(
         self, parent_id: int, child: RegionDefinition
     ) -> None:

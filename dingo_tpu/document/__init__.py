@@ -1,0 +1,10 @@
+"""Document subsystem: per-region full-text index.
+
+Mirrors reference src/document/ (DocumentIndex over the vendored Rust
+tantivy-search, document_index.h; DocumentIndexManager; DocumentReader).
+No Rust exists in this image, so the index is an original BM25 inverted
+index (documents are also persisted in the engine; the index is an
+apply-log-tracked materialized view exactly like the vector index).
+"""
+
+from dingo_tpu.document.index import DocumentIndex  # noqa: F401

@@ -1,0 +1,167 @@
+"""DocumentIndex: BM25 inverted index with typed fields.
+
+Reference: src/document/document_index.h wraps tantivy (tokenized text
+fields + i64/f64/bytes columns; queries are boolean text matches with
+optional column filters). This is an original implementation covering that
+surface: tokenization, postings with term frequencies, BM25 ranking,
+AND/OR boolean modes, column (scalar) filters, delete/upsert, save/load.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import pickle
+import re
+import threading
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_TOKEN_RE = re.compile(r"[a-z0-9]+")
+
+BM25_K1 = 1.2
+BM25_B = 0.75
+
+
+def tokenize(text: str) -> List[str]:
+    return _TOKEN_RE.findall(text.lower())
+
+
+class DocumentIndex:
+    def __init__(self, index_id: int, text_fields: Sequence[str] = ("text",)):
+        self.id = index_id
+        self.text_fields = list(text_fields)
+        self._lock = threading.RLock()
+        #: term -> {doc_id: tf}
+        self._postings: Dict[str, Dict[int, int]] = defaultdict(dict)
+        #: doc_id -> (doc dict, token_count)
+        self._docs: Dict[int, Tuple[Dict[str, Any], int]] = {}
+        self._total_tokens = 0
+        self.apply_log_id = 0
+
+    # ---------------- mutation ----------------
+    def add(self, doc_id: int, doc: Dict[str, Any]) -> None:
+        with self._lock:
+            if doc_id in self._docs:
+                self._remove_unlocked(doc_id)
+            tokens: List[str] = []
+            for field in self.text_fields:
+                value = doc.get(field)
+                if isinstance(value, str):
+                    tokens.extend(tokenize(value))
+            for tok in tokens:
+                self._postings[tok][doc_id] = self._postings[tok].get(doc_id, 0) + 1
+            self._docs[doc_id] = (dict(doc), len(tokens))
+            self._total_tokens += len(tokens)
+
+    upsert = add
+
+    def delete(self, doc_ids: Sequence[int]) -> int:
+        with self._lock:
+            n = 0
+            for did in doc_ids:
+                if did in self._docs:
+                    self._remove_unlocked(int(did))
+                    n += 1
+            return n
+
+    def _remove_unlocked(self, doc_id: int) -> None:
+        doc, ntok = self._docs.pop(doc_id)
+        self._total_tokens -= ntok
+        for field in self.text_fields:
+            value = doc.get(field)
+            if isinstance(value, str):
+                for tok in set(tokenize(value)):
+                    entry = self._postings.get(tok)
+                    if entry is not None:
+                        entry.pop(doc_id, None)
+                        if not entry:
+                            del self._postings[tok]
+
+    # ---------------- search ----------------
+    def search(
+        self,
+        query: str,
+        topk: int = 10,
+        mode: str = "or",
+        column_filter: Optional[Dict[str, Any]] = None,
+    ) -> List[Tuple[int, float]]:
+        """BM25-ranked (doc_id, score), best first. mode: 'or'|'and'."""
+        terms = tokenize(query)
+        if not terms:
+            return []
+        with self._lock:
+            n_docs = len(self._docs)
+            if n_docs == 0:
+                return []
+            avg_len = self._total_tokens / n_docs
+            scores: Dict[int, float] = defaultdict(float)
+            for term in terms:
+                postings = self._postings.get(term)
+                if not postings:
+                    continue
+                idf = math.log(1 + (n_docs - len(postings) + 0.5)
+                               / (len(postings) + 0.5))
+                for did, tf in postings.items():
+                    dlen = self._docs[did][1] or 1
+                    denom = tf + BM25_K1 * (
+                        1 - BM25_B + BM25_B * dlen / max(avg_len, 1e-9)
+                    )
+                    scores[did] += idf * tf * (BM25_K1 + 1) / denom
+            hits = scores.items()
+            if mode == "and":
+                need = len(set(terms))
+                uniq_matched: Dict[int, set] = defaultdict(set)
+                for term in set(terms):
+                    for did in self._postings.get(term, {}):
+                        uniq_matched[did].add(term)
+                hits = [
+                    (did, sc) for did, sc in scores.items()
+                    if len(uniq_matched.get(did, ())) >= need
+                ]
+            if column_filter:
+                hits = [
+                    (did, sc) for did, sc in hits
+                    if all(self._docs[did][0].get(k) == v
+                           for k, v in column_filter.items())
+                ]
+            return sorted(hits, key=lambda t: -t[1])[:topk]
+
+    def get(self, doc_id: int) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            entry = self._docs.get(doc_id)
+            return entry[0] if entry else None
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._docs)
+
+    # ---------------- persistence ----------------
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        with self._lock:
+            blob = pickle.dumps({
+                "postings": dict(self._postings),
+                "docs": self._docs,
+                "total_tokens": self._total_tokens,
+            }, protocol=4)
+        with open(os.path.join(path, "document.idx"), "wb") as f:
+            f.write(blob)
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump({
+                "text_fields": self.text_fields,
+                "apply_log_id": self.apply_log_id,
+            }, f)
+
+    def load(self, path: str) -> None:
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        with open(os.path.join(path, "document.idx"), "rb") as f:
+            state = pickle.loads(f.read())
+        with self._lock:
+            self.text_fields = meta["text_fields"]
+            self.apply_log_id = meta["apply_log_id"]
+            self._postings = defaultdict(dict, state["postings"])
+            self._docs = state["docs"]
+            self._total_tokens = state["total_tokens"]

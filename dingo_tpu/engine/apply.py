@@ -48,6 +48,11 @@ def apply_write(
             )
         context.handle_split(region, data, log_id)
         return
+    if isinstance(data, wd.MergeRegionData):
+        if context is None:
+            raise NotImplementedError("region merge needs a StoreNode context")
+        context.handle_merge(region, data, log_id)
+        return
     if isinstance(data, wd.KvPutData):
         _apply_kv_put(engine, data)
     elif isinstance(data, wd.KvDeleteData):

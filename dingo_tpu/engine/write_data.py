@@ -84,6 +84,16 @@ class SplitRegionData:
 
 
 @dataclasses.dataclass
+class MergeRegionData:
+    """CommitMergeHandler payload (raft_apply_handler.cc:78-99,1021):
+    target absorbs the source region's range; the source's in-memory index
+    becomes the target's sibling until the target rebuilds."""
+
+    source_region_id: int
+    source_end_key: bytes
+
+
+@dataclasses.dataclass
 class TxnRaftData:
     """TxnHandler payload (raft_apply_handler_txn.cc): pre-encoded CF writes
     produced by the Percolator helper (engine/txn.py)."""

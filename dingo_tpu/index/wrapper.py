@@ -16,6 +16,7 @@ from typing import List, Optional
 
 import numpy as np
 
+
 from dingo_tpu.index.base import (
     FilterSpec,
     IndexParameter,
@@ -24,6 +25,14 @@ from dingo_tpu.index.base import (
     VectorIndexError,
 )
 from dingo_tpu.index.factory import new_index
+from dingo_tpu.ops.distance import metric_ascending
+
+
+def _merge_results(a: SearchResult, b: SearchResult, topk: int, metric):
+    ids = np.concatenate([a.ids, b.ids])
+    d = np.concatenate([a.distances, b.distances])
+    order = np.argsort(d if metric_ascending(metric) else -d)[:topk]
+    return SearchResult(ids[order], d[order])
 
 
 class VectorIndexWrapper:
@@ -113,6 +122,11 @@ class VectorIndexWrapper:
                 idx.upsert(ids, vectors)
             else:
                 idx.add(ids, vectors)
+            # post-merge: purge absorbed-range versions from the sibling so
+            # search's sibling merge can't resurrect stale vectors
+            sib = self.sibling_index.active() if self.sibling_index else None
+            if sib is not None and sib is not idx:
+                sib.delete(ids)
             if log_id:
                 self.apply_log_id = log_id
                 if idx is self.own_index:
@@ -129,6 +143,9 @@ class VectorIndexWrapper:
             if log_id != 0 and log_id <= self.apply_log_id:
                 return
             idx.delete(ids)
+            sib = self.sibling_index.active() if self.sibling_index else None
+            if sib is not None and sib is not idx:
+                sib.delete(ids)
             if log_id:
                 self.apply_log_id = log_id
                 if idx is self.own_index:
@@ -146,7 +163,17 @@ class VectorIndexWrapper:
         idx = self.active()
         if idx is None:
             raise VectorIndexError(f"vector index {self.id} not ready")
-        return idx.search(queries, topk, filter_spec, **kw)
+        results = idx.search(queries, topk, filter_spec, **kw)
+        sibling = self.sibling_index
+        if sibling is not None and sibling.active() is not None:
+            # post-merge: the absorbed region's index serves its id range
+            # until the target rebuild covers it (CommitMerge sibling flow)
+            other = sibling.active().search(queries, topk, filter_spec, **kw)
+            results = [
+                _merge_results(a, b, topk, self.parameter.metric)
+                for a, b in zip(results, other)
+            ]
+        return results
 
     # -- policies --------------------------------------------------------------
     def need_to_save(self) -> bool:

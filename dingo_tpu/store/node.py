@@ -141,6 +141,69 @@ class StoreNode:
         if self.coordinator is not None and node is not None and node.is_leader():
             self.coordinator.on_region_split_done(parent.id, child_def)
 
+    def propose_merge(self, target_region_id: int,
+                      source_region_id: int) -> None:
+        """MergeRegionTask: propose on the TARGET region's raft; applied on
+        every replica via handle_merge (peers must be co-located — the
+        coordinator aligns peers via change_peer first, as the reference's
+        merge jobs do)."""
+        target = self.meta.get_region(target_region_id)
+        source = self.meta.get_region(source_region_id)
+        if target is None or source is None:
+            raise KeyError("merge requires both regions hosted")
+        if target.definition.end_key != source.definition.start_key:
+            raise ValueError("merge requires adjacent regions (target first)")
+        self.engine.write(target, wd.MergeRegionData(
+            source_region_id=source_region_id,
+            source_end_key=source.definition.end_key,
+        ))
+
+    def handle_merge(self, target: Region, data: wd.MergeRegionData,
+                     log_id: int) -> None:
+        """CommitMergeHandler: target absorbs the source range; source's
+        index becomes target's sibling; source region retires."""
+        with self._lock:
+            source = self.meta.get_region(data.source_region_id)
+            if source is None:
+                return  # replay after source already purged
+            target.definition.end_key = data.source_end_key
+            target.definition.epoch.version += 1
+            self.meta.update_region(target)
+            if (target.vector_index_wrapper is not None
+                    and source.vector_index_wrapper is not None):
+                target.vector_index_wrapper.set_sibling(
+                    source.vector_index_wrapper
+                )
+            source.set_state(RegionState.TOMBSTONE,
+                             f"merged into {target.id}")
+            # Quiesce: let the source state machine drain committed entries
+            # before retiring it (the reference's PrepareMerge freezes the
+            # source first; losing committed-but-unapplied writes would
+            # diverge replicas).
+            src_node = self.engine.get_node(source.id)
+            if src_node is not None:
+                deadline = time.monotonic() + 2.0
+                while (src_node.last_applied < src_node.commit_index
+                       and time.monotonic() < deadline):
+                    time.sleep(0.01)
+            self.engine.stop_node(source.id)
+            self.meta.delete_region(source.id)
+        node = self.engine.get_node(target.id)
+        if self.coordinator is not None and node is not None \
+                and node.is_leader():
+            self.coordinator.on_region_merge_done(
+                target.id, data.source_region_id, target.definition
+            )
+
+    def finish_merge_index(self, target_region_id: int) -> None:
+        """Post-merge rebuild: own index covers the absorbed range, sibling
+        dropped (reference: rebuild task after merge)."""
+        target = self.meta.get_region(target_region_id)
+        if target is None or target.vector_index_wrapper is None:
+            return
+        self.index_manager.rebuild(target)
+        target.vector_index_wrapper.set_sibling(None)
+
     def finish_child_index(self, child_region_id: int) -> None:
         """Post-split rebuild: give the child its own index and drop the
         share (reference: child rebuild task then UpdateVectorIndex)."""
@@ -214,6 +277,9 @@ class StoreNode:
         elif t is RegionCmdType.SPLIT:
             self.propose_split(cmd.region_id, cmd.split_key,
                                cmd.child_region_id)
+        elif t is RegionCmdType.MERGE:
+            # cmd.region_id = target, child_region_id field carries source
+            self.propose_merge(cmd.region_id, cmd.child_region_id)
         elif t is RegionCmdType.CHANGE_PEER:
             # ChangePeerRegionTask: refresh the raft member list so the
             # leader replicates to added peers and drops removed ones

@@ -252,3 +252,122 @@ def test_change_peer_catches_up_new_store(cluster):
         new_node.get_region(d.region_id), b"k1"
     )
     assert got == b"v1"
+
+
+def test_merge_regions(cluster):
+    """Split then merge back: target absorbs the child's range, serves its
+    ids via the sibling index, then owns everything after rebuild."""
+    transport, coord, nodes = cluster
+    definition = coord.create_region(
+        start_key=vcodec.encode_vector_key(3, 0),
+        end_key=vcodec.encode_vector_key(3, 1000),
+        partition_id=3,
+        region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(index_type=IndexType.FLAT, dimension=8),
+    )
+    drive_heartbeats(nodes)
+    leader = wait_region_leader(nodes, definition.region_id)
+    region = leader.get_region(definition.region_id)
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((100, 8)).astype(np.float32)
+    leader.storage.vector_add(region, np.arange(100, dtype=np.int64), x)
+    time.sleep(0.3)
+    child_id = coord.split_region(
+        definition.region_id, vcodec.encode_vector_key(3, 50)
+    )
+    drive_heartbeats(nodes, rounds=4)
+    time.sleep(0.5)
+    # make the child's own index real before merging back
+    child_leader = wait_region_leader(nodes, child_id)
+    child_leader.finish_child_index(child_id)
+
+    coord.merge_region(definition.region_id, child_id)
+    drive_heartbeats(nodes, rounds=4)
+    time.sleep(0.5)
+    # child gone everywhere; parent covers full range again
+    for n in nodes.values():
+        assert n.get_region(child_id) is None, n.store_id
+    lo, hi = region.id_window()
+    assert (lo, hi) == (0, 1000)
+    assert coord.regions.get(child_id) is None
+    assert coord.regions[definition.region_id].end_key == \
+        vcodec.encode_vector_key(3, 1000)
+    # searches reach the absorbed range via the sibling index
+    tl = wait_region_leader(nodes, definition.region_id)
+    tr = tl.get_region(definition.region_id)
+    res = tl.engine.new_vector_reader(tr).vector_batch_search(x[75][None, :], 3)
+    assert res[0][0].id == 75
+    # rebuild absorbs everything and drops the sibling
+    tl.finish_merge_index(definition.region_id)
+    assert tr.vector_index_wrapper.sibling_index is None
+    assert tr.vector_index_wrapper.own_index.get_count() == 100
+
+
+def test_split_checker_proposes_midpoint(cluster):
+    from dingo_tpu.store.checker import PreMergeChecker, PreSplitChecker
+
+    transport, coord, nodes = cluster
+    definition = coord.create_region(
+        start_key=vcodec.encode_vector_key(4, 0),
+        end_key=vcodec.encode_vector_key(4, 10000),
+        partition_id=4,
+        region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(index_type=IndexType.FLAT, dimension=8),
+    )
+    drive_heartbeats(nodes)
+    leader = wait_region_leader(nodes, definition.region_id)
+    region = leader.get_region(definition.region_id)
+    rng = np.random.default_rng(2)
+    leader.storage.vector_add(
+        region, np.arange(200, dtype=np.int64),
+        rng.standard_normal((200, 8)).astype(np.float32),
+    )
+    checker = PreSplitChecker(leader, max_keys=100)
+    proposals = checker.run()
+    assert len(proposals) == 1
+    assert proposals[0].region_id == definition.region_id
+    # the proposal landed in the coordinator's job queue
+    assert any(c.cmd_type.value == "split" for q in coord.store_ops.values()
+               for c in q)
+    # merge checker: two tiny adjacent regions propose a merge
+    drive_heartbeats(nodes, rounds=4)
+    time.sleep(0.5)
+    merges = PreMergeChecker(leader, min_keys=10_000).run()
+    assert len(merges) >= 1
+
+
+def test_merge_sibling_sees_deletes(cluster):
+    """Regression: deletes in the absorbed range must not resurrect via the
+    sibling index during the post-merge window."""
+    transport, coord, nodes = cluster
+    definition = coord.create_region(
+        start_key=vcodec.encode_vector_key(5, 0),
+        end_key=vcodec.encode_vector_key(5, 1000),
+        partition_id=5,
+        region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(index_type=IndexType.FLAT, dimension=8),
+    )
+    drive_heartbeats(nodes)
+    leader = wait_region_leader(nodes, definition.region_id)
+    region = leader.get_region(definition.region_id)
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((60, 8)).astype(np.float32)
+    leader.storage.vector_add(region, np.arange(60, dtype=np.int64), x)
+    time.sleep(0.3)
+    child_id = coord.split_region(
+        definition.region_id, vcodec.encode_vector_key(5, 30)
+    )
+    drive_heartbeats(nodes, rounds=4)
+    time.sleep(0.5)
+    child_leader = wait_region_leader(nodes, child_id)
+    child_leader.finish_child_index(child_id)
+    coord.merge_region(definition.region_id, child_id)
+    drive_heartbeats(nodes, rounds=4)
+    time.sleep(0.5)
+    tl = wait_region_leader(nodes, definition.region_id)
+    tr = tl.get_region(definition.region_id)
+    assert tr.vector_index_wrapper.sibling_index is not None
+    # delete an absorbed-range id while the sibling is still attached
+    tl.storage.vector_delete(tr, [45])
+    res = tl.engine.new_vector_reader(tr).vector_batch_search(x[45][None, :], 3)
+    assert 45 not in [v.id for v in res[0]]

@@ -1,0 +1,195 @@
+"""Document index, BR backup/restore, CLI tests."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from dingo_tpu.coordinator.control import CoordinatorControl
+from dingo_tpu.br import backup_cluster, restore_cluster
+from dingo_tpu.document import DocumentIndex
+from dingo_tpu.engine.raw_engine import MemEngine
+from dingo_tpu.index import codec as vcodec
+from dingo_tpu.index.base import IndexParameter, IndexType
+from dingo_tpu.raft import LocalTransport
+from dingo_tpu.store.node import StoreNode
+from dingo_tpu.store.region import RegionType
+
+
+# ---------------- document index ----------------
+
+
+def test_document_bm25_ranking():
+    idx = DocumentIndex(1, text_fields=("title", "body"))
+    idx.add(1, {"title": "tpu vector search",
+                "body": "fast distance kernels on the mxu"})
+    idx.add(2, {"title": "cooking pasta",
+                "body": "boil water add salt add pasta"})
+    idx.add(3, {"title": "vector databases",
+                "body": "vector indexes ivf hnsw vector"})
+    hits = idx.search("vector")
+    assert [h[0] for h in hits][:2] == [3, 1]   # 3 has more matches
+    assert idx.search("pasta")[0][0] == 2
+    assert idx.search("nonexistentterm") == []
+
+
+def test_document_and_mode_and_filters():
+    idx = DocumentIndex(1)
+    idx.add(1, {"text": "red fast car", "year": 2020})
+    idx.add(2, {"text": "red slow truck", "year": 2021})
+    idx.add(3, {"text": "blue fast car", "year": 2021})
+    both = idx.search("red fast", mode="and")
+    assert [h[0] for h in both] == [1]
+    filtered = idx.search("fast", column_filter={"year": 2021})
+    assert [h[0] for h in filtered] == [3]
+
+
+def test_document_delete_upsert_save_load(tmp_path):
+    idx = DocumentIndex(1)
+    idx.add(1, {"text": "hello world"})
+    idx.add(2, {"text": "hello there"})
+    idx.delete([1])
+    assert idx.count() == 1
+    assert [h[0] for h in idx.search("hello")] == [2]
+    idx.upsert(2, {"text": "goodbye"})
+    assert idx.search("hello") == []
+    assert idx.search("goodbye")[0][0] == 2
+    idx.apply_log_id = 42
+    idx.save(str(tmp_path))
+    idx2 = DocumentIndex(1)
+    idx2.load(str(tmp_path))
+    assert idx2.apply_log_id == 42
+    assert idx2.search("goodbye")[0][0] == 2
+
+
+# ---------------- BR ----------------
+
+
+def test_backup_restore_roundtrip(tmp_path):
+    transport = LocalTransport()
+    coord = CoordinatorControl(MemEngine(), replication=2)
+    nodes = {
+        sid: StoreNode(sid, transport, coord, raft_kw={"seed": i})
+        for i, sid in enumerate(["s0", "s1"])
+    }
+    d = coord.create_region(
+        start_key=vcodec.encode_vector_key(0, 0),
+        end_key=vcodec.encode_vector_key(0, 1 << 30),
+        region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(index_type=IndexType.FLAT, dimension=8),
+    )
+    for _ in range(3):
+        for n in nodes.values():
+            n.heartbeat_once()
+        time.sleep(0.05)
+    leader = None
+    deadline = time.monotonic() + 5
+    while leader is None and time.monotonic() < deadline:
+        leader = next((n for n in nodes.values()
+                       if (rn := n.engine.get_node(d.region_id)) and
+                       rn.is_leader()), None)
+        time.sleep(0.02)
+    region = leader.get_region(d.region_id)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((50, 8)).astype(np.float32)
+    leader.storage.vector_add(region, np.arange(50, dtype=np.int64), x,
+                              [{"i": int(i)} for i in range(50)])
+    time.sleep(0.3)
+    manifest = backup_cluster(coord, nodes, str(tmp_path / "bak"))
+    assert len(manifest["regions"]) == 1
+
+    # fresh cluster
+    transport2 = LocalTransport()
+    coord2 = CoordinatorControl(MemEngine(), replication=2)
+    nodes2 = {
+        sid: StoreNode(sid, transport2, coord2, raft_kw={"seed": i})
+        for i, sid in enumerate(["s0", "s1"])
+    }
+    n_restored = restore_cluster(coord2, nodes2, str(tmp_path / "bak"))
+    assert n_restored == 1
+    rid2 = next(iter(coord2.regions))
+    deadline = time.monotonic() + 5
+    leader2 = None
+    while leader2 is None and time.monotonic() < deadline:
+        leader2 = next((n for n in nodes2.values()
+                        if (rn := n.engine.get_node(rid2)) and rn.is_leader()),
+                       None)
+        time.sleep(0.02)
+    region2 = leader2.get_region(rid2)
+    assert leader2.storage.vector_count(region2) == 50
+    res = leader2.storage.vector_batch_search(region2, x[:2], 1)
+    assert [r[0].id for r in res] == [0, 1]
+    got = leader2.storage.vector_batch_query(region2, [7],
+                                             with_scalar_data=True)
+    assert got[0].scalar == {"i": 7}
+    for n in list(nodes.values()) + list(nodes2.values()):
+        n.stop()
+
+
+# ---------------- CLI ----------------
+
+
+@pytest.fixture(scope="module")
+def grpc_cluster():
+    from dingo_tpu.coordinator.kv_control import KvControl
+    from dingo_tpu.coordinator.tso import TsoControl
+    from dingo_tpu.server.rpc import DingoServer
+
+    transport = LocalTransport()
+    me = MemEngine()
+    control = CoordinatorControl(me, replication=2)
+    cs = DingoServer()
+    cs.host_coordinator_role(control, TsoControl(me), KvControl(me))
+    cport = cs.start()
+    nodes, servers, flags = {}, [], []
+    for i, sid in enumerate(["s0", "s1"]):
+        n = StoreNode(sid, transport, control, raft_kw={"seed": i})
+        srv = DingoServer()
+        srv.host_store_role(n)
+        port = srv.start()
+        n.start_heartbeat(0.1)
+        nodes[sid] = n
+        servers.append(srv)
+        flags.append(f"--store")
+        flags.append(f"{sid}=127.0.0.1:{port}")
+    base = ["--coordinator", f"127.0.0.1:{cport}"] + flags
+    yield base
+    for s in servers:
+        s.stop()
+    cs.stop()
+    for n in nodes.values():
+        n.stop()
+
+
+def test_cli_end_to_end(grpc_cluster, capsys):
+    from dingo_tpu.client.cli import main
+
+    base = grpc_cluster
+    assert main(base + ["coordinator", "hello"]) == 0
+    assert main(base + ["region", "create-index", "--dim", "8"]) == 0
+    rid = json.loads(capsys.readouterr().out.strip().splitlines()[-1])["region_id"]
+    time.sleep(1.0)
+    assert main(base + ["vector", "add-random", "--dim", "8",
+                        "--count", "50"]) == 0
+    # count may route to a follower that hasn't applied yet (reads are
+    # eventually consistent off-leader); poll briefly
+    deadline = time.monotonic() + 3
+    out = [""]
+    while time.monotonic() < deadline:
+        assert main(base + ["vector", "count"]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        if out[-1] == "50":
+            break
+        time.sleep(0.1)
+    assert out[-1] == "50"
+    assert main(base + ["vector", "search-random", "--dim", "8"]) == 0
+    hits = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(hits) == 5
+    assert main(base + ["node", "info", "--store", "s0"]) == 0
+    assert main(base + ["coordinator", "region-map"]) == 0
+    assert main(base + ["debug", "metrics", "--store", "s0"]) == 0
+    # kv flow needs a kv region over byte keys
+    from dingo_tpu.client.client import DingoClient
+    from dingo_tpu.server import pb as _pb
+    assert main(base + ["coordinator", "tso"]) == 0
