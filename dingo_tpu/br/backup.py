@@ -33,16 +33,23 @@ def backup_cluster(coordinator, nodes: Dict[str, object], path: str) -> dict:
         "regions": [],
         "stores": sorted(nodes),
     }
+    skipped = []
     for region_id, definition in coordinator.regions.items():
-        # read from any alive peer hosting the region (leader preferred)
-        host = coordinator.region_leaders.get(region_id)
-        if host not in nodes:
-            host = next((p for p in definition.peers if p in nodes), None)
-        if host is None:
-            continue
-        node = nodes[host]
-        region = node.get_region(region_id)
-        if region is None:
+        # leader preferred, but fall back to ANY peer that actually holds
+        # the region (leadership records can be stale)
+        candidates = [coordinator.region_leaders.get(region_id)]
+        candidates += [p for p in definition.peers if p not in candidates]
+        node = region = None
+        for host in candidates:
+            cand = nodes.get(host)
+            if cand is None:
+                continue
+            region = cand.get_region(region_id)
+            if region is not None:
+                node = cand
+                break
+        if node is None or region is None:
+            skipped.append(region_id)
             continue
         blob = pickle.dumps(region_snapshot(node.raw, region), protocol=4)
         fname = f"region_{region_id}.data"
@@ -54,6 +61,7 @@ def backup_cluster(coordinator, nodes: Dict[str, object], path: str) -> dict:
             "data_file": fname,
             "bytes": len(blob),
         })
+    manifest["skipped_regions"] = skipped
     with open(os.path.join(path, "backupmeta.json"), "w") as f:
         json.dump(manifest, f, indent=1)
     # coordinator meta KV (id counters etc.) travels as a pickle
@@ -102,6 +110,7 @@ def restore_cluster(coordinator, nodes: Dict[str, object], path: str,
             time.sleep(0.05)
         with open(os.path.join(path, entry["data_file"]), "rb") as f:
             state = pickle.loads(f.read())
+        installed = 0
         for sid in created.peers:
             node = nodes.get(sid)
             if node is None:
@@ -113,7 +122,11 @@ def restore_cluster(coordinator, nodes: Dict[str, object], path: str,
             # indexes rebuild from the ingested engine data
             if region.vector_index_wrapper is not None:
                 node.index_manager.rebuild(region)
-        restored += 1
+            if region.document_index is not None:
+                node.rebuild_document_index(region)
+            installed += 1
+        if installed:
+            restored += 1
     return restored
 
 

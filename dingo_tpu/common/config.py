@@ -71,6 +71,7 @@ FLAGS.define("server_heartbeat_interval_s", 10, mutable=True)
 FLAGS.define("raft_snapshot_threshold", 10000, mutable=True)
 FLAGS.define("region_max_size_bytes", 256 * 1024 * 1024, mutable=True)
 FLAGS.define("split_check_approximate_keys", 1_000_000, mutable=True)
+FLAGS.define("gc_retention_ms", 3_600_000, mutable=True)
 
 
 class Config:

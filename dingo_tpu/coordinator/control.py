@@ -415,6 +415,19 @@ class CoordinatorControl:
                     cmd_type=RegionCmdType.DELETE,
                 ))
 
+    #: GC retention window (versions younger than this always survive)
+    GC_RETENTION_MS = 3_600_000
+
+    def gc_safe_ts(self, tso) -> int:
+        """Safe point = now - retention, in TSO format (coordinator pushes
+        this to stores; their MVCC GC prunes below it)."""
+        from dingo_tpu.mvcc.ts_provider import compose_ts
+        import time as _time
+
+        return compose_ts(
+            int(_time.time() * 1000) - self.GC_RETENTION_MS, 0
+        )
+
     # ---------------- failure handling --------------------------------------
     def check_region_health(self) -> List[Tuple[int, List[str]]]:
         """CheckRegionAllPeerOnline (:597-599): regions with offline peers,

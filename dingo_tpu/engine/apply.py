@@ -63,6 +63,10 @@ def apply_write(
         _apply_vector_add(engine, region, data, log_id)
     elif isinstance(data, wd.VectorDeleteData):
         _apply_vector_delete(engine, region, data, log_id)
+    elif isinstance(data, wd.DocumentAddData):
+        _apply_document_add(engine, region, data, log_id)
+    elif isinstance(data, wd.DocumentDeleteData):
+        _apply_document_delete(engine, region, data, log_id)
     elif isinstance(data, wd.TxnRaftData):
         _apply_txn(engine, data)
     else:
@@ -155,6 +159,53 @@ def _apply_vector_delete(
     wrapper = region.vector_index_wrapper
     if wrapper is not None and wrapper.is_ready():
         wrapper.delete(np.asarray(data.ids, np.int64), log_id)
+
+
+def _apply_document_add(
+    engine: RawEngine, region: Region, data: wd.DocumentAddData, log_id: int
+) -> None:
+    """DocumentAdd handler: persist docs (source of truth) then update the
+    in-memory full-text index — same dual-write contract as vectors."""
+    import pickle as _pickle
+
+    part = region.definition.partition_id
+    batch = WriteBatch()
+    for did, doc in zip(data.ids, data.documents):
+        key = vcodec.encode_vector_key(part, int(did))
+        batch.put(
+            CF_DEFAULT,
+            Codec.encode_key(key, data.ts),
+            Codec.package_value(_pickle.dumps(doc, protocol=4)),
+        )
+    engine.write(batch)
+    if region.document_index is not None and (
+        log_id == 0 or log_id > region.document_index.apply_log_id
+    ):
+        for did, doc in zip(data.ids, data.documents):
+            region.document_index.upsert(int(did), doc)
+        if log_id:
+            region.document_index.apply_log_id = log_id
+
+
+def _apply_document_delete(
+    engine: RawEngine, region: Region, data: wd.DocumentDeleteData, log_id: int
+) -> None:
+    part = region.definition.partition_id
+    batch = WriteBatch()
+    for did in data.ids:
+        key = vcodec.encode_vector_key(part, int(did))
+        batch.put(
+            CF_DEFAULT,
+            Codec.encode_key(key, data.ts),
+            Codec.package_value(b"", ValueFlag.DELETE),
+        )
+    engine.write(batch)
+    if region.document_index is not None and (
+        log_id == 0 or log_id > region.document_index.apply_log_id
+    ):
+        region.document_index.delete([int(d) for d in data.ids])
+        if log_id:
+            region.document_index.apply_log_id = log_id
 
 
 def _apply_txn(engine: RawEngine, data: wd.TxnRaftData) -> None:

@@ -84,6 +84,23 @@ class SplitRegionData:
 
 
 @dataclasses.dataclass
+class DocumentAddData:
+    """DocumentAdd/BatchAddHandler payload (handler list,
+    raft_apply_handler.h: DocumentAdd/Delete/BatchAddHandler)."""
+
+    ts: int
+    ids: List[int]
+    documents: List[Dict[str, Any]]
+    is_update: bool = True
+
+
+@dataclasses.dataclass
+class DocumentDeleteData:
+    ts: int
+    ids: List[int]
+
+
+@dataclasses.dataclass
 class MergeRegionData:
     """CommitMergeHandler payload (raft_apply_handler.cc:78-99,1021):
     target absorbs the source region's range; the source's in-memory index

@@ -1,0 +1,159 @@
+"""Role-based server binary (the `dingodb_server --role=...` analog,
+reference src/server/main.cc:526-541).
+
+    python -m dingo_tpu.server.main --role coordinator --port 20001 \
+        --data-dir /tmp/dingo/coord
+    python -m dingo_tpu.server.main --role store --id s0 --port 20011 \
+        --coordinator 127.0.0.1:20001 --data-dir /tmp/dingo/s0
+
+Startup order mirrors §3.3: config -> engine -> (coordinator: controls |
+store: meta recovery -> index manager -> storage -> controllers) ->
+services -> crontab schedule.
+
+Note: multi-process stores need a network raft transport between store
+processes; the in-process LocalTransport serves single-process multi-role
+deployments (the production-grade grpc raft transport is tracked work).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+from dingo_tpu.common.config import FLAGS, Config
+from dingo_tpu.common.crontab import CrontabManager
+from dingo_tpu.common.stream import StreamManager
+from dingo_tpu.coordinator.balance import (
+    BalanceLeaderScheduler,
+    BalanceRegionScheduler,
+)
+from dingo_tpu.coordinator.control import CoordinatorControl
+from dingo_tpu.coordinator.kv_control import KvControl
+from dingo_tpu.coordinator.tso import TsoControl
+from dingo_tpu.engine.gc import GCSafePointManager
+from dingo_tpu.engine.raw_engine import MemEngine, WalEngine
+from dingo_tpu.raft import LocalTransport
+from dingo_tpu.server.rpc import DingoServer
+from dingo_tpu.store.checker import PreMergeChecker, PreSplitChecker
+from dingo_tpu.store.node import StoreNode
+
+_TRANSPORT = LocalTransport()   # in-process multi-role transport
+
+
+def serve_coordinator(args) -> None:
+    engine = WalEngine(args.data_dir) if args.data_dir else MemEngine()
+    control = CoordinatorControl(engine, replication=args.replication)
+    tso = TsoControl(engine)
+    kv_control = KvControl(engine)
+
+    server = DingoServer(args.port)
+    server.host_coordinator_role(control, tso, kv_control)
+    port = server.start()
+
+    crontab = CrontabManager()
+    crontab.add("update_store_state", 5.0, control.update_store_states)
+    crontab.add("lease_gc", 10.0, kv_control.lease_gc)
+    crontab.add(
+        "balance_leader", 30.0, BalanceLeaderScheduler(control).dispatch
+    )
+    crontab.add(
+        "balance_region", 60.0, BalanceRegionScheduler(control).dispatch
+    )
+    crontab.start()
+    print(f"coordinator listening on 127.0.0.1:{port}", flush=True)
+    _wait(server, crontab)
+
+
+def serve_store(args) -> None:
+    engine = WalEngine(args.data_dir) if args.data_dir else MemEngine()
+    # single-process deployments reach the coordinator object directly; a
+    # remote coordinator is reached through the grpc heartbeat below
+    node = StoreNode(
+        args.id, _TRANSPORT, coordinator=None, raw_engine=engine,
+        snapshot_root=args.data_dir,
+    )
+    node.meta.recover()
+    gc = GCSafePointManager()
+    streams = StreamManager()
+
+    server = DingoServer(args.port)
+    server.host_store_role(node)
+    port = server.start()
+
+    crontab = CrontabManager()
+    hb_interval = FLAGS.get("server_heartbeat_interval_s")
+    if args.coordinator:
+        from dingo_tpu.server.remote_heartbeat import RemoteHeartbeat
+
+        hb = RemoteHeartbeat(node, args.coordinator)
+        crontab.add("heartbeat", float(hb_interval), hb.beat, immediately=True)
+    crontab.add("scan_gc", 30.0, streams.recycle_idle)
+
+    def run_gc():
+        # advance the safe point (coordinator pull when configured, local
+        # now-minus-retention otherwise), then prune MVCC versions below it
+        from dingo_tpu.mvcc.ts_provider import compose_ts
+
+        if args.coordinator:
+            try:
+                resp = hb._stub.GetGCSafePoint(pb_mod.GetGCSafePointRequest())
+                gc.update(resp.safe_ts)
+            except Exception:
+                pass
+        else:
+            gc.update(compose_ts(
+                int(time.time() * 1000) - FLAGS.get("gc_retention_ms"), 0
+            ))
+        return gc.gc_non_txn(node.raw)
+
+    from dingo_tpu.server import pb as pb_mod
+
+    crontab.add("mvcc_gc", 60.0, run_gc)
+    crontab.add("split_check", 60.0,
+                lambda: PreSplitChecker(node).run() if node.coordinator else None)
+    crontab.add("scrub_vector_index", 60.0, lambda: [
+        node.index_manager.scrub(r) for r in node.meta.get_all_regions()
+    ])
+    crontab.start()
+    print(f"store {args.id} listening on 127.0.0.1:{port}", flush=True)
+    _wait(server, crontab, node)
+
+
+def _wait(server, crontab, node=None) -> None:
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        crontab.stop()
+        server.stop()
+        if node is not None:
+            node.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="dingo-server")
+    p.add_argument("--role", choices=["coordinator", "store", "index"],
+                   required=True)
+    p.add_argument("--id", default="s0")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--coordinator", default="")
+    p.add_argument("--data-dir", default="")
+    p.add_argument("--replication", type=int, default=3)
+    p.add_argument("--config", default="")
+    args = p.parse_args(argv)
+    if args.config:
+        Config.load(args.config).apply_flag_overrides(FLAGS)
+    if args.role == "coordinator":
+        serve_coordinator(args)
+    else:
+        serve_store(args)   # store and index are one binary role-wise here
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

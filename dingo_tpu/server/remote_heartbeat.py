@@ -1,0 +1,71 @@
+"""Store -> remote coordinator heartbeat over grpc.
+
+The in-process path calls CoordinatorControl directly (StoreNode.heartbeat_
+once); multi-process stores use this grpc client instead — same payload,
+same command execution on the response (store/heartbeat.cc:61,294 flow).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from dingo_tpu.coordinator.control import RegionCmd, RegionCmdType
+from dingo_tpu.server import convert, pb
+from dingo_tpu.server.rpc import ServiceStub
+
+
+class RemoteHeartbeat:
+    def __init__(self, node, coordinator_addr: str):
+        self.node = node
+        self._channel = grpc.insecure_channel(coordinator_addr)
+        self._stub = ServiceStub(self._channel, "CoordinatorService")
+
+    def beat(self) -> int:
+        regions = self.node.meta.get_all_regions()
+        leader_ids = [
+            r.id for r in regions
+            if (n := self.node.engine.get_node(r.id)) is not None
+            and n.is_leader()
+        ]
+        req = pb.StoreHeartbeatRequest()
+        req.store_id = self.node.store_id
+        req.region_ids.extend(r.id for r in regions)
+        req.leader_region_ids.extend(leader_ids)
+        for r in regions:
+            if r.id in leader_ids:
+                req.region_definitions.add().CopyFrom(
+                    convert.region_def_to_pb(r.definition)
+                )
+        resp = self._stub.StoreHeartbeat(req)
+        executed = 0
+        for c in resp.commands:
+            cmd = RegionCmd(
+                cmd_id=c.cmd_id,
+                region_id=c.region_id,
+                cmd_type=RegionCmdType(c.cmd_type),
+                definition=(
+                    convert.region_def_from_pb(c.definition)
+                    if c.definition.region_id else None
+                ),
+                split_key=c.split_key,
+                child_region_id=c.child_region_id,
+                target_store_id=c.target_store_id,
+            )
+            try:
+                self.node.execute_region_cmd(cmd)
+                executed += 1
+            except Exception as e:  # noqa: BLE001
+                from dingo_tpu.raft.core import NotLeader
+
+                if isinstance(e, NotLeader) and e.leader_hint:
+                    # hand the command back to the coordinator addressed at
+                    # the hinted leader (same flow as the in-process path)
+                    rq = pb.RequeueRegionCmdRequest()
+                    rq.cmd.CopyFrom(c)
+                    rq.target_store_id = e.leader_hint.split("/")[0]
+                    rq.from_store_id = self.node.store_id
+                    try:
+                        self._stub.RequeueRegionCmd(rq)
+                    except Exception:
+                        pass
+        return executed

@@ -16,6 +16,7 @@ from dingo_tpu.server import pb
 from dingo_tpu.server.services import (
     CoordinatorService,
     DebugService,
+    DocumentService,
     IndexService,
     NodeService,
     StoreService,
@@ -49,6 +50,12 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
     "UtilService": {
         "VectorCalcDistance": (pb.VectorCalcDistanceRequest, pb.VectorCalcDistanceResponse),
     },
+    "DocumentService": {
+        "DocumentAdd": (pb.DocumentAddRequest, pb.DocumentAddResponse),
+        "DocumentDelete": (pb.DocumentDeleteRequest, pb.DocumentDeleteResponse),
+        "DocumentSearch": (pb.DocumentSearchRequest, pb.DocumentSearchResponse),
+        "DocumentCount": (pb.DocumentCountRequest, pb.DocumentCountResponse),
+    },
     "NodeService": {
         "NodeInfo": (pb.NodeInfoRequest, pb.NodeInfoResponse),
     },
@@ -63,6 +70,8 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "SplitRegion": (pb.SplitRegionRequest, pb.SplitRegionResponse),
         "GetRegionMap": (pb.GetRegionMapRequest, pb.GetRegionMapResponse),
         "Tso": (pb.TsoRequest, pb.TsoResponse),
+        "RequeueRegionCmd": (pb.RequeueRegionCmdRequest, pb.RequeueRegionCmdResponse),
+        "GetGCSafePoint": (pb.GetGCSafePointRequest, pb.GetGCSafePointResponse),
     },
     "VersionService": {
         "VKvPut": (pb.VKvPutRequest, pb.VKvPutResponse),
@@ -107,6 +116,7 @@ class DingoServer:
         """--role=store|index service set (main.cc:681+)."""
         _register(self._server, "IndexService", IndexService(node))
         _register(self._server, "StoreService", StoreService(node))
+        _register(self._server, "DocumentService", DocumentService(node))
         _register(self._server, "NodeService", NodeService(node))
         _register(self._server, "DebugService", DebugService())
         _register(self._server, "UtilService", UtilService())

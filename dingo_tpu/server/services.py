@@ -365,6 +365,85 @@ class StoreService:
         return resp
 
 
+class DocumentService:
+    """Full-text RPCs (reference DocumentService, server/main.cc:1176)."""
+
+    def __init__(self, node: StoreNode):
+        self.node = node
+
+    def DocumentAdd(self, req: pb.DocumentAddRequest) -> pb.DocumentAddResponse:
+        from dingo_tpu.engine import write_data as wd
+
+        resp = pb.DocumentAddResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        if region.document_index is None:
+            return _err(resp, 80001, "not a DOCUMENT region")
+        ids = [d.id for d in req.documents]
+        docs = [convert.scalar_from_pb(d.fields) for d in req.documents]
+        try:
+            ts = self.node.storage.ts_provider.get_ts()
+            self.node.engine.write(region, wd.DocumentAddData(
+                ts=ts, ids=ids, documents=docs, is_update=req.is_update,
+            ))
+        except NotLeader as e:
+            return _err(resp, 20001, f"not leader: {e.leader_hint}")
+        resp.ts = ts
+        return resp
+
+    def DocumentDelete(self, req: pb.DocumentDeleteRequest):
+        from dingo_tpu.engine import write_data as wd
+
+        resp = pb.DocumentDeleteResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        if region.document_index is None:
+            return _err(resp, 80001, "not a DOCUMENT region")
+        try:
+            ts = self.node.storage.ts_provider.get_ts()
+            self.node.engine.write(region, wd.DocumentDeleteData(
+                ts=ts, ids=list(req.ids),
+            ))
+        except NotLeader as e:
+            return _err(resp, 20001, f"not leader: {e.leader_hint}")
+        return resp
+
+    def DocumentSearch(self, req: pb.DocumentSearchRequest):
+        resp = pb.DocumentSearchResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        if region.document_index is None:
+            return _err(resp, 80001, "not a DOCUMENT region")
+        hits = region.document_index.search(
+            req.query,
+            topk=req.top_n or 10,
+            mode=req.mode or "or",
+            column_filter=convert.scalar_from_pb(req.column_filter) or None,
+        )
+        for did, score in hits:
+            d = resp.documents.add()
+            d.id = did
+            d.score = score
+            if req.with_fields:
+                doc = region.document_index.get(did)
+                if doc:
+                    convert.scalar_to_pb(d.fields, doc)
+        return resp
+
+    def DocumentCount(self, req: pb.DocumentCountRequest):
+        resp = pb.DocumentCountResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        if region.document_index is None:
+            return _err(resp, 80001, "not a DOCUMENT region")
+        resp.count = region.document_index.count()
+        return resp
+
+
 class NodeService:
     def __init__(self, node: StoreNode):
         self.node = node
@@ -468,6 +547,28 @@ class CoordinatorService:
         resp = pb.GetRegionMapResponse()
         for d in self.control.regions.values():
             resp.regions.add().CopyFrom(convert.region_def_to_pb(d))
+        return resp
+
+    def RequeueRegionCmd(self, req: pb.RequeueRegionCmdRequest):
+        resp = pb.RequeueRegionCmdResponse()
+        c = req.cmd
+        cmd = RegionCmd(
+            cmd_id=c.cmd_id, region_id=c.region_id,
+            cmd_type=RegionCmdType(c.cmd_type),
+            definition=(convert.region_def_from_pb(c.definition)
+                        if c.definition.region_id else None),
+            split_key=c.split_key, child_region_id=c.child_region_id,
+            target_store_id=c.target_store_id,
+        )
+        self.control.requeue_cmd(cmd, req.target_store_id,
+                                 from_store=req.from_store_id or None)
+        return resp
+
+    def GetGCSafePoint(self, req: pb.GetGCSafePointRequest):
+        """GC safe point = now - retention (tso-format). Stores poll this
+        and run MVCC GC below it (gc_safe_point push/pull flow)."""
+        resp = pb.GetGCSafePointResponse()
+        resp.safe_ts = self.control.gc_safe_ts(self.tso)
         return resp
 
     def Tso(self, req: pb.TsoRequest) -> pb.TsoResponse:

@@ -51,9 +51,12 @@ class PreSplitChecker:
         count = reader.vector_count()
         if count < self.max_keys:
             return None
-        # split at the median id (HALF_SPLIT policy analog)
-        rows = reader.vector_scan_query(0, limit=count, with_vector_data=False)
-        mid_id = rows[len(rows) // 2].id
+        # split at the median id (HALF_SPLIT policy analog); scan only up to
+        # the median — no need to materialize the full region
+        rows = reader.vector_scan_query(
+            0, limit=count // 2 + 1, with_vector_data=False
+        )
+        mid_id = rows[-1].id
         lo, hi = region.id_window()
         if not (lo < mid_id < hi):
             return None

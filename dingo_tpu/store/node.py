@@ -176,18 +176,19 @@ class StoreNode:
                 )
             source.set_state(RegionState.TOMBSTONE,
                              f"merged into {target.id}")
-            # Quiesce: let the source state machine drain committed entries
-            # before retiring it (the reference's PrepareMerge freezes the
-            # source first; losing committed-but-unapplied writes would
-            # diverge replicas).
             src_node = self.engine.get_node(source.id)
-            if src_node is not None:
-                deadline = time.monotonic() + 2.0
-                while (src_node.last_applied < src_node.commit_index
-                       and time.monotonic() < deadline):
-                    time.sleep(0.01)
-            self.engine.stop_node(source.id)
-            self.meta.delete_region(source.id)
+        # Quiesce OUTSIDE self._lock (holding it would stall every other
+        # region's apply/heartbeat for the whole wait): let the source state
+        # machine drain committed entries before retiring it (the
+        # reference's PrepareMerge freezes the source first; losing
+        # committed-but-unapplied writes would diverge replicas).
+        if src_node is not None:
+            deadline = time.monotonic() + 2.0
+            while (src_node.last_applied < src_node.commit_index
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+        self.engine.stop_node(source.id)
+        self.meta.delete_region(source.id)
         node = self.engine.get_node(target.id)
         if self.coordinator is not None and node is not None \
                 and node.is_leader():
@@ -203,6 +204,35 @@ class StoreNode:
             return
         self.index_manager.rebuild(target)
         target.vector_index_wrapper.set_sibling(None)
+
+    def rebuild_document_index(self, region: Region) -> int:
+        """Repopulate a DOCUMENT region's full-text index from the engine
+        (dual-write recovery contract, same as the vector index)."""
+        import pickle as _pickle
+
+        from dingo_tpu.mvcc.reader import Reader as _MvccReader
+        from dingo_tpu.engine.raw_engine import CF_DEFAULT as _CFD
+        from dingo_tpu.index import codec as _vcodec
+
+        if region.document_index is None:
+            return 0
+        reader = _MvccReader(self.raw, _CFD)
+        lo, hi = region.id_window()
+        start = _vcodec.encode_vector_key(region.definition.partition_id, lo)
+        end = _vcodec.encode_vector_key(region.definition.partition_id, hi)
+        n = 0
+        from dingo_tpu.mvcc.codec import MAX_TS as _MAXTS
+
+        for key, blob in reader.iter_visible(start, end, _MAXTS):
+            _, did, _ = _vcodec.decode_vector_key(key)
+            if did is None:
+                continue
+            try:
+                region.document_index.upsert(did, _pickle.loads(blob))
+                n += 1
+            except Exception:
+                continue
+        return n
 
     def finish_child_index(self, child_region_id: int) -> None:
         """Post-split rebuild: give the child its own index and drop the

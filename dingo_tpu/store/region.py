@@ -76,11 +76,16 @@ class Region:
         self.state = RegionState.NEW
         self.leader_store_id = 0
         self.vector_index_wrapper: Optional[VectorIndexWrapper] = None
+        self.document_index = None   # DocumentIndex for DOCUMENT regions
         if definition.region_type is RegionType.INDEX:
             assert definition.index_parameter is not None
             self.vector_index_wrapper = VectorIndexWrapper(
                 definition.region_id, definition.index_parameter
             )
+        elif definition.region_type is RegionType.DOCUMENT:
+            from dingo_tpu.document import DocumentIndex
+
+            self.document_index = DocumentIndex(definition.region_id)
         self.change_log: List[Tuple[float, str]] = []  # RegionChangeRecorder
 
     @property

@@ -1,0 +1,129 @@
+"""DOCUMENT regions over raft + grpc, and MVCC GC safe point."""
+
+import time
+
+import numpy as np
+import pytest
+
+from dingo_tpu.coordinator.control import CoordinatorControl
+from dingo_tpu.engine.gc import GCSafePointManager
+from dingo_tpu.engine.mono_engine import MonoStoreEngine
+from dingo_tpu.engine.raw_engine import CF_DEFAULT, MemEngine
+from dingo_tpu.engine.storage import Storage
+from dingo_tpu.index import codec as vcodec
+from dingo_tpu.raft import LocalTransport
+from dingo_tpu.server import pb
+from dingo_tpu.server.rpc import DingoServer
+from dingo_tpu.store.node import StoreNode
+from dingo_tpu.store.region import Region, RegionDefinition, RegionType
+
+
+def test_document_region_over_grpc():
+    transport = LocalTransport()
+    coord = CoordinatorControl(MemEngine(), replication=2)
+    nodes, servers = {}, []
+    for i, sid in enumerate(["s0", "s1"]):
+        n = StoreNode(sid, transport, coord, raft_kw={"seed": i})
+        srv = DingoServer()
+        srv.host_store_role(n)
+        port = srv.start()
+        n.start_heartbeat(0.1)
+        nodes[sid] = (n, f"127.0.0.1:{port}")
+        servers.append(srv)
+    d = coord.create_region(
+        start_key=vcodec.encode_vector_key(0, 0),
+        end_key=vcodec.encode_vector_key(0, 1 << 30),
+        region_type=RegionType.DOCUMENT,
+    )
+    time.sleep(1.0)
+    # find the leader store and talk grpc to it
+    import grpc as _grpc
+
+    from dingo_tpu.server.rpc import ServiceStub
+
+    leader_sid = None
+    deadline = time.monotonic() + 5
+    while leader_sid is None and time.monotonic() < deadline:
+        for sid, (n, _) in nodes.items():
+            rn = n.engine.get_node(d.region_id)
+            if rn is not None and rn.is_leader():
+                leader_sid = sid
+        time.sleep(0.02)
+    stub = ServiceStub(
+        _grpc.insecure_channel(nodes[leader_sid][1]), "DocumentService"
+    )
+    req = pb.DocumentAddRequest()
+    req.context.region_id = d.region_id
+    import pickle
+
+    for did, text in [(1, "tpu raft storage"), (2, "vector search engine"),
+                      (3, "raft consensus replication")]:
+        e = req.documents.add()
+        e.id = did
+        f = e.fields.add()
+        f.key = "text"
+        f.value = pickle.dumps(text)
+    resp = stub.DocumentAdd(req)
+    assert resp.error.errcode == 0
+
+    sreq = pb.DocumentSearchRequest()
+    sreq.context.region_id = d.region_id
+    sreq.query = "raft"
+    sreq.with_fields = True
+    sresp = stub.DocumentSearch(sreq)
+    assert sorted(doc.id for doc in sresp.documents) == [1, 3]
+
+    creq = pb.DocumentCountRequest()
+    creq.context.region_id = d.region_id
+    assert stub.DocumentCount(creq).count == 3
+
+    # replicated to the follower's document index too
+    time.sleep(0.4)
+    follower_sid = next(s for s in nodes if s != leader_sid)
+    freg = nodes[follower_sid][0].get_region(d.region_id)
+    assert freg.document_index.count() == 3
+
+    dreq = pb.DocumentDeleteRequest()
+    dreq.context.region_id = d.region_id
+    dreq.ids.append(1)
+    stub.DocumentDelete(dreq)
+    sresp = stub.DocumentSearch(sreq)
+    assert [doc.id for doc in sresp.documents] == [3]
+    for s in servers:
+        s.stop()
+    for n, _ in nodes.values():
+        n.stop()
+
+
+def test_gc_safe_point_prunes_versions():
+    raw = MemEngine()
+    engine = MonoStoreEngine(raw)
+    storage = Storage(engine)
+    region = Region(RegionDefinition(region_id=1, start_key=b"",
+                                     end_key=b"\xff" * 8))
+    # three versions + a deleted key
+    ts1 = storage.kv_put(region, [(b"k", b"v1")])
+    ts2 = storage.kv_put(region, [(b"k", b"v2")])
+    ts3 = storage.kv_put(region, [(b"k", b"v3")])
+    storage.kv_put(region, [(b"dead", b"x")])
+    dts = storage.kv_batch_delete(region, [b"dead"])
+
+    gc = GCSafePointManager()
+    assert gc.gc_non_txn(raw) == 0          # no safe point yet
+    gc.update(ts2)
+    removed = gc.gc_non_txn(raw)
+    assert removed >= 1
+    # newest <= safe point (v2) survives, v1 gone, v3 untouched
+    assert storage.kv_get(region, b"k") == b"v3"
+    assert storage.kv_scan(region, b"k", b"l", read_ts=ts2 + 0) != []
+    gc.update(dts + 1)
+    gc.gc_non_txn(raw)
+    # the deleted key's versions are fully wiped below the safe point
+    remaining = [k for k, _ in raw.scan(CF_DEFAULT)]
+    from dingo_tpu.mvcc.codec import Codec
+
+    users = {Codec.decode_key(k)[0] for k in remaining}
+    assert b"dead" not in users
+    # safe point never regresses
+    gc.update(ts1)
+    assert gc.get() == dts + 1
