@@ -1,0 +1,160 @@
+"""Fused distance + running top-k Pallas kernel.
+
+SURVEY.md §7 kernel layer: "fused distance+top-k Pallas kernel with running
+k-selection to avoid materializing [b, n]". The XLA path (ops/distance.py +
+lax.top_k) materializes the full [b, n] score matrix in HBM; this kernel
+streams the database through VMEM in blocks, keeps a [b, k] running best in
+VMEM scratch, and never writes the score matrix out — at 10M x 768 that is
+~2.5 GB of HBM traffic saved per query batch (k=10, b=64).
+
+Selection strategy: per block, k rounds of (max, argmax, mask) over the
+[b, C] block scores — k/d ≈ 1-2% overhead relative to the distance matmul —
+then a merge of the 2k running+block candidates by another k rounds.
+Runs under interpret=True on CPU for tests; compiled on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+
+
+def _select_topk(scores, idx, k):
+    """k rounds of max/argmax/mask over [b, C] -> ([b, k], [b, k])."""
+    vals, ids = [], []
+    for _ in range(k):
+        m = jnp.max(scores, axis=1)                      # [b]
+        am = jnp.argmax(scores, axis=1)                  # [b]
+        vals.append(m)
+        ids.append(jnp.take_along_axis(idx, am[:, None], axis=1)[:, 0])
+        # mask the winner out
+        b, c = scores.shape
+        cols = jax.lax.broadcasted_iota(jnp.int32, (b, c), 1)
+        scores = jnp.where(cols == am[:, None], NEG_INF, scores)
+    return jnp.stack(vals, axis=1), jnp.stack(ids, axis=1)
+
+
+def _fused_kernel(q_ref, qsq_ref, x_ref, xsq_ref, valid_ref,
+                  out_v_ref, out_i_ref, best_v, best_i, *, k, block, ascending):
+    j = pl.program_id(0)
+    nblocks = pl.num_programs(0)
+
+    @pl.when(j == 0)
+    def _init():
+        best_v[:] = jnp.full_like(best_v, NEG_INF)
+        best_i[:] = jnp.full_like(best_i, -1)
+
+    q = q_ref[:]                                          # [b, d]
+    x = x_ref[:]                                          # [C, d]
+    dots = jax.lax.dot_general(
+        q, x, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                     # [b, C]
+    if ascending:  # L2: score = -(||q||^2 - 2qx + ||x||^2)
+        scores = -(qsq_ref[:] - 2.0 * dots + xsq_ref[:])  # [b,1] + [1,C]
+    else:          # IP
+        scores = dots
+    valid = valid_ref[:]                                  # [1, C] float (1/0)
+    scores = jnp.where(valid > 0.5, scores, NEG_INF)
+
+    b = scores.shape[0]
+    gidx = (
+        jax.lax.broadcasted_iota(jnp.int32, (b, block), 1) + j * block
+    )
+    blk_v, blk_i = _select_topk(scores, gidx, k)
+
+    cat_v = jnp.concatenate([best_v[:], blk_v], axis=1)   # [b, 2k]
+    cat_i = jnp.concatenate([best_i[:], blk_i], axis=1)
+    new_v, new_i = _select_topk(cat_v, cat_i, k)
+    best_v[:] = new_v
+    best_i[:] = new_i
+
+    @pl.when(j == nblocks - 1)
+    def _finish():
+        out_v_ref[:] = best_v[:]
+        out_i_ref[:] = best_i[:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "block", "ascending", "interpret"),
+)
+def fused_topk(
+    q: jax.Array,
+    x: jax.Array,
+    x_sqnorm: jax.Array,
+    valid: jax.Array,
+    k: int,
+    block: int = 2048,
+    ascending: bool = True,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Streaming fused search: q[b,d] vs x[n,d] -> (scores[b,k], slots[b,k]).
+
+    Returns 'larger is better' scores (negated L2 when ascending) and global
+    slot indices (-1 for masked). n must be a multiple of `block` (pad with
+    valid=0 rows).
+    """
+    b, d = q.shape
+    n = x.shape[0]
+    assert n % block == 0, f"n={n} not a multiple of block={block}"
+    qsq = jnp.einsum("bd,bd->b", q.astype(jnp.float32), q.astype(jnp.float32),
+                     precision=jax.lax.Precision.HIGHEST)[:, None]   # [b, 1]
+    grid = (n // block,)
+    out_v, out_i = pl.pallas_call(
+        functools.partial(_fused_kernel, k=k, block=block,
+                          ascending=ascending),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((b, d), lambda j: (0, 0)),         # q (all blocks)
+            pl.BlockSpec((b, 1), lambda j: (0, 0)),         # qsq [b,1]
+            pl.BlockSpec((block, d), lambda j: (j, 0)),     # x block
+            pl.BlockSpec((1, block), lambda j: (0, j)),     # xsq [1, n]
+            pl.BlockSpec((1, block), lambda j: (0, j)),     # valid [1, n]
+        ],
+        out_specs=[
+            pl.BlockSpec((b, k), lambda j: (0, 0)),
+            pl.BlockSpec((b, k), lambda j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, k), jnp.float32),
+            jax.ShapeDtypeStruct((b, k), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, k), jnp.float32),
+            pltpu.VMEM((b, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(q.astype(jnp.float32), qsq, x, x_sqnorm[None, :],
+      valid.astype(jnp.float32)[None, :])
+    return out_v, out_i
+
+
+def fused_search(
+    q: np.ndarray,
+    x: jax.Array,
+    x_sqnorm: jax.Array,
+    valid: jax.Array,
+    k: int,
+    block: int = 2048,
+    ascending: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Host-friendly wrapper: pads n to the block multiple and picks
+    interpret mode off-TPU (Mosaic kernels only compile for TPU)."""
+    n = x.shape[0]
+    pad = (-n) % block
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, x.shape[1]), x.dtype)])
+        x_sqnorm = jnp.concatenate([x_sqnorm, jnp.zeros((pad,), x_sqnorm.dtype)])
+        valid = jnp.concatenate([valid, jnp.zeros((pad,), valid.dtype)])
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    return fused_topk(jnp.asarray(q), x, x_sqnorm, valid, k=k, block=block,
+                      ascending=ascending, interpret=interpret)
