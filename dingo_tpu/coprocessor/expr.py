@@ -1,0 +1,117 @@
+"""Expression VM for pushdown predicates (coprocessor v2).
+
+Reference: src/coprocessor/coprocessor_v2.{h,cc} runs rel-expression
+bytecode from the dingo-libexpr submodule (rel::RelRunner,
+coprocessor_v2.cc:209-216). This is an original expression evaluator over
+the same role: a wire-encodable expression tree evaluated against a row's
+field map, with comparison, boolean, arithmetic, and membership operators.
+
+Wire form: nested lists (JSON/pickle friendly) —
+    ["and", ["ge", ["field", "age"], ["const", 21]],
+            ["in", ["field", "color"], ["const", ["red", "blue"]]]]
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+_BINOPS = {
+    "eq": lambda a, b: a == b,
+    "ne": lambda a, b: a != b,
+    "lt": lambda a, b: a < b,
+    "le": lambda a, b: a <= b,
+    "gt": lambda a, b: a > b,
+    "ge": lambda a, b: a >= b,
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "div": lambda a, b: a / b,
+    "mod": lambda a, b: a % b,
+    "in": lambda a, b: a in b,
+}
+
+
+class ExprError(ValueError):
+    pass
+
+
+class Expr:
+    """Compiled expression (validates shape once; eval per row)."""
+
+    def __init__(self, tree: Sequence):
+        self._tree = self._validate(tree)
+
+    @classmethod
+    def _validate(cls, node) -> List:
+        if not isinstance(node, (list, tuple)) or not node:
+            raise ExprError(f"bad expr node {node!r}")
+        op = node[0]
+        if op == "const":
+            if len(node) != 2:
+                raise ExprError("const takes 1 arg")
+            return ["const", node[1]]
+        if op == "field":
+            if len(node) != 2 or not isinstance(node[1], str):
+                raise ExprError("field takes a name")
+            return ["field", node[1]]
+        if op == "not":
+            if len(node) != 2:
+                raise ExprError("not takes 1 arg")
+            return ["not", cls._validate(node[1])]
+        if op in ("and", "or"):
+            if len(node) < 3:
+                raise ExprError(f"{op} takes >=2 args")
+            return [op] + [cls._validate(a) for a in node[1:]]
+        if op == "is_null":
+            if len(node) != 2:
+                raise ExprError("is_null takes 1 arg")
+            return ["is_null", cls._validate(node[1])]
+        if op in _BINOPS:
+            if len(node) != 3:
+                raise ExprError(f"{op} takes 2 args")
+            return [op, cls._validate(node[1]), cls._validate(node[2])]
+        raise ExprError(f"unknown op {op!r}")
+
+    def eval(self, row: Dict[str, Any]) -> Any:
+        return self._eval(self._tree, row)
+
+    def matches(self, row: Dict[str, Any]) -> bool:
+        try:
+            return bool(self.eval(row))
+        except TypeError:
+            return False   # type-mismatched comparisons filter the row out
+
+    @classmethod
+    def _eval(cls, node: List, row: Dict[str, Any]) -> Any:
+        op = node[0]
+        if op == "const":
+            return node[1]
+        if op == "field":
+            return row.get(node[1])
+        if op == "not":
+            return not cls._eval(node[1], row)
+        if op == "and":
+            return all(cls._eval(a, row) for a in node[1:])
+        if op == "or":
+            return any(cls._eval(a, row) for a in node[1:])
+        if op == "is_null":
+            return cls._eval(node[1], row) is None
+        a = cls._eval(node[1], row)
+        b = cls._eval(node[2], row)
+        if a is None or b is None:
+            raise TypeError("null operand")
+        return _BINOPS[op](a, b)
+
+
+class ExprFilter:
+    """ScalarFilter-compatible adapter so the VectorReader's TABLE filter
+    mode and scans can take full expressions."""
+
+    def __init__(self, tree: Sequence):
+        self.expr = Expr(tree)
+
+    def matches(self, scalar: Dict[str, Any]) -> bool:
+        return self.expr.matches(scalar)
+
+    def is_empty(self) -> bool:
+        return False

@@ -17,6 +17,7 @@ from dingo_tpu.server.services import (
     CoordinatorService,
     DebugService,
     DocumentService,
+    FileService,
     IndexService,
     NodeService,
     StoreService,
@@ -46,6 +47,9 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "TxnScan": (pb.TxnScanRequest, pb.TxnScanResponse),
         "TxnBatchRollback": (pb.TxnBatchRollbackRequest, pb.TxnBatchRollbackResponse),
         "TxnCheckStatus": (pb.TxnCheckStatusRequest, pb.TxnCheckStatusResponse),
+        "KvScanBegin": (pb.KvScanBeginRequest, pb.KvScanBeginResponse),
+        "KvScanContinue": (pb.KvScanContinueRequest, pb.KvScanContinueResponse),
+        "KvScanRelease": (pb.KvScanReleaseRequest, pb.KvScanReleaseResponse),
     },
     "UtilService": {
         "VectorCalcDistance": (pb.VectorCalcDistanceRequest, pb.VectorCalcDistanceResponse),
@@ -58,6 +62,13 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
     },
     "NodeService": {
         "NodeInfo": (pb.NodeInfoRequest, pb.NodeInfoResponse),
+        "GetVectorIndexSnapshotMeta": (
+            pb.VectorIndexSnapshotMetaRequest,
+            pb.VectorIndexSnapshotMetaResponse,
+        ),
+    },
+    "FileService": {
+        "ReadFileChunk": (pb.FileChunkRequest, pb.FileChunkResponse),
     },
     "DebugService": {
         "MetricsDump": (pb.MetricsDumpRequest, pb.MetricsDumpResponse),
@@ -117,6 +128,7 @@ class DingoServer:
         _register(self._server, "IndexService", IndexService(node))
         _register(self._server, "StoreService", StoreService(node))
         _register(self._server, "DocumentService", DocumentService(node))
+        _register(self._server, "FileService", FileService(node))
         _register(self._server, "NodeService", NodeService(node))
         _register(self._server, "DebugService", DebugService())
         _register(self._server, "UtilService", UtilService())

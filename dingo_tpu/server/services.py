@@ -280,6 +280,53 @@ class StoreService:
             kv.value = v
         return resp
 
+    # ---- scan sessions (ScanManager v1/v2 + Stream paging) ----
+    def KvScanBegin(self, req: pb.KvScanBeginRequest) -> pb.KvScanBeginResponse:
+        resp = pb.KvScanBeginResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        from dingo_tpu.engine.raw_engine import CF_DEFAULT
+        from dingo_tpu.mvcc.codec import MAX_TS
+        from dingo_tpu.mvcc.reader import Reader as MvccReader
+
+        reader = MvccReader(self.node.raw, CF_DEFAULT)
+        it = reader.iter_visible(
+            req.range.start_key, req.range.end_key,
+            req.context.read_ts or MAX_TS,
+        )
+        stream = _SCAN_SESSIONS.streams.open(it, limit=req.page_size or 100)
+        items, more = stream.next_page()
+        resp.scan_id = stream.id
+        resp.has_more = more
+        for k, v in items:
+            kv = resp.kvs.add()
+            kv.key = k
+            kv.value = v
+        if not more:
+            _SCAN_SESSIONS.streams.release(stream.id)
+        return resp
+
+    def KvScanContinue(self, req: pb.KvScanContinueRequest):
+        resp = pb.KvScanContinueResponse()
+        stream = _SCAN_SESSIONS.streams.get(req.scan_id)
+        if stream is None:
+            return _err(resp, 10010, f"unknown scan {req.scan_id}")
+        items, more = stream.next_page(req.page_size or None)
+        resp.has_more = more
+        for k, v in items:
+            kv = resp.kvs.add()
+            kv.key = k
+            kv.value = v
+        if not more:
+            _SCAN_SESSIONS.streams.release(req.scan_id)
+        return resp
+
+    def KvScanRelease(self, req: pb.KvScanReleaseRequest):
+        resp = pb.KvScanReleaseResponse()
+        _SCAN_SESSIONS.streams.release(req.scan_id)
+        return resp
+
     # ---- txn ----
     def TxnPrewrite(self, req: pb.TxnPrewriteRequest):
         resp = pb.TxnPrewriteResponse()
@@ -444,9 +491,45 @@ class DocumentService:
         return resp
 
 
+class _ScanSessions:
+    """Shared StreamManager for KvScan sessions (ScanManager v2 role)."""
+
+    def __init__(self):
+        from dingo_tpu.common.stream import StreamManager
+
+        self.streams = StreamManager(idle_timeout_s=60.0)
+
+
+_SCAN_SESSIONS = _ScanSessions()
+
+
 class NodeService:
     def __init__(self, node: StoreNode):
         self.node = node
+
+    def GetVectorIndexSnapshotMeta(
+        self, req: pb.VectorIndexSnapshotMetaRequest
+    ) -> pb.VectorIndexSnapshotMetaResponse:
+        """Snapshot manifest for peer pull (node_service.h:45-52 flow)."""
+        import os
+
+        resp = pb.VectorIndexSnapshotMetaResponse()
+        mgr = self.node.index_manager
+        if not mgr.snapshot_root:
+            return _err(resp, 90001, "store has no snapshot root")
+        path = mgr.snapshot_path(req.region_id)
+        if not os.path.isdir(path):
+            return _err(resp, 90002, f"no snapshot for region {req.region_id}")
+        region = self.node.get_region(req.region_id)
+        if region is not None and region.vector_index_wrapper is not None:
+            resp.snapshot_log_id = region.vector_index_wrapper.snapshot_log_id
+        for name in sorted(os.listdir(path)):
+            full = os.path.join(path, name)
+            if os.path.isfile(full):
+                f = resp.files.add()
+                f.name = name
+                f.size = os.path.getsize(full)
+        return resp
 
     def NodeInfo(self, req: pb.NodeInfoRequest) -> pb.NodeInfoResponse:
         resp = pb.NodeInfoResponse()
@@ -458,6 +541,37 @@ class NodeService:
             if (n := self.node.engine.get_node(r.id)) is not None
             and n.is_leader()
         )
+        return resp
+
+
+class FileService:
+    """Chunked snapshot file download (reference file_service.{h,cc}: the
+    vector-index snapshot transfer's data plane)."""
+
+    CHUNK = 1 << 20
+
+    def __init__(self, node: StoreNode):
+        self.node = node
+
+    def ReadFileChunk(self, req: pb.FileChunkRequest) -> pb.FileChunkResponse:
+        import os
+
+        resp = pb.FileChunkResponse()
+        mgr = self.node.index_manager
+        if not mgr.snapshot_root:
+            return _err(resp, 90001, "store has no snapshot root")
+        base = os.path.realpath(mgr.snapshot_path(req.region_id))
+        full = os.path.realpath(os.path.join(base, req.name))
+        # no path escape: serve only files inside the region's snapshot dir
+        if not full.startswith(base + os.sep):
+            return _err(resp, 90003, "invalid file name")
+        if not os.path.isfile(full):
+            return _err(resp, 90002, f"no such file {req.name}")
+        size = min(req.size or self.CHUNK, self.CHUNK)
+        with open(full, "rb") as f:
+            f.seek(req.offset)
+            resp.data = f.read(size)
+        resp.eof = req.offset + len(resp.data) >= os.path.getsize(full)
         return resp
 
 

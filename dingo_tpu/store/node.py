@@ -242,6 +242,56 @@ class StoreNode:
             return
         self.index_manager.rebuild(child)  # clears the share on swap
 
+    # ---------------- vector index snapshot transfer ------------------------
+    def pull_vector_index_snapshot(self, region_id: int,
+                                   peer_addr: str) -> bool:
+        """PullLastSnapshotFromPeers (vector_index_snapshot_manager.h:38-52):
+        fetch the peer's snapshot manifest over NodeService, download the
+        files through FileService chunks, then load + WAL-replay locally."""
+        import os
+
+        import grpc
+
+        from dingo_tpu.server import pb
+        from dingo_tpu.server.rpc import ServiceStub
+
+        if not self.index_manager.snapshot_root:
+            return False
+        channel = grpc.insecure_channel(peer_addr)
+        try:
+            meta = ServiceStub(channel, "NodeService").GetVectorIndexSnapshotMeta(
+                pb.VectorIndexSnapshotMetaRequest(region_id=region_id)
+            )
+            if meta.error.errcode or not meta.files:
+                return False
+            files = ServiceStub(channel, "FileService")
+            dest = self.index_manager.snapshot_path(region_id)
+            os.makedirs(dest, exist_ok=True)
+            for f in meta.files:
+                with open(os.path.join(dest, f.name), "wb") as out:
+                    offset = 0
+                    while True:
+                        chunk = files.ReadFileChunk(pb.FileChunkRequest(
+                            region_id=region_id, name=f.name, offset=offset,
+                        ))
+                        if chunk.error.errcode:
+                            return False
+                        out.write(chunk.data)
+                        offset += len(chunk.data)
+                        if chunk.eof:
+                            break
+            region = self.get_region(region_id)
+            if region is None:
+                return False
+            node = self.engine.get_node(region_id)
+            raft_log = node.log if node is not None else None
+            ok = self.index_manager.load_index(region, raft_log=raft_log)
+            if ok and region.vector_index_wrapper is not None:
+                region.vector_index_wrapper.snapshot_log_id =                     meta.snapshot_log_id
+            return ok
+        finally:
+            channel.close()
+
     # ---------------- heartbeat --------------------------------------------
     def heartbeat_once(self) -> List[RegionCmd]:
         """StoreHeartbeat (store/heartbeat.cc:61): send region metrics, then

@@ -1,0 +1,173 @@
+"""Vector-index snapshot peer transfer, coprocessor expressions, scan
+sessions over grpc."""
+
+import time
+
+import numpy as np
+import pytest
+
+from dingo_tpu.coordinator.control import CoordinatorControl
+from dingo_tpu.coprocessor.expr import Expr, ExprError, ExprFilter
+from dingo_tpu.engine.raw_engine import MemEngine
+from dingo_tpu.index import codec as vcodec
+from dingo_tpu.index.base import IndexParameter, IndexType
+from dingo_tpu.raft import LocalTransport
+from dingo_tpu.server import pb
+from dingo_tpu.server.rpc import DingoServer, ServiceStub
+from dingo_tpu.store.node import StoreNode
+from dingo_tpu.store.region import RegionType
+
+
+# ---------------- expression VM ----------------
+
+
+def test_expr_eval_basics():
+    e = Expr(["and",
+              ["ge", ["field", "age"], ["const", 21]],
+              ["in", ["field", "color"], ["const", ["red", "blue"]]]])
+    assert e.matches({"age": 30, "color": "red"})
+    assert not e.matches({"age": 18, "color": "red"})
+    assert not e.matches({"age": 30, "color": "green"})
+    assert not e.matches({"color": "red"})  # null age -> filtered
+
+
+def test_expr_arithmetic_and_not():
+    e = Expr(["gt", ["mul", ["field", "w"], ["const", 2]], ["const", 10]])
+    assert e.matches({"w": 6})
+    assert not e.matches({"w": 5})
+    n = Expr(["not", ["eq", ["field", "x"], ["const", 1]]])
+    assert n.matches({"x": 2})
+    assert Expr(["is_null", ["field", "missing"]]).matches({})
+
+
+def test_expr_validation():
+    with pytest.raises(ExprError):
+        Expr(["bogus_op", ["const", 1]])
+    with pytest.raises(ExprError):
+        Expr(["eq", ["const", 1]])
+
+
+def test_expr_filter_in_scan():
+    """ExprFilter plugs into the scalar-filter slots (TABLE filter mode)."""
+    from dingo_tpu.engine.mono_engine import MonoStoreEngine
+    from dingo_tpu.engine.storage import Storage
+    from dingo_tpu.store.region import Region, RegionDefinition
+
+    region = Region(RegionDefinition(
+        region_id=1,
+        start_key=vcodec.encode_vector_key(0, 0),
+        end_key=vcodec.encode_vector_key(0, 1 << 30),
+        region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(index_type=IndexType.FLAT, dimension=4),
+    ))
+    w = region.vector_index_wrapper
+    w.build_own()
+    w.set_own(w.own_index)
+    storage = Storage(MonoStoreEngine(MemEngine()))
+    x = np.eye(4, dtype=np.float32)[[0, 1, 2, 3]]
+    storage.vector_add(region, np.arange(4, dtype=np.int64), x,
+                       [{"v": i} for i in range(4)])
+    rows = storage.vector_scan_query(
+        region, start_id=0, limit=10,
+        scalar_filter=ExprFilter(["ge", ["field", "v"], ["const", 2]]),
+        with_scalar_data=True,
+    )
+    assert [r.id for r in rows] == [2, 3]
+
+
+# ---------------- snapshot transfer + scan sessions ----------------
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    transport = LocalTransport()
+    coord = CoordinatorControl(MemEngine(), replication=2)
+    nodes, servers, addrs = {}, [], {}
+    for i, sid in enumerate(["s0", "s1"]):
+        n = StoreNode(sid, transport, coord, raft_kw={"seed": i},
+                      snapshot_root=str(tmp_path / sid))
+        srv = DingoServer()
+        srv.host_store_role(n)
+        port = srv.start()
+        n.start_heartbeat(0.1)
+        nodes[sid] = n
+        addrs[sid] = f"127.0.0.1:{port}"
+        servers.append(srv)
+    yield coord, nodes, addrs
+    for s in servers:
+        s.stop()
+    for n in nodes.values():
+        n.stop()
+
+
+def test_snapshot_peer_pull(cluster):
+    coord, nodes, addrs = cluster
+    d = coord.create_region(
+        start_key=vcodec.encode_vector_key(0, 0),
+        end_key=vcodec.encode_vector_key(0, 1 << 30),
+        region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(index_type=IndexType.FLAT, dimension=8),
+    )
+    time.sleep(1.0)
+    leader = next(n for n in nodes.values()
+                  if (rn := n.engine.get_node(d.region_id)) and rn.is_leader())
+    follower_id = next(s for s in nodes if nodes[s] is not leader)
+    region = leader.get_region(d.region_id)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((40, 8)).astype(np.float32)
+    leader.storage.vector_add(region, np.arange(40, dtype=np.int64), x)
+    leader.index_manager.save_index(region)
+
+    follower = nodes[follower_id]
+    # wipe the follower's in-memory index to simulate a cold peer
+    freg = follower.get_region(d.region_id)
+    freg.vector_index_wrapper.ready = False
+    freg.vector_index_wrapper.own_index = None
+    assert follower.pull_vector_index_snapshot(
+        d.region_id, addrs[next(s for s in nodes if nodes[s] is leader)]
+    )
+    assert freg.vector_index_wrapper.own_index.get_count() == 40
+    res = freg.vector_index_wrapper.search(x[:2], 1)
+    assert [r.ids[0] for r in res] == [0, 1]
+
+
+def test_file_service_rejects_escape(cluster):
+    coord, nodes, addrs = cluster
+    import grpc
+
+    stub = ServiceStub(grpc.insecure_channel(addrs["s0"]), "FileService")
+    resp = stub.ReadFileChunk(pb.FileChunkRequest(
+        region_id=1, name="../../../etc/passwd"
+    ))
+    assert resp.error.errcode == 90003
+
+
+def test_scan_sessions_over_grpc(cluster):
+    coord, nodes, addrs = cluster
+    d = coord.create_region(start_key=b"a", end_key=b"z")
+    time.sleep(1.0)
+    leader = next(n for n in nodes.values()
+                  if (rn := n.engine.get_node(d.region_id)) and rn.is_leader())
+    region = leader.get_region(d.region_id)
+    kvs = [(f"k{i:03d}".encode(), f"v{i}".encode()) for i in range(25)]
+    leader.storage.kv_put(region, kvs)
+    import grpc
+
+    sid = next(s for s in nodes if nodes[s] is leader)
+    stub = ServiceStub(grpc.insecure_channel(addrs[sid]), "StoreService")
+    req = pb.KvScanBeginRequest()
+    req.context.region_id = d.region_id
+    req.range.start_key = b"k"
+    req.range.end_key = b"l"
+    req.page_size = 10
+    r1 = stub.KvScanBegin(req)
+    assert len(r1.kvs) == 10 and r1.has_more
+    r2 = stub.KvScanContinue(pb.KvScanContinueRequest(scan_id=r1.scan_id))
+    assert len(r2.kvs) == 10 and r2.has_more
+    r3 = stub.KvScanContinue(pb.KvScanContinueRequest(scan_id=r1.scan_id))
+    assert len(r3.kvs) == 5 and not r3.has_more
+    got = [kv.key for kv in list(r1.kvs) + list(r2.kvs) + list(r3.kvs)]
+    assert got == [k for k, _ in kvs]
+    # released on exhaustion: continue now errors
+    r4 = stub.KvScanContinue(pb.KvScanContinueRequest(scan_id=r1.scan_id))
+    assert r4.error.errcode == 10010
