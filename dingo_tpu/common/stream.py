@@ -23,19 +23,23 @@ class Stream:
         self.limit = limit
         self.last_active_ms = int(time.time() * 1000)
         self.finished = False
+        #: serializes concurrent pagers — two in-flight continues on one
+        #: generator would raise 'generator already executing'
+        self._lock = threading.Lock()
 
     def next_page(self, limit: Optional[int] = None) -> Tuple[List[Any], bool]:
         """Returns (items, has_more)."""
-        self.last_active_ms = int(time.time() * 1000)
-        n = limit or self.limit
-        items: List[Any] = []
-        try:
-            for _ in range(n):
-                items.append(next(self._source))
-        except StopIteration:
-            self.finished = True
-            return items, False
-        return items, True
+        with self._lock:
+            self.last_active_ms = int(time.time() * 1000)
+            n = limit or self.limit
+            items: List[Any] = []
+            try:
+                for _ in range(n):
+                    items.append(next(self._source))
+            except StopIteration:
+                self.finished = True
+                return items, False
+            return items, True
 
 
 class StreamManager:

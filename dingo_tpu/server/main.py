@@ -89,7 +89,12 @@ def serve_store(args) -> None:
 
         hb = RemoteHeartbeat(node, args.coordinator)
         crontab.add("heartbeat", float(hb_interval), hb.beat, immediately=True)
-    crontab.add("scan_gc", 30.0, streams.recycle_idle)
+    def scan_gc():
+        from dingo_tpu.server.services import _SCAN_SESSIONS
+
+        return streams.recycle_idle() + _SCAN_SESSIONS.streams.recycle_idle()
+
+    crontab.add("scan_gc", 30.0, scan_gc)
 
     def run_gc():
         # advance the safe point (coordinator pull when configured, local

@@ -291,11 +291,15 @@ class StoreService:
         from dingo_tpu.mvcc.reader import Reader as MvccReader
 
         reader = MvccReader(self.node.raw, CF_DEFAULT)
-        it = reader.iter_visible(
+        # materialize at open: the session must be a stable snapshot —
+        # paging a live iterator would skip/repeat keys under concurrent
+        # writes (the reference ScanManager pins a snapshot the same way)
+        snapshot = tuple(reader.iter_visible(
             req.range.start_key, req.range.end_key,
             req.context.read_ts or MAX_TS,
-        )
-        stream = _SCAN_SESSIONS.streams.open(it, limit=req.page_size or 100)
+        ))
+        stream = _SCAN_SESSIONS.streams.open(iter(snapshot),
+                                             limit=req.page_size or 100)
         items, more = stream.next_page()
         resp.scan_id = stream.id
         resp.has_more = more

@@ -266,9 +266,19 @@ class StoreNode:
                 return False
             files = ServiceStub(channel, "FileService")
             dest = self.index_manager.snapshot_path(region_id)
-            os.makedirs(dest, exist_ok=True)
+            # download into a temp dir and swap atomically: a mid-pull
+            # failure must never leave a mixed old/new snapshot behind
+            tmp_dest = dest + ".pulling"
+            import shutil
+
+            shutil.rmtree(tmp_dest, ignore_errors=True)
+            os.makedirs(tmp_dest, exist_ok=True)
             for f in meta.files:
-                with open(os.path.join(dest, f.name), "wb") as out:
+                # peer-supplied names must stay inside the snapshot dir
+                if (os.sep in f.name or "/" in f.name or ".." in f.name
+                        or not f.name):
+                    return False
+                with open(os.path.join(tmp_dest, f.name), "wb") as out:
                     offset = 0
                     while True:
                         chunk = files.ReadFileChunk(pb.FileChunkRequest(
@@ -280,6 +290,8 @@ class StoreNode:
                         offset += len(chunk.data)
                         if chunk.eof:
                             break
+            shutil.rmtree(dest, ignore_errors=True)
+            os.replace(tmp_dest, dest)
             region = self.get_region(region_id)
             if region is None:
                 return False

@@ -171,3 +171,67 @@ def test_scan_sessions_over_grpc(cluster):
     # released on exhaustion: continue now errors
     r4 = stub.KvScanContinue(pb.KvScanContinueRequest(scan_id=r1.scan_id))
     assert r4.error.errcode == 10010
+
+
+def test_scan_snapshot_isolated_from_writes(cluster):
+    """Regression: pages must come from the open-time snapshot even when
+    keys are inserted/deleted between pages."""
+    coord, nodes, addrs = cluster
+    d = coord.create_region(start_key=b"m", end_key=b"n")
+    time.sleep(1.0)
+    leader_sid = next(s for s, n in nodes.items()
+                      if (rn := n.engine.get_node(d.region_id))
+                      and rn.is_leader())
+    leader = nodes[leader_sid]
+    region = leader.get_region(d.region_id)
+    leader.storage.kv_put(region, [(b"m%02d" % i, b"v") for i in range(10)])
+    import grpc
+
+    stub = ServiceStub(grpc.insecure_channel(addrs[leader_sid]), "StoreService")
+    req = pb.KvScanBeginRequest()
+    req.context.region_id = d.region_id
+    req.range.start_key = b"m"
+    req.range.end_key = b"n"
+    req.page_size = 4
+    r1 = stub.KvScanBegin(req)
+    # mutate between pages: insert before the cursor + delete ahead of it
+    leader.storage.kv_put(region, [(b"m000", b"new")])
+    leader.storage.kv_batch_delete(region, [b"m07"])
+    r2 = stub.KvScanContinue(pb.KvScanContinueRequest(scan_id=r1.scan_id))
+    r3 = stub.KvScanContinue(pb.KvScanContinueRequest(scan_id=r1.scan_id))
+    got = [kv.key for kv in list(r1.kvs) + list(r2.kvs) + list(r3.kvs)]
+    assert got == [b"m%02d" % i for i in range(10)]  # open-time snapshot
+
+
+def test_pull_rejects_traversal_names(cluster, monkeypatch):
+    """Regression: peer-supplied snapshot file names must not escape."""
+    coord, nodes, addrs = cluster
+    d = coord.create_region(
+        start_key=vcodec.encode_vector_key(9, 0),
+        end_key=vcodec.encode_vector_key(9, 1 << 20),
+        partition_id=9,
+        region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(index_type=IndexType.FLAT, dimension=4),
+    )
+    time.sleep(1.0)
+    from dingo_tpu.server.rpc import ServiceStub as _SS
+
+    class EvilMeta:
+        class error:
+            errcode = 0
+        snapshot_log_id = 1
+        class _F:
+            name = "../evil"
+            size = 1
+        files = [_F()]
+
+    real_init = _SS.__init__
+
+    def fake_init(self, channel, service):
+        real_init(self, channel, service)
+        if service == "NodeService":
+            self.GetVectorIndexSnapshotMeta = lambda req: EvilMeta()
+
+    monkeypatch.setattr(_SS, "__init__", fake_init)
+    follower = nodes["s1"]
+    assert not follower.pull_vector_index_snapshot(d.region_id, addrs["s0"])
