@@ -53,6 +53,7 @@ def main():
         default_nprobe=nprobe, dtype="bfloat16",
     )
     idx = new_index(1, param)
+    idx.store.reserve(n)        # one allocation, no growth recompiles
     t0 = time.perf_counter()
     step = 50_000
     for i in range(0, n, step):

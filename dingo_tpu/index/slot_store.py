@@ -114,6 +114,12 @@ class SlotStore:
         itemsize = jnp.zeros((), self.dtype).dtype.itemsize
         return self.capacity * (self.dim * itemsize + 8 + 4 + 1)
 
+    def reserve(self, capacity: int) -> None:
+        """Pre-size device arrays (bulk ingest avoids per-growth recompiles
+        of the write program — each growth step re-specializes the DUS)."""
+        if capacity > self.capacity:
+            self._grow(capacity)
+
     # -- mutation ----------------------------------------------------------
     def put(self, ids: np.ndarray, vectors: np.ndarray) -> np.ndarray:
         """Insert/replace rows; returns assigned slots. Contiguous slot runs
