@@ -1,0 +1,130 @@
+"""grpc raft transport: multi-process replication.
+
+The reference replicates over brpc/braft TCP; this transport carries the
+same RaftNode RPCs (request_vote / append_entries / install_snapshot /
+timeout_now) between store PROCESSES over grpc. Raft node addresses stay
+"<store_id>/r<region_id>"; the transport maps the store prefix to a grpc
+endpoint and the receiving server dispatches to the locally-registered
+handler. Local targets short-circuit in process.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Callable, Dict, Optional
+
+import grpc
+
+from dingo_tpu.raft.transport import Transport
+from dingo_tpu.server import pb
+from dingo_tpu.server.rpc import ServiceStub
+
+
+class GrpcRaftTransport(Transport):
+    def __init__(self, store_id: str,
+                 peer_addrs: Optional[Dict[str, str]] = None,
+                 cluster_token: str = ""):
+        self.store_id = store_id
+        #: shared cluster secret: the raft port deserializes cluster-internal
+        #: payloads (pickle, like braft trusts its cluster network), so
+        #: out-of-cluster senders are rejected before deserialization
+        self.cluster_token = cluster_token
+        self._peer_addrs = dict(peer_addrs or {})
+        self._handlers: Dict[str, Callable[[str, dict], dict]] = {}
+        self._channels: Dict[str, grpc.Channel] = {}
+        self._stubs: Dict[str, ServiceStub] = {}
+        self._lock = threading.Lock()
+
+    # -- wiring --------------------------------------------------------------
+    def set_peer(self, store_id: str, addr: str) -> None:
+        with self._lock:
+            self._peer_addrs[store_id] = addr
+            self._channels.pop(store_id, None)
+            self._stubs.pop(store_id, None)
+
+    def register(self, node_id: str, handler) -> None:
+        with self._lock:
+            self._handlers[node_id] = handler
+
+    def unregister(self, node_id: str) -> None:
+        with self._lock:
+            self._handlers.pop(node_id, None)
+
+    # -- server side (RaftService dispatch) ----------------------------------
+    def dispatch(self, target: str, method: str, msg: dict) -> Optional[dict]:
+        with self._lock:
+            handler = self._handlers.get(target)
+        if handler is None:
+            return None
+        try:
+            return handler(method, msg)
+        except Exception:
+            return None
+
+    # -- client side ----------------------------------------------------------
+    def _stub(self, store_id: str) -> Optional[ServiceStub]:
+        with self._lock:
+            stub = self._stubs.get(store_id)
+            if stub is not None:
+                return stub
+            addr = self._peer_addrs.get(store_id)
+            if addr is None:
+                return None
+            chan = grpc.insecure_channel(addr)
+            self._channels[store_id] = chan
+            stub = ServiceStub(chan, "RaftService")
+            self._stubs[store_id] = stub
+            return stub
+
+    def send(self, target: str, method: str, msg: dict) -> Optional[dict]:
+        store_id = target.split("/")[0]
+        if store_id == self.store_id:
+            return self.dispatch(target, method, msg)
+        stub = self._stub(store_id)
+        if stub is None:
+            return None
+        try:
+            resp = stub.RaftMessage(
+                pb.RaftMessageRequest(
+                    target=target, method=method,
+                    payload=pickle.dumps(msg, protocol=4),
+                    cluster_token=self.cluster_token,
+                ),
+                timeout=2.0,
+            )
+        except grpc.RpcError:
+            return None
+        if not resp.delivered:
+            return None
+        return pickle.loads(resp.payload)
+
+    def close(self) -> None:
+        with self._lock:
+            for chan in self._channels.values():
+                chan.close()
+            self._channels.clear()
+
+
+class RaftService:
+    """Server-side receiver (registered on the store's DingoServer)."""
+
+    def __init__(self, transport: GrpcRaftTransport):
+        self.transport = transport
+
+    def RaftMessage(self, req: pb.RaftMessageRequest) -> pb.RaftMessageResponse:
+        resp = pb.RaftMessageResponse()
+        if req.cluster_token != self.transport.cluster_token:
+            resp.delivered = False
+            resp.error.errcode = 95001
+            resp.error.errmsg = "cluster token mismatch"
+            return resp
+        out = self.transport.dispatch(
+            req.target, req.method, pickle.loads(req.payload)
+        )
+        if out is None:
+            resp.delivered = False
+        else:
+            resp.delivered = True
+            resp.payload = pickle.dumps(out, protocol=4)
+        return resp

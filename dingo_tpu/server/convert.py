@@ -140,5 +140,22 @@ def search_kwargs_from_pb(param: pb.VectorSearchParameter) -> dict:
     return kw
 
 
+def region_cmd_from_pb(c):
+    """pb.RegionCmd -> coordinator RegionCmd (single source of truth for
+    the three command-delivery paths: push, requeue, remote heartbeat)."""
+    from dingo_tpu.coordinator.control import RegionCmd, RegionCmdType
+
+    return RegionCmd(
+        cmd_id=c.cmd_id,
+        region_id=c.region_id,
+        cmd_type=RegionCmdType(c.cmd_type),
+        definition=(region_def_from_pb(c.definition)
+                    if c.definition.region_id else None),
+        split_key=c.split_key,
+        child_region_id=c.child_region_id,
+        target_store_id=c.target_store_id,
+    )
+
+
 def queries_from_pb(vectors) -> np.ndarray:
     return np.asarray([list(v.values) for v in vectors], np.float32)

@@ -68,10 +68,26 @@ def serve_coordinator(args) -> None:
 
 def serve_store(args) -> None:
     engine = WalEngine(args.data_dir) if args.data_dir else MemEngine()
+    if args.raft_peers:
+        # multi-process replication: raft RPCs ride grpc between stores
+        from dingo_tpu.raft.grpc_transport import GrpcRaftTransport
+
+        transport = GrpcRaftTransport(args.id,
+                                      cluster_token=args.cluster_token)
+        for spec in args.raft_peers.split(","):
+            sid, eq, addr = spec.strip().partition("=")
+            if not eq or not sid or not addr:
+                raise SystemExit(
+                    f"--raft-peers: malformed entry {spec!r} "
+                    "(want store_id=host:port)"
+                )
+            transport.set_peer(sid.strip(), addr.strip())
+    else:
+        transport = _TRANSPORT
     # single-process deployments reach the coordinator object directly; a
     # remote coordinator is reached through the grpc heartbeat below
     node = StoreNode(
-        args.id, _TRANSPORT, coordinator=None, raw_engine=engine,
+        args.id, transport, coordinator=None, raw_engine=engine,
         snapshot_root=args.data_dir,
     )
     node.meta.recover()
@@ -81,6 +97,8 @@ def serve_store(args) -> None:
     server = DingoServer(args.port)
     server.host_store_role(node)
     port = server.start()
+    if args.raft_peers:
+        transport.set_peer(args.id, f"127.0.0.1:{port}")
 
     crontab = CrontabManager()
     hb_interval = FLAGS.get("server_heartbeat_interval_s")
@@ -150,6 +168,10 @@ def main(argv=None) -> int:
     p.add_argument("--data-dir", default="")
     p.add_argument("--replication", type=int, default=3)
     p.add_argument("--config", default="")
+    p.add_argument("--cluster-token", default="",
+                   help="shared secret gating the raft transport")
+    p.add_argument("--raft-peers", default="",
+                   help="store raft endpoints: s0=host:port,s1=host:port,...")
     args = p.parse_args(argv)
     if args.config:
         Config.load(args.config).apply_flag_overrides(FLAGS)

@@ -39,18 +39,7 @@ class RemoteHeartbeat:
         resp = self._stub.StoreHeartbeat(req)
         executed = 0
         for c in resp.commands:
-            cmd = RegionCmd(
-                cmd_id=c.cmd_id,
-                region_id=c.region_id,
-                cmd_type=RegionCmdType(c.cmd_type),
-                definition=(
-                    convert.region_def_from_pb(c.definition)
-                    if c.definition.region_id else None
-                ),
-                split_key=c.split_key,
-                child_region_id=c.child_region_id,
-                target_store_id=c.target_store_id,
-            )
+            cmd = convert.region_cmd_from_pb(c)
             try:
                 self.node.execute_region_cmd(cmd)
                 executed += 1

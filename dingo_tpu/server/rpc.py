@@ -84,6 +84,14 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "RequeueRegionCmd": (pb.RequeueRegionCmdRequest, pb.RequeueRegionCmdResponse),
         "GetGCSafePoint": (pb.GetGCSafePointRequest, pb.GetGCSafePointResponse),
     },
+    "RaftService": {
+        "RaftMessage": (pb.RaftMessageRequest, pb.RaftMessageResponse),
+    },
+    "PushService": {
+        "PushStoreOperation": (
+            pb.PushStoreOperationRequest, pb.PushStoreOperationResponse,
+        ),
+    },
     "VersionService": {
         "VKvPut": (pb.VKvPutRequest, pb.VKvPutResponse),
         "VKvRange": (pb.VKvRangeRequest, pb.VKvRangeResponse),
@@ -125,6 +133,13 @@ class DingoServer:
 
     def host_store_role(self, node) -> None:
         """--role=store|index service set (main.cc:681+)."""
+        from dingo_tpu.raft.grpc_transport import GrpcRaftTransport, RaftService
+        from dingo_tpu.server.services import PushService
+
+        if isinstance(node.engine.transport, GrpcRaftTransport):
+            _register(self._server, "RaftService",
+                      RaftService(node.engine.transport))
+        _register(self._server, "PushService", PushService(node))
         _register(self._server, "IndexService", IndexService(node))
         _register(self._server, "StoreService", StoreService(node))
         _register(self._server, "DocumentService", DocumentService(node))

@@ -507,6 +507,33 @@ class _ScanSessions:
 _SCAN_SESSIONS = _ScanSessions()
 
 
+class PushService:
+    """Coordinator -> store push of store operations (push_service.h — the
+    inverse of the heartbeat pull)."""
+
+    def __init__(self, node: StoreNode):
+        self.node = node
+
+    def PushStoreOperation(self, req: pb.PushStoreOperationRequest):
+        resp = pb.PushStoreOperationResponse()
+        for c in req.commands:
+            # per-command isolation: a malformed or failing command must not
+            # abort the batch or lose acks for commands that DID execute
+            try:
+                cmd = convert.region_cmd_from_pb(c)
+                self.node.execute_region_cmd(cmd)
+                resp.done_cmd_ids.append(c.cmd_id)
+            except NotLeader as e:
+                if self.node.coordinator is not None and e.leader_hint:
+                    self.node.coordinator.requeue_cmd(
+                        cmd, e.leader_hint.split("/")[0],
+                        from_store=self.node.store_id,
+                    )
+            except Exception:  # noqa: BLE001
+                pass
+        return resp
+
+
 class NodeService:
     def __init__(self, node: StoreNode):
         self.node = node
@@ -669,15 +696,7 @@ class CoordinatorService:
 
     def RequeueRegionCmd(self, req: pb.RequeueRegionCmdRequest):
         resp = pb.RequeueRegionCmdResponse()
-        c = req.cmd
-        cmd = RegionCmd(
-            cmd_id=c.cmd_id, region_id=c.region_id,
-            cmd_type=RegionCmdType(c.cmd_type),
-            definition=(convert.region_def_from_pb(c.definition)
-                        if c.definition.region_id else None),
-            split_key=c.split_key, child_region_id=c.child_region_id,
-            target_store_id=c.target_store_id,
-        )
+        cmd = convert.region_cmd_from_pb(req.cmd)
         self.control.requeue_cmd(cmd, req.target_store_id,
                                  from_store=req.from_store_id or None)
         return resp
