@@ -155,3 +155,44 @@ def test_worker_set_policies():
     hash_part = [i for i in done if i >= 30]
     assert hash_part == sorted(hash_part)
     ws.stop()
+
+
+def test_serial_roundtrip_and_ordering():
+    from dingo_tpu.common.serial import (
+        decode_row_key,
+        encode_row_key,
+        encode_value,
+    )
+    import random
+
+    values = [None, False, True, -(1 << 40), -1, 0, 7, 1 << 40,
+              -1e300, -2.5, -0.0, 0.0, 1.5, 3e7, "", "abc", "abd", "ab\x00"]
+    # roundtrip
+    for v in values:
+        got = decode_row_key(encode_value(v))
+        assert len(got) == 1
+        a = got[0]
+        assert (a == v) or (v is None and a is None) or (
+            isinstance(v, float) and a == v
+        ), (v, a)
+    # ordering: encoded bytes sort exactly like a (tag, value) tuple sort
+    def sort_key(v):
+        if v is None:
+            return (0,)
+        if isinstance(v, bool):
+            return (1, v)
+        if isinstance(v, int):
+            return (2, v)
+        if isinstance(v, float):
+            return (3, v)
+        return (4, v if isinstance(v, str) else v.decode())
+
+    want = sorted(values, key=sort_key)
+    got = sorted(values, key=lambda v: encode_value(v))
+    assert [sort_key(v) for v in got] == [sort_key(v) for v in want]
+    # composite keys order like tuples
+    rows = [(1, "b"), (1, "a"), (0, "z"), (2, ""), (1, "ab")]
+    enc = sorted(rows, key=lambda r: encode_row_key(r))
+    assert enc == sorted(rows)
+    assert decode_row_key(encode_row_key((7, "x", None, 2.5))) == \
+        [7, "x", None, 2.5]
