@@ -40,6 +40,9 @@ def apply_write(
     `context` (optional) is the hosting StoreNode for handlers that touch
     region topology (SplitHandler needs to create the child region and its
     raft member on EVERY replica applying the entry)."""
+    from dingo_tpu.common.failpoint import failpoint
+
+    failpoint("before_apply")
     if isinstance(data, wd.SplitRegionData):
         if context is None:
             raise NotImplementedError(

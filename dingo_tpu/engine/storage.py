@@ -150,6 +150,9 @@ class Storage:
     ) -> int:
         """Storage::VectorAdd (storage.cc:458-482): stamp TSO ts, build write
         payload, hand to the engine (raft propose or mono apply)."""
+        from dingo_tpu.common.failpoint import failpoint
+
+        failpoint("before_vector_add")
         vectors = np.asarray(vectors, np.float32)
         ids = np.asarray(ids, np.int64)
         self._validate_vector_batch(region, ids, vectors)

@@ -132,6 +132,7 @@ class VectorReader:
             queries = queries[None, :]
         base = FilterSpec(ranges=[self.ctx.id_window()])
 
+        radius = search_kw.pop("radius", 0.0)
         if filter_mode is VectorFilterMode.VECTOR_ID:
             # pre-filter on explicit ids (vector_reader.cc:216-222, :830)
             ids = np.asarray(sorted(set(map(int, vector_ids or []))), np.int64)
@@ -155,6 +156,10 @@ class VectorReader:
         else:
             results = self._search_with_fallback(queries, topk, base, **search_kw)
 
+        if radius:
+            # range-search semantics: keep hits within radius, capped at
+            # RANGE_SEARCH_CAP (vector_reader.cc:60)
+            results = [self._radius_cut(r, radius) for r in results]
         out: List[List[VectorWithData]] = []
         for r in results:
             row = [
@@ -166,6 +171,15 @@ class VectorReader:
             for row in out:
                 self._backfill(row, with_vector_data, with_scalar_data)
         return out
+
+    def _radius_cut(self, r: SearchResult, radius: float) -> SearchResult:
+        from dingo_tpu.ops.distance import Metric, metric_ascending
+
+        metric = self.ctx.parameter.metric if self.ctx.parameter else Metric.L2
+        keep = (r.distances <= radius) if metric_ascending(metric) \
+            else (r.distances >= radius)
+        return SearchResult(r.ids[keep][:RANGE_SEARCH_CAP],
+                            r.distances[keep][:RANGE_SEARCH_CAP])
 
     def vector_batch_query(
         self,

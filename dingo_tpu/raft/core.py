@@ -133,6 +133,9 @@ class RaftNode:
     def propose(self, payload: bytes, timeout: float = 5.0) -> int:
         """Append to the replicated log; blocks until applied locally.
         Returns the log index. Raises NotLeader / ProposalFailed."""
+        from dingo_tpu.common.failpoint import failpoint
+
+        failpoint("before_raft_propose")
         with self._lock:
             if self.role != LEADER:
                 raise NotLeader(self.leader_id)

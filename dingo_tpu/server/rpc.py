@@ -106,14 +106,23 @@ def _register(server: grpc.Server, service_name: str, impl) -> None:
     for method, (req_t, resp_t) in schema.items():
         fn = getattr(impl, method)
 
-        def make(fn, req_t):
+        def make(fn, req_t, resp_t):
             def handler(request, context):
-                return fn(request)
+                try:
+                    return fn(request)
+                except Exception as e:  # noqa: BLE001
+                    # unexpected failures (incl. injected failpoints) become
+                    # in-band errors instead of opaque grpc UNKNOWNs
+                    resp = resp_t()
+                    if hasattr(resp, "error"):
+                        resp.error.errcode = 99999
+                        resp.error.errmsg = f"{type(e).__name__}: {e}"
+                    return resp
 
             return handler
 
         handlers[method] = grpc.unary_unary_rpc_method_handler(
-            make(fn, req_t),
+            make(fn, req_t, resp_t),
             request_deserializer=req_t.FromString,
             response_serializer=resp_t.SerializeToString,
         )

@@ -76,8 +76,15 @@ class IndexService:
                 kw["nprobe"] = req.parameter.nprobe
             if req.parameter.ef_search:
                 kw["ef"] = req.parameter.ef_search
+            topn = req.parameter.top_n or 10
+            if req.parameter.radius > 0:
+                # VectorRangeSearch path: over-fetch to the cap, reader cuts
+                kw["radius"] = req.parameter.radius
+                from dingo_tpu.index.vector_reader import RANGE_SEARCH_CAP
+
+                topn = min(max(topn, 128), RANGE_SEARCH_CAP)
             results = self.node.storage.vector_batch_search(
-                region, queries, req.parameter.top_n or 10, **kw
+                region, queries, topn, **kw
             )
         except (VectorIndexError, ValueError) as e:
             return _err(resp, 30001, str(e))

@@ -170,3 +170,59 @@ def test_calc_distance_util(cluster):
     b.values.extend([0.0, 1.0])
     resp = stub.VectorCalcDistance(req)
     assert resp.distances[0].values[0] == pytest.approx(2.0, abs=1e-4)
+
+
+def test_range_search_over_grpc(cluster):
+    client, control, nodes = cluster
+    client.refresh_region_map()
+    region = next(d for d in client._regions if d.index_parameter is not None)
+    rng = np.random.default_rng(3)
+    q = rng.standard_normal((1, 16)).astype(np.float32)
+    # exact distances to pick a radius including exactly a few neighbors
+    res_all = client.vector_search(0, q, topk=20)
+    radius = res_all[0][4][1]  # include the 5 nearest
+    req = pb.VectorSearchRequest()
+    req.context.region_id = region.region_id
+    v = req.vectors.add()
+    v.values.extend(q[0].tolist())
+    req.parameter.top_n = 10
+    req.parameter.radius = float(radius)
+    leader = control.region_leaders.get(region.region_id, "s0")
+    resp = client._stub(leader, "IndexService").VectorSearch(req)
+    got = [(r.vector.id, r.distance) for r in resp.batch_results[0].results]
+    assert 0 < len(got) <= 10
+    assert all(d <= radius + 1e-4 for _, d in got)
+
+
+def test_failpoint_injects_into_write_path(cluster):
+    client, control, nodes = cluster
+    param = pb.VectorIndexParameter(
+        index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=16,
+        metric_type=pb.METRIC_TYPE_L2,
+    )
+    region = client.create_index_region(7, 0, 1 << 30, param)
+    time.sleep(1.0)
+    deadline = time.monotonic() + 5
+    leader_sid = None
+    while leader_sid is None and time.monotonic() < deadline:
+        leader_sid = next(
+            (sid for sid, n in nodes.items()
+             if (rn := n.engine.get_node(region.region_id)) and rn.is_leader()),
+            None,
+        )
+        time.sleep(0.05)
+    dbg = client._stub(leader_sid, "DebugService")
+    dbg.FailPoint(pb.FailPointRequest(name="before_vector_add",
+                                      config="100%1*panic"))
+    req = pb.VectorAddRequest()
+    req.context.region_id = region.region_id
+    v = req.vectors.add()
+    v.vector.id = 123
+    v.vector.values.extend([0.0] * 16)
+    resp = client._stub(leader_sid, "IndexService").VectorAdd(req)
+    # injected fault surfaces as an in-band error, then auto-disarms
+    assert resp.error.errcode == 99999
+    assert "failpoint" in resp.error.errmsg
+    resp2 = client._stub(leader_sid, "IndexService").VectorAdd(req)
+    assert resp2.error.errcode == 0
+    dbg.FailPoint(pb.FailPointRequest(name="before_vector_add", remove=True))
