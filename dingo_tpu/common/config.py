@@ -72,6 +72,9 @@ FLAGS.define("raft_snapshot_threshold", 10000, mutable=True)
 FLAGS.define("region_max_size_bytes", 256 * 1024 * 1024, mutable=True)
 FLAGS.define("split_check_approximate_keys", 1_000_000, mutable=True)
 FLAGS.define("gc_retention_ms", 3_600_000, mutable=True)
+FLAGS.define("use_pallas_fused_search", False, mutable=True,
+             help_="route flat L2/IP searches through the fused Pallas "
+                   "streaming kernel (no [b,n] HBM materialization)")
 
 
 class Config:

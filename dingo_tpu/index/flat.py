@@ -126,15 +126,7 @@ class _SlotStoreIndex(VectorIndex):
             mask = self.store.device_mask()
         else:
             mask = jnp.asarray(filter_spec.slot_mask(self.store.ids_by_slot))
-        dists, slots = _flat_search_kernel(
-            self.store.vecs,
-            self.store.sqnorm,
-            mask,
-            qpad,
-            k=int(topk),
-            metric=self._kernel_metric,
-            nbits=self._kernel_nbits,
-        )
+        dists, slots = self._run_search_kernel(qpad, mask, int(topk))
         store = self.store
         lease = store.begin_search()
         # Start the D2H copy as soon as the kernel finishes: the tunnel's
@@ -157,6 +149,36 @@ class _SlotStoreIndex(VectorIndex):
         """Kernel-score -> wire-distance hook (identity for float metrics;
         binary hamming converts from the cached-pm1 IP score)."""
         return dists
+
+    def _run_search_kernel(self, qpad, mask, k):
+        """XLA flat-scan kernel, or the fused Pallas streaming kernel when
+        FLAGS.use_pallas_fused_search is on (L2/IP only — the fused kernel
+        avoids materializing the [b, capacity] score matrix in HBM)."""
+        from dingo_tpu.common.config import FLAGS
+        from dingo_tpu.ops.distance import metric_ascending
+
+        use_fused = (
+            FLAGS.get("use_pallas_fused_search")
+            and self._kernel_metric in (Metric.L2, Metric.INNER_PRODUCT)
+            and self.store.capacity >= 2048
+        )
+        if use_fused:
+            from dingo_tpu.ops.pallas_topk import fused_search
+
+            vals, slots = fused_search(
+                qpad, self.store.vecs, self.store.sqnorm,
+                mask, k, ascending=metric_ascending(self._kernel_metric),
+            )
+            return scores_to_distances(vals, self._kernel_metric), slots
+        return _flat_search_kernel(
+            self.store.vecs,
+            self.store.sqnorm,
+            mask,
+            qpad,
+            k=k,
+            metric=self._kernel_metric,
+            nbits=self._kernel_nbits,
+        )
 
     # -- lifecycle ---------------------------------------------------------
     def get_count(self) -> int:

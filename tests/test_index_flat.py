@@ -237,3 +237,23 @@ def test_dimension_mismatch_rejected(corpus):
     idx.add(ids[:2], x[:2])
     with pytest.raises(InvalidParameter):
         idx.search(np.zeros((1, 16), np.float32), 1)
+
+
+def test_fused_pallas_path_matches_xla(corpus):
+    """FLAGS.use_pallas_fused_search routes flat search through the fused
+    streaming kernel with identical results (interpret mode off-TPU)."""
+    from dingo_tpu.common.config import FLAGS
+
+    ids, x = corpus
+    idx = make_index()
+    idx.add(ids, x)
+    want = idx.search(x[:4], 7)
+    FLAGS.set("use_pallas_fused_search", True)
+    try:
+        got = idx.search(x[:4], 7)
+    finally:
+        FLAGS.set("use_pallas_fused_search", False)
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(a.ids, b.ids)
+        np.testing.assert_allclose(a.distances, b.distances, rtol=5e-3,
+                                   atol=5e-2)
