@@ -10,7 +10,6 @@ SURVEY.md §5).
 
 from __future__ import annotations
 
-import pickle
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -21,6 +20,7 @@ from dingo_tpu.index import codec as vcodec
 from dingo_tpu.server import pb
 from dingo_tpu.server.convert import region_def_from_pb, scalar_from_pb
 from dingo_tpu.server.rpc import ServiceStub
+from dingo_tpu.raft import wire
 
 
 class ClientError(RuntimeError):
@@ -145,7 +145,7 @@ class DingoClient:
                     for k, val in scalars[i].items():
                         e = v.scalar_data.add()
                         e.key = k
-                        e.value = pickle.dumps(val)
+                        e.value = wire.encode_obj(val)
             self._call_leader(d, "IndexService", "VectorAdd", req)
 
     def vector_search(

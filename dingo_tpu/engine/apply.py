@@ -29,6 +29,7 @@ from dingo_tpu.index import codec as vcodec
 from dingo_tpu.index.vector_reader import serialize_scalar, serialize_vector
 from dingo_tpu.mvcc.codec import Codec, ValueFlag
 from dingo_tpu.store.region import Region
+from dingo_tpu.raft import wire
 
 
 def apply_write(
@@ -169,8 +170,6 @@ def _apply_document_add(
 ) -> None:
     """DocumentAdd handler: persist docs (source of truth) then update the
     in-memory full-text index — same dual-write contract as vectors."""
-    import pickle as _pickle
-
     part = region.definition.partition_id
     batch = WriteBatch()
     for did, doc in zip(data.ids, data.documents):
@@ -178,7 +177,7 @@ def _apply_document_add(
         batch.put(
             CF_DEFAULT,
             Codec.encode_key(key, data.ts),
-            Codec.package_value(_pickle.dumps(doc, protocol=4)),
+            Codec.package_value(wire.encode_obj(doc)),
         )
     engine.write(batch)
     if region.document_index is not None and (

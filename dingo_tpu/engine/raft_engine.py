@@ -10,13 +10,13 @@ through the same apply handlers the mono engine uses
 
 from __future__ import annotations
 
-import pickle
 import threading
 from typing import Dict, Optional
 
 from dingo_tpu.engine.apply import apply_write
 from dingo_tpu.engine.raw_engine import ALL_CFS, CF_META, RawEngine, WriteBatch
-from dingo_tpu.engine.write_data import WriteData
+from dingo_tpu.engine.write_data import WriteData, decode_write, encode_write
+from dingo_tpu.raft import wire
 from dingo_tpu.index import codec as vcodec
 from dingo_tpu.mvcc.codec import Codec
 from dingo_tpu.index.vector_reader import ReaderContext, VectorReader
@@ -85,7 +85,7 @@ class RaftStoreEngine:
         region_id = region.id
 
         def apply_fn(index: int, payload: bytes) -> None:
-            data = pickle.loads(payload)
+            data = decode_write(payload)
             apply_write(self.raw, region, data, index, context=self.context)
 
         def snapshot_save() -> bytes:
@@ -93,12 +93,10 @@ class RaftStoreEngine:
             # RocksDB SSTs through DingoFileSystemAdaptor): only this
             # region's key range, across all CFs — a store hosts many
             # regions on one raw engine and must not ship the others.
-            return pickle.dumps(
-                region_snapshot(self.raw, region), protocol=4
-            )
+            return wire.encode(region_snapshot(self.raw, region))
 
         def snapshot_install(blob: bytes) -> None:
-            region_install(self.raw, region, pickle.loads(blob))
+            region_install(self.raw, region, wire.decode(blob))
             # in-memory index must be rebuilt after a state install
             wrapper = region.vector_index_wrapper
             if wrapper is not None:
@@ -143,7 +141,7 @@ class RaftStoreEngine:
         node = self.get_node(region.id)
         if node is None:
             raise RuntimeError(f"no raft node for region {region.id}")
-        payload = pickle.dumps(data, protocol=4)
+        payload = encode_write(data)
         return node.propose(payload, timeout=timeout)
 
     # -- Engine::VectorReader -------------------------------------------------

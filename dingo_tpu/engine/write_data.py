@@ -5,8 +5,11 @@ constructs typed RaftCmdRequest payloads (KV puts, vector adds with cf/ts/ttl,
 deletes); the same payload is applied by the raft state machine on every
 replica (handler/raft_apply_handler.h:29-193).
 
-These dataclasses are the wire-neutral equivalents; raft serializes them with
-pickle for replication (a protobuf schema lands with the grpc service layer).
+These dataclasses are the wire-neutral equivalents; `encode_write` /
+`decode_write` serialize them with the typed TLV codec (raft/wire.py) for
+replication — decoding network bytes can only ever produce these dataclass
+shapes, never execute code (the reference gets the same property from
+protobuf-typed RaftCmdRequest messages).
 """
 
 from __future__ import annotations
@@ -15,6 +18,8 @@ import dataclasses
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from dingo_tpu.raft import wire
 
 
 @dataclasses.dataclass
@@ -120,3 +125,44 @@ class TxnRaftData:
 
 
 WriteData = Any  # union of the payload dataclasses above
+
+_PAYLOAD_TYPES = {
+    cls.__name__: cls
+    for cls in (
+        KvPutData, KvDeleteData, KvDeleteRangeData, VectorAddData,
+        VectorDeleteData, RebuildVectorIndexData, SplitRegionData,
+        DocumentAddData, DocumentDeleteData, MergeRegionData, TxnRaftData,
+    )
+}
+
+def encode_write(data: WriteData) -> bytes:
+    """Raft proposal payload bytes for any of the dataclasses above."""
+    fields = {
+        f.name: wire.to_plain(getattr(data, f.name))
+        for f in dataclasses.fields(data)
+    }
+    return wire.encode({"kind": type(data).__name__, "fields": fields})
+
+
+def decode_write(payload: bytes) -> WriteData:
+    """Inverse of encode_write; raises wire.WireError on malformed bytes.
+    Decoded ndarrays are read-only views over the wire buffer; tuples decode
+    as lists (apply handlers only iterate/unpack)."""
+    d = wire.decode(payload)
+    if not isinstance(d, dict) or "kind" not in d or "fields" not in d:
+        raise wire.WireError("decode_write: not a WriteData envelope")
+    cls = _PAYLOAD_TYPES.get(d["kind"])
+    if cls is None:
+        raise wire.WireError(f"decode_write: unknown payload kind {d['kind']!r}")
+    fields = d["fields"]
+    if not isinstance(fields, dict):
+        raise wire.WireError("decode_write: fields must be a dict")
+    names = {f.name for f in dataclasses.fields(cls)}
+    if set(fields) - names:
+        raise wire.WireError(
+            f"decode_write: unexpected fields {set(fields) - names}"
+        )
+    try:
+        return cls(**{k: wire.from_plain(v) for k, v in fields.items()})
+    except (TypeError, ValueError) as e:
+        raise wire.WireError(f"decode_write: bad fields: {e}") from e

@@ -161,6 +161,10 @@ class _SlotStoreIndex(VectorIndex):
             FLAGS.get("use_pallas_fused_search")
             and self._kernel_metric in (Metric.L2, Metric.INNER_PRODUCT)
             and self.store.capacity >= 2048
+            # float stores only: TpuBinaryFlat reaches here with an int8
+            # ±1 store (kernel metric IP) and mixed-dtype dot under Mosaic
+            # is unvalidated on TPU; keep it on the XLA path.
+            and self.store.vecs.dtype == jnp.float32
         )
         if use_fused:
             from dingo_tpu.ops.pallas_topk import fused_search

@@ -17,7 +17,6 @@ the source of truth and every index tracks apply_log_id.
 from __future__ import annotations
 
 import os
-import pickle
 import threading
 from typing import Optional
 
@@ -150,7 +149,7 @@ class VectorIndexManager:
             return 0
         n = 0
         for log_id, _term, payload in raft_log.get_data_entries(start, end):
-            data = pickle.loads(payload)
+            data = wd.decode_write(payload)
             if isinstance(data, wd.VectorAddData):
                 index.upsert(data.ids, data.vectors)
             elif isinstance(data, wd.VectorDeleteData):

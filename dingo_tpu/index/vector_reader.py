@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-import pickle
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -41,6 +40,7 @@ from dingo_tpu.index.flat import TpuFlat
 from dingo_tpu.index.wrapper import VectorIndexWrapper
 from dingo_tpu.mvcc.codec import MAX_TS
 from dingo_tpu.mvcc.reader import Reader as MvccReader
+from dingo_tpu.raft import wire
 
 #: FLAGS_vector_index_bruteforce_batch_count (vector_reader.cc:61)
 BRUTEFORCE_BATCH = 2048
@@ -100,11 +100,11 @@ def deserialize_vector(b: bytes, dim: int) -> np.ndarray:
 
 
 def serialize_scalar(scalar: Dict[str, Any]) -> bytes:
-    return pickle.dumps(scalar, protocol=4)
+    return wire.encode_obj(scalar)
 
 
 def deserialize_scalar(b: bytes) -> Dict[str, Any]:
-    return pickle.loads(b)
+    return wire.decode_obj(b)
 
 
 class VectorReader:

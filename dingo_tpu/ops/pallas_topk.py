@@ -54,9 +54,12 @@ def _fused_kernel(q_ref, qsq_ref, x_ref, xsq_ref, valid_ref,
 
     q = q_ref[:]                                          # [b, d]
     x = x_ref[:]                                          # [C, d]
+    # HIGHEST precision: the default bf16-pass matmul measurably costs
+    # recall (distance.py pins the same; flat recall@10 0.9875 -> 1.0).
     dots = jax.lax.dot_general(
         q, x, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )                                                     # [b, C]
     if ascending:  # L2: score = -(||q||^2 - 2qx + ||x||^2)
         scores = -(qsq_ref[:] - 2.0 * dots + xsq_ref[:])  # [b,1] + [1,C]
@@ -79,8 +82,12 @@ def _fused_kernel(q_ref, qsq_ref, x_ref, xsq_ref, valid_ref,
 
     @pl.when(j == nblocks - 1)
     def _finish():
-        out_v_ref[:] = best_v[:]
-        out_i_ref[:] = best_i[:]
+        fv = best_v[:]
+        out_v_ref[:] = fv
+        # -inf picks are argmax-of-all-masked artifacts: they carry real
+        # (and duplicated) slot ids. Map them to -1 like the XLA path
+        # (topk.py maps -inf picks to -1) so filter-excluded ids never leak.
+        out_i_ref[:] = jnp.where(jnp.isneginf(fv), -1, best_i[:])
 
 
 @functools.partial(

@@ -248,6 +248,11 @@ class RaftNode:
         self._advance_commit()
 
     def _replicate_to(self, peer: str) -> None:
+        # Decide snapshot-vs-append under _lock, but CALL _send_snapshot
+        # outside it: _send_snapshot takes _apply_mutex, and
+        # _apply_committed takes _apply_mutex then _lock — calling it
+        # while holding _lock inverts the lock order (deadlock).
+        need_snapshot = False
         with self._lock:
             if self.role != LEADER:
                 return
@@ -255,15 +260,18 @@ class RaftNode:
             nxt = self.next_index.get(peer, self.log.last_index() + 1)
             # Follower too far behind the compacted log -> snapshot install
             if nxt <= self.log.snapshot_index:
-                self._send_snapshot(peer, term)
-                return
-            prev_index = nxt - 1
-            prev_term = self.log.term_at(prev_index)
-            if prev_term is None:
-                self._send_snapshot(peer, term)
-                return
-            entries = self.log.entries_from(nxt)
-            commit = self.commit_index
+                need_snapshot = True
+            else:
+                prev_index = nxt - 1
+                prev_term = self.log.term_at(prev_index)
+                if prev_term is None:
+                    need_snapshot = True
+                else:
+                    entries = self.log.entries_from(nxt)
+                    commit = self.commit_index
+        if need_snapshot:
+            self._send_snapshot(peer, term)
+            return
         resp = self.transport.send(peer, "append_entries", {
             "from": self.id, "term": term, "prev_index": prev_index,
             "prev_term": prev_term, "entries": entries, "commit": commit,

@@ -10,12 +10,13 @@ handler. Local targets short-circuit in process.
 
 from __future__ import annotations
 
-import pickle
+import hmac
 import threading
 from typing import Callable, Dict, Optional
 
 import grpc
 
+from dingo_tpu.raft import wire
 from dingo_tpu.raft.transport import Transport
 from dingo_tpu.server import pb
 from dingo_tpu.server.rpc import ServiceStub
@@ -26,9 +27,9 @@ class GrpcRaftTransport(Transport):
                  peer_addrs: Optional[Dict[str, str]] = None,
                  cluster_token: str = ""):
         self.store_id = store_id
-        #: shared cluster secret: the raft port deserializes cluster-internal
-        #: payloads (pickle, like braft trusts its cluster network), so
-        #: out-of-cluster senders are rejected before deserialization
+        #: shared cluster secret rejecting out-of-cluster senders; payloads
+        #: themselves are a typed TLV codec (raft/wire.py) that can only
+        #: produce plain data, so a forged message cannot execute code
         self.cluster_token = cluster_token
         self._peer_addrs = dict(peer_addrs or {})
         self._handlers: Dict[str, Callable[[str, dict], dict]] = {}
@@ -88,7 +89,7 @@ class GrpcRaftTransport(Transport):
             resp = stub.RaftMessage(
                 pb.RaftMessageRequest(
                     target=target, method=method,
-                    payload=pickle.dumps(msg, protocol=4),
+                    payload=wire.encode(msg),
                     cluster_token=self.cluster_token,
                 ),
                 timeout=2.0,
@@ -97,7 +98,10 @@ class GrpcRaftTransport(Transport):
             return None
         if not resp.delivered:
             return None
-        return pickle.loads(resp.payload)
+        try:
+            return wire.decode(resp.payload)
+        except wire.WireError:
+            return None
 
     def close(self) -> None:
         with self._lock:
@@ -114,17 +118,24 @@ class RaftService:
 
     def RaftMessage(self, req: pb.RaftMessageRequest) -> pb.RaftMessageResponse:
         resp = pb.RaftMessageResponse()
-        if req.cluster_token != self.transport.cluster_token:
+        if not hmac.compare_digest(
+            req.cluster_token.encode(), self.transport.cluster_token.encode()
+        ):
             resp.delivered = False
             resp.error.errcode = 95001
             resp.error.errmsg = "cluster token mismatch"
             return resp
-        out = self.transport.dispatch(
-            req.target, req.method, pickle.loads(req.payload)
-        )
+        try:
+            msg = wire.decode(req.payload)
+        except wire.WireError:
+            resp.delivered = False
+            resp.error.errcode = 95002
+            resp.error.errmsg = "malformed raft payload"
+            return resp
+        out = self.transport.dispatch(req.target, req.method, msg)
         if out is None:
             resp.delivered = False
         else:
             resp.delivered = True
-            resp.payload = pickle.dumps(out, protocol=4)
+            resp.payload = wire.encode(out)
         return resp

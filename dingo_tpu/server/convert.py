@@ -2,7 +2,6 @@
 
 from __future__ import annotations
 
-import pickle
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -13,6 +12,7 @@ from dingo_tpu.index.vector_reader import VectorFilterMode, VectorFilterType
 from dingo_tpu.ops.distance import Metric
 from dingo_tpu.server import pb
 from dingo_tpu.store.region import RegionDefinition, RegionEpoch, RegionType
+from dingo_tpu.raft import wire
 
 _METRIC_TO_PB = {
     Metric.L2: pb.METRIC_TYPE_L2,
@@ -105,18 +105,18 @@ def scalar_to_pb(entries, scalar: Optional[Dict[str, Any]]) -> None:
     for k, v in (scalar or {}).items():
         e = entries.add()
         e.key = k
-        e.value = pickle.dumps(v)
+        e.value = wire.encode_obj(v)
 
 
 def scalar_from_pb(entries) -> Dict[str, Any]:
-    return {e.key: pickle.loads(e.value) for e in entries}
+    return {e.key: wire.decode_obj(e.value) for e in entries}
 
 
 def predicates_from_pb(preds) -> Optional[ScalarFilter]:
     if not preds:
         return None
     return ScalarFilter([
-        ScalarPredicate(p.field, CmpOp(p.op), pickle.loads(p.value))
+        ScalarPredicate(p.field, CmpOp(p.op), wire.decode_obj(p.value))
         for p in preds
     ])
 

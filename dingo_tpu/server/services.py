@@ -12,7 +12,6 @@ codegen plugin in this image); registration uses generic method handlers.
 from __future__ import annotations
 
 import json
-import pickle
 import time
 from typing import Dict, Optional
 

@@ -26,6 +26,7 @@ from dingo_tpu.engine.raft_engine import RaftStoreEngine
 from dingo_tpu.engine.raw_engine import MemEngine, RawEngine
 from dingo_tpu.engine.storage import Storage
 from dingo_tpu.index.manager import VectorIndexManager
+from dingo_tpu.raft import wire
 from dingo_tpu.store.region import (
     Region,
     RegionDefinition,
@@ -208,8 +209,6 @@ class StoreNode:
     def rebuild_document_index(self, region: Region) -> int:
         """Repopulate a DOCUMENT region's full-text index from the engine
         (dual-write recovery contract, same as the vector index)."""
-        import pickle as _pickle
-
         from dingo_tpu.mvcc.reader import Reader as _MvccReader
         from dingo_tpu.engine.raw_engine import CF_DEFAULT as _CFD
         from dingo_tpu.index import codec as _vcodec
@@ -228,7 +227,7 @@ class StoreNode:
             if did is None:
                 continue
             try:
-                region.document_index.upsert(did, _pickle.loads(blob))
+                region.document_index.upsert(did, wire.decode_obj(blob))
                 n += 1
             except Exception:
                 continue

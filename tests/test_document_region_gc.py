@@ -54,7 +54,7 @@ def test_document_region_over_grpc():
     )
     req = pb.DocumentAddRequest()
     req.context.region_id = d.region_id
-    import pickle
+    from dingo_tpu.raft import wire
 
     for did, text in [(1, "tpu raft storage"), (2, "vector search engine"),
                       (3, "raft consensus replication")]:
@@ -62,7 +62,7 @@ def test_document_region_over_grpc():
         e.id = did
         f = e.fields.add()
         f.key = "text"
-        f.value = pickle.dumps(text)
+        f.value = wire.encode(text)
     resp = stub.DocumentAdd(req)
     assert resp.error.errcode == 0
 

@@ -1,7 +1,6 @@
 """VectorIndexManager: build / rebuild+catch-up / save+load / scrub
 (reference vector_index_manager.cc §3.4 lifecycle)."""
 
-import pickle
 
 import numpy as np
 import pytest
@@ -60,14 +59,14 @@ def test_replay_wal_catchup(tmp_path):
     index = mgr.build_index(region)
     log = RaftLog()
     for i in range(50, 60):
-        log.append(1, pickle.dumps(wd.VectorAddData(
+        log.append(1, wd.encode_write(wd.VectorAddData(
             ts=1, ids=np.asarray([i], np.int64), vectors=x[i:i + 1],
         )))
-    log.append(1, pickle.dumps(wd.VectorDeleteData(
+    log.append(1, wd.encode_write(wd.VectorDeleteData(
         ts=2, ids=np.asarray([0, 1], np.int64),
     )))
     # overlap: replaying an add the scan already saw must be harmless
-    log.append(1, pickle.dumps(wd.VectorAddData(
+    log.append(1, wd.encode_write(wd.VectorAddData(
         ts=3, ids=np.asarray([10], np.int64), vectors=x[10:11],
     )))
     n = mgr.replay_wal(index, region, log, 1, log.last_index())
@@ -121,8 +120,8 @@ def test_save_load_snapshot_with_wal_replay(tmp_path):
     # fresh wrapper (restart): load snapshot + replay the log tail
     log = RaftLog()
     for _ in range(7):
-        log.append(1, pickle.dumps(wd.KvPutData(cf="default", ts=1, kvs=[])))
-    extra = pickle.dumps(wd.VectorAddData(
+        log.append(1, wd.encode_write(wd.KvPutData(cf="default", ts=1, kvs=[])))
+    extra = wd.encode_write(wd.VectorAddData(
         ts=2, ids=np.asarray([999], np.int64),
         vectors=rng.standard_normal((1, DIM)).astype(np.float32),
     ))

@@ -59,3 +59,19 @@ def test_fully_masked_returns_minus_one(data):
     x, q, xd, xsq = data
     vals, ids = fused_search(q, xd, xsq, jnp.zeros(len(x)), 4, block=512)
     assert (np.asarray(ids) == -1).all()
+
+
+def test_fewer_valid_than_k_pads_with_minus_one(data):
+    """k > number of valid vectors: the surplus picks are -inf and must
+    come back as -1, not as leaked/duplicated real slot ids (round-1
+    advisor repro: 3 valid over 2 blocks, k=5 returned [0, 2, 1, 0, 0])."""
+    x, q, xd, xsq = data
+    valid = np.zeros(len(x), bool)
+    valid[[0, 1, 600]] = True  # spans two 512-blocks
+    vals, ids = fused_search(q, xd, xsq, jnp.asarray(valid), 5, block=512)
+    ids = np.asarray(ids)
+    assert set(ids[:, :3].ravel()) <= {0, 1, 600}
+    # each query returns the 3 valid ids exactly once, then -1 padding
+    for row in ids:
+        assert sorted(row[:3]) == [0, 1, 600]
+        assert (row[3:] == -1).all()

@@ -1,0 +1,77 @@
+"""Raft wire codec: round-trip + malformed-input rejection.
+
+Replaces round-1 pickle (advisor: raft-port RCE). The codec must cover
+exactly the payload shapes RaftNode sends (dicts of scalars, entry tuples,
+snapshot blobs) and reject anything malformed instead of executing it.
+"""
+
+import pytest
+
+from dingo_tpu.raft import wire
+
+
+CASES = [
+    None,
+    True,
+    False,
+    0,
+    -1,
+    2**62,
+    -(2**62),
+    1.5,
+    float("inf"),
+    "",
+    "héllo",
+    b"",
+    b"\x00\xff" * 100,
+    [],
+    {},
+    [1, "a", b"b", None, [2, 3]],
+    {"from": "s1/r7", "term": 3, "entries": [(1, 1, b"x"), (2, 1, b"y")],
+     "commit": 2, "ok": True, "blob": b"\x00" * 1000},
+]
+
+
+@pytest.mark.parametrize("obj", CASES, ids=range(len(CASES)))
+def test_roundtrip(obj):
+    got = wire.decode(wire.encode(obj))
+
+    def norm(o):
+        if isinstance(o, (list, tuple)):
+            return [norm(i) for i in o]
+        if isinstance(o, dict):
+            return {k: norm(v) for k, v in o.items()}
+        return o
+
+    assert norm(got) == norm(obj)
+
+
+def test_append_entries_shape_survives():
+    """The exact message _replicate_to sends: entries unpack as 3-tuples."""
+    msg = {"from": "a", "term": 5, "prev_index": 9, "prev_term": 4,
+           "entries": [(10, 5, b"p1"), (11, 5, b"p2")], "commit": 9}
+    got = wire.decode(wire.encode(msg))
+    for index, term, payload in got["entries"]:
+        assert isinstance(index, int) and isinstance(payload, bytes)
+
+
+@pytest.mark.parametrize("bad", [
+    b"",                      # empty
+    b"\x63",                  # unknown tag
+    b"\x03\x00",              # truncated int
+    b"\x05\x00\x00\x00\x00\x00\x00\x00\x09abc",  # str len 9, 3 bytes
+    wire.encode({"a": 1}) + b"x",                # trailing garbage
+    b"\x07" + b"\xff" * 8,    # list claims 2^64 items
+    b"\x08\x00\x00\x00\x00\x00\x00\x00\x01" + b"\x03" + b"\x00" * 8 + b"\x00",
+    # ^ dict with non-str (int) key
+])
+def test_malformed_rejected(bad):
+    with pytest.raises(wire.WireError):
+        wire.decode(bad)
+
+
+def test_unsupported_type_rejected():
+    with pytest.raises(wire.WireError):
+        wire.encode(object())
+    with pytest.raises(wire.WireError):
+        wire.encode({1: "non-str-key"})
