@@ -75,6 +75,10 @@ FLAGS.define("gc_retention_ms", 3_600_000, mutable=True)
 FLAGS.define("use_pallas_fused_search", False, mutable=True,
              help_="route flat L2/IP searches through the fused Pallas "
                    "streaming kernel (no [b,n] HBM materialization)")
+FLAGS.define("use_pallas_ivf_search", False, mutable=True,
+             help_="route trained IVF_FLAT searches through the Pallas "
+                   "list-DMA kernel (streams only probed buckets to VMEM; "
+                   "no per-rank [b,cap,d] gather materialization)")
 
 
 class Config:

@@ -12,11 +12,14 @@ TPU-first design:
            (max_points_per_centroid * nlist, faiss ClusteringParameters
            convention), deterministic farthest-first init.
   layout — ground truth lives in a flat SlotStore (same arrays as TpuFlat);
-           a *bucketed view* [nlist, cap_list, d] grouped by coarse
-           assignment is (re)built lazily after mutations. cap_list pads to
-           the largest list (power of two), keeping shapes static for XLA.
-  search — [b, nlist] centroid scores -> top-nprobe probe ids -> lax.scan
-           over probe ranks: gather one bucket per query per rank
+           a *bucketed view* [B, cap_list, d] of fixed-width spill buckets
+           (ivf_layout.py) is (re)built lazily after mutations. cap_list
+           tracks the MEAN list size; long lists spill into extra buckets,
+           so HBM is bounded by ~n*d + nlist*cap_list*d regardless of
+           assignment skew.
+  search — [b, nlist] centroid scores -> top-nprobe coarse lists ->
+           on-device expansion to virtual bucket probes -> lax.scan over
+           probe ranks: gather one bucket per query per rank
            ([b, cap_list, d] dynamic gather), distance einsum, running
            top-k merge. HBM traffic per query ~ nprobe/nlist of the index
            (vs full scan) — the win IVF exists for. (A Pallas kernel that
@@ -50,6 +53,7 @@ from dingo_tpu.index.base import (
     strip_invalid,
 )
 from dingo_tpu.index.flat import _SlotStoreIndex, _pad_batch
+from dingo_tpu.index.ivf_layout import BucketLayout, build_layout, expand_probes
 from dingo_tpu.index.slot_store import SlotStore, _next_pow2
 from dingo_tpu.ops.distance import (
     Metric,
@@ -105,11 +109,13 @@ def _ivf_scan_kernel(
 
     def body(carry, r):
         best_vals, best_slots = carry
-        lists_r = jnp.take(probes, r, axis=1)        # [b]
-        data = jnp.take(buckets, lists_r, axis=0)    # [b, cap_list, d]
-        sq = jnp.take(bucket_sqnorm, lists_r, axis=0)
-        val = jnp.take(bucket_valid, lists_r, axis=0)
-        slot = jnp.take(bucket_slot, lists_r, axis=0)
+        lists_r = jnp.take(probes, r, axis=1)        # [b] (-1 = padded rank)
+        rank_ok = lists_r >= 0
+        lists_c = jnp.where(rank_ok, lists_r, 0)
+        data = jnp.take(buckets, lists_c, axis=0)    # [b, cap_list, d]
+        sq = jnp.take(bucket_sqnorm, lists_c, axis=0)
+        val = jnp.take(bucket_valid, lists_c, axis=0) & rank_ok[:, None]
+        slot = jnp.take(bucket_slot, lists_c, axis=0)
         # per-query distance to its own bucket: einsum over d
         if metric is Metric.L2:
             dots = jnp.einsum(
@@ -155,11 +161,9 @@ class TpuIvfFlat(_SlotStoreIndex):
         self.centroids: Optional[jax.Array] = None       # [nlist, d]
         self._c_sqnorm: Optional[jax.Array] = None
         self._assign_h = np.full((self.store.capacity,), -1, np.int32)
-        self._buckets = None          # [nlist, cap_list, d]
+        self._layout: Optional[BucketLayout] = None
+        self._buckets = None          # [B, cap_list, d]
         self._bucket_sqnorm = None
-        self._bucket_valid = None
-        self._bucket_slot = None
-        self._bucket_pos: dict[int, tuple[int, int]] = {}  # slot -> (list, pos)
         self._view_dirty = True
 
     def _prep_vectors(self, vectors: np.ndarray) -> np.ndarray:
@@ -246,41 +250,20 @@ class TpuIvfFlat(_SlotStoreIndex):
 
     # -- bucketed view ------------------------------------------------------
     def _rebuild_view(self) -> None:
-        """Group live slots by coarse list into padded static buckets."""
-        live = np.flatnonzero(self.store.valid_h)
-        assign = self._assign_h[live]
-        counts = np.bincount(assign[assign >= 0], minlength=self.nlist)
-        cap_list = max(8, _next_pow2(int(counts.max()) if len(counts) else 1))
-        order = np.argsort(assign, kind="stable")
-        live, assign = live[order], assign[order]
-        pos_in_list = np.zeros(len(live), np.int64)
-        bucket_slot = np.full((self.nlist, cap_list), -1, np.int32)
-        fill = np.zeros(self.nlist, np.int64)
-        self._bucket_pos.clear()
-        for s, a in zip(live, assign):
-            p = fill[a]
-            bucket_slot[a, p] = s
-            self._bucket_pos[int(s)] = (int(a), int(p))
-            fill[a] = p + 1
-        safe = np.where(bucket_slot >= 0, bucket_slot, 0)
-        gather_idx = jnp.asarray(safe.reshape(-1), jnp.int32)
-        data = jnp.take(self.store.vecs, gather_idx, axis=0).reshape(
-            self.nlist, cap_list, self.dimension
+        """Group live slots into fixed-width spill buckets (ivf_layout.py)."""
+        lay = build_layout(self._assign_h, self.store.valid_h, self.nlist)
+        self._layout = lay
+        self._buckets = lay.gather_rows(self.store.vecs)
+        self._bucket_sqnorm = jnp.take(self.store.sqnorm, lay.gather_idx).reshape(
+            lay.nbuckets, lay.cap_list
         )
-        sq = jnp.take(self.store.sqnorm, gather_idx).reshape(
-            self.nlist, cap_list
-        )
-        self._buckets = data
-        self._bucket_sqnorm = sq
-        self._bucket_slot = jnp.asarray(bucket_slot)
-        self._bucket_valid = jnp.asarray(bucket_slot >= 0)
         self._view_dirty = False
 
     def _bucket_valid_for_filter(self, filter_spec: Optional[FilterSpec]):
         if filter_spec is None or filter_spec.is_empty():
-            return self._bucket_valid
+            return self._layout.bucket_valid
         mask = filter_spec.slot_mask(self.store.ids_by_slot)
-        bucket_slot = np.asarray(self._bucket_slot)
+        bucket_slot = self._layout.bucket_slot_h
         safe = np.where(bucket_slot >= 0, bucket_slot, 0)
         bmask = mask[safe] & (bucket_slot >= 0)
         return jnp.asarray(bmask)
@@ -310,18 +293,40 @@ class TpuIvfFlat(_SlotStoreIndex):
         b = queries.shape[0]
         nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
         qpad = jnp.asarray(_pad_batch(queries))
+        lay = self._layout
         probes = _probe_lists(qpad, self.centroids, self._c_sqnorm, nprobe)
+        vprobes = expand_probes(probes, lay.probe_table, nprobe, lay.max_spill)
         valid = self._bucket_valid_for_filter(filter_spec)
-        dists, slots = _ivf_scan_kernel(
-            self._buckets,
-            self._bucket_sqnorm,
-            valid,
-            self._bucket_slot,
-            probes,
-            qpad,
-            k=int(topk),
-            metric=self.metric,
-        )
+        from dingo_tpu.common.config import FLAGS
+
+        if (
+            FLAGS.get("use_pallas_ivf_search")
+            and self.metric in (Metric.L2, Metric.INNER_PRODUCT, Metric.COSINE)
+            and self.store.vecs.dtype == jnp.float32
+            # kernel keeps top-k in a 128-lane output block; larger k (and
+            # its unrolled select rounds) stays on the XLA path
+            and int(topk) <= 64
+        ):
+            from dingo_tpu.ops.distance import metric_ascending
+            from dingo_tpu.ops.pallas_ivf import ivf_list_search
+
+            vals, slots = ivf_list_search(
+                vprobes, qpad, self._buckets, self._bucket_sqnorm,
+                valid, lay.bucket_slot, k=int(topk),
+                ascending=metric_ascending(self.metric),
+            )
+            dists = scores_to_distances(vals, self.metric)
+        else:
+            dists, slots = _ivf_scan_kernel(
+                self._buckets,
+                self._bucket_sqnorm,
+                valid,
+                lay.bucket_slot,
+                vprobes,
+                qpad,
+                k=int(topk),
+                metric=self.metric,
+            )
         store = self.store
         lease = store.begin_search()
         dists.copy_to_host_async()

@@ -44,6 +44,7 @@ from dingo_tpu.index.base import (
 )
 from dingo_tpu.index.flat import _SlotStoreIndex, _flat_search_kernel, _pad_batch
 from dingo_tpu.index.ivf_flat import _probe_lists
+from dingo_tpu.index.ivf_layout import build_layout, expand_probes_ranked
 from dingo_tpu.index.slot_store import SlotStore, _next_pow2
 from dingo_tpu.ops.distance import Metric, normalize, pairwise_l2sqr, squared_norms
 from dingo_tpu.ops.kmeans import (
@@ -68,33 +69,38 @@ def _encode_residual(vectors, assign, centroids, codebooks):
     return jax.vmap(enc_one)(subs, codebooks).T.astype(jnp.uint8)
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(jax.jit, static_argnames=("k", "precompute_lut"))
 def _ivfpq_scan_kernel(
-    code_buckets,      # [nlist, cap_list, m] uint8
-    bucket_valid,      # [nlist, cap_list] bool
-    bucket_slot,       # [nlist, cap_list] int32
-    probes,            # [b, nprobe] int32
+    code_buckets,      # [B, cap_list, m] uint8 (spill buckets, ivf_layout.py)
+    bucket_valid,      # [B, cap_list] bool
+    bucket_slot,       # [B, cap_list] int32
+    bucket_coarse,     # [B] int32: coarse list of each bucket (for residuals)
+    probes_coarse,     # [b, nprobe] int32 coarse probe ranking
+    probes,            # [b, budget] int32 virtual bucket ids (-1 pad)
+    coarse_pos,        # [b, budget] int32 coarse rank of each virtual probe
     queries,           # [b, d] f32
     centroids,         # [nlist, d] f32
     codebooks,         # [m, ksub, dsub] f32
     k,
+    precompute_lut,
 ):
-    """ADC scan over probed lists with per-(query, list) residual LUTs."""
+    """ADC scan over probed lists with per-(query, list) residual LUTs.
+
+    precompute_lut=True builds the [b, nprobe, m, ksub] LUT once over the
+    COARSE probe ranking and gathers per rank — a hot list's spill buckets
+    then share one LUT instead of recomputing it per bucket. The flag is
+    static so callers can fall back when the LUT would not fit HBM."""
     b, d = queries.shape
     m, ksub, dsub = codebooks.shape
-    nprobe = probes.shape[1]
     neg_inf = jnp.float32(-jnp.inf)
     cb_sq = jnp.einsum(
         "mkd,mkd->mk", codebooks, codebooks,
         precision=jax.lax.Precision.HIGHEST,
     )                                                   # [m, ksub]
 
-    def body(carry, r):
-        best_vals, best_slots = carry
-        lists_r = jnp.take(probes, r, axis=1)           # [b]
-        qr = queries - jnp.take(centroids, lists_r, axis=0)   # residual targets
-        # LUT[b, m, ksub] = ||qr_sub - codeword||^2
-        qsubs = split_subvectors(qr, m)                 # [m, b, dsub]
+    def lut_for(resid):
+        """residual targets [n, d] -> LUT [n, m, ksub]."""
+        qsubs = split_subvectors(resid, m)              # [m, n, dsub]
         dots = jnp.einsum(
             "mbd,mkd->mbk", qsubs, codebooks,
             preferred_element_type=jnp.float32,
@@ -104,12 +110,36 @@ def _ivfpq_scan_kernel(
             "mbd,mbd->mb", qsubs, qsubs,
             precision=jax.lax.Precision.HIGHEST,
         )
-        lut = q_sq[:, :, None] - 2.0 * dots + cb_sq[:, None, :]  # [m, b, ksub]
-        lut = jnp.transpose(lut, (1, 0, 2))             # [b, m, ksub]
+        lut = q_sq[:, :, None] - 2.0 * dots + cb_sq[:, None, :]  # [m, n, ksub]
+        return jnp.transpose(lut, (1, 0, 2))            # [n, m, ksub]
 
-        codes = jnp.take(code_buckets, lists_r, axis=0)  # [b, cap, m]
-        val = jnp.take(bucket_valid, lists_r, axis=0)
-        slot = jnp.take(bucket_slot, lists_r, axis=0)
+    if precompute_lut:
+        nprobe = probes_coarse.shape[1]
+        resid_all = queries[:, None, :] - jnp.take(
+            centroids, probes_coarse, axis=0
+        )                                               # [b, nprobe, d]
+        lut_all = lut_for(resid_all.reshape(b * nprobe, d)).reshape(
+            b, nprobe, m, ksub
+        )
+
+    def body(carry, r):
+        best_vals, best_slots = carry
+        vlists = jnp.take(probes, r, axis=1)            # [b] virtual bucket ids
+        rank_ok = vlists >= 0
+        bkt = jnp.where(rank_ok, vlists, 0)
+        if precompute_lut:
+            cp = jnp.take(coarse_pos, r, axis=1)        # [b]
+            lut = jnp.take_along_axis(
+                lut_all, cp[:, None, None, None], axis=1
+            )[:, 0]                                     # [b, m, ksub]
+        else:
+            lists_r = jnp.take(bucket_coarse, bkt)      # coarse list per bucket
+            qr = queries - jnp.take(centroids, lists_r, axis=0)
+            lut = lut_for(qr)                           # [b, m, ksub]
+
+        codes = jnp.take(code_buckets, bkt, axis=0)      # [b, cap, m]
+        val = jnp.take(bucket_valid, bkt, axis=0) & rank_ok[:, None]
+        slot = jnp.take(bucket_slot, bkt, axis=0)
         # ADC: dist[b, cap] = sum_m LUT[b, m, codes[b, cap, m]]
         codes_t = jnp.transpose(codes, (0, 2, 1)).astype(jnp.int32)  # [b, m, cap]
         gathered = jnp.take_along_axis(lut, codes_t, axis=2)         # [b, m, cap]
@@ -124,7 +154,7 @@ def _ivfpq_scan_kernel(
         jnp.full((b, k), neg_inf, jnp.float32),
         jnp.full((b, k), -1, jnp.int32),
     )
-    (vals, slots), _ = jax.lax.scan(body, init, jnp.arange(nprobe))
+    (vals, slots), _ = jax.lax.scan(body, init, jnp.arange(probes.shape[1]))
     return -vals, slots    # wire convention: squared-L2-approx ascending
 
 
@@ -151,9 +181,8 @@ class TpuIvfPq(_SlotStoreIndex):
         self.codebooks: Optional[jax.Array] = None       # [m, ksub, dsub]
         self._assign_h = np.full((self.store.capacity,), -1, np.int32)
         self._codes: Optional[jax.Array] = None          # [capacity, m] uint8
-        self._code_buckets = None
-        self._bucket_valid = None
-        self._bucket_slot = None
+        self._code_buckets = None                        # [B, cap_list, m]
+        self._layout = None
         self._view_dirty = True
         self._kernel_metric = p.metric
         self._kernel_nbits = 0
@@ -260,31 +289,16 @@ class TpuIvfPq(_SlotStoreIndex):
 
     # -- bucketed view -------------------------------------------------------
     def _rebuild_view(self) -> None:
-        live = np.flatnonzero(self.store.valid_h)
-        assign = self._assign_h[live]
-        counts = np.bincount(assign[assign >= 0], minlength=self.nlist)
-        cap_list = max(8, _next_pow2(int(counts.max()) if len(counts) else 1))
-        order = np.argsort(assign, kind="stable")
-        live, assign = live[order], assign[order]
-        bucket_slot = np.full((self.nlist, cap_list), -1, np.int32)
-        fill = np.zeros(self.nlist, np.int64)
-        for s, a in zip(live, assign):
-            bucket_slot[a, fill[a]] = s
-            fill[a] += 1
-        safe = np.where(bucket_slot >= 0, bucket_slot, 0)
-        gidx = jnp.asarray(safe.reshape(-1), jnp.int32)
-        self._code_buckets = jnp.take(self._codes, gidx, axis=0).reshape(
-            self.nlist, cap_list, self.m
-        )
-        self._bucket_slot = jnp.asarray(bucket_slot)
-        self._bucket_valid = jnp.asarray(bucket_slot >= 0)
+        lay = build_layout(self._assign_h, self.store.valid_h, self.nlist)
+        self._layout = lay
+        self._code_buckets = lay.gather_rows(self._codes)
         self._view_dirty = False
 
     def _bucket_valid_for_filter(self, filter_spec: Optional[FilterSpec]):
         if filter_spec is None or filter_spec.is_empty():
-            return self._bucket_valid
+            return self._layout.bucket_valid
         mask = filter_spec.slot_mask(self.store.ids_by_slot)
-        bucket_slot = np.asarray(self._bucket_slot)
+        bucket_slot = self._layout.bucket_slot_h
         safe = np.where(bucket_slot >= 0, bucket_slot, 0)
         return jnp.asarray(mask[safe] & (bucket_slot >= 0))
 
@@ -324,17 +338,28 @@ class TpuIvfPq(_SlotStoreIndex):
             if self._view_dirty:
                 self._rebuild_view()
             nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
+            lay = self._layout
             probes = _probe_lists(qpad, self.centroids, self._c_sqnorm, nprobe)
+            vprobes, coarse_pos = expand_probes_ranked(
+                probes, lay.probe_table, nprobe, lay.max_spill
+            )
             valid = self._bucket_valid_for_filter(filter_spec)
+            # share one residual LUT across a list's spill buckets when the
+            # [b, nprobe, m, ksub] table fits comfortably in HBM
+            lut_bytes = qpad.shape[0] * nprobe * self.m * self.ksub * 4
             dists, slots = _ivfpq_scan_kernel(
                 self._code_buckets,
                 valid,
-                self._bucket_slot,
+                lay.bucket_slot,
+                lay.bucket_coarse,
                 probes,
+                vprobes,
+                coarse_pos,
                 qpad,
                 self.centroids,
                 self.codebooks,
                 k=int(topk),
+                precompute_lut=lut_bytes <= 256 * 1024 * 1024,
             )
         lease = store.begin_search()
         dists.copy_to_host_async()

@@ -1,0 +1,90 @@
+"""Spill-bucket IVF layout: skew-bounded memory + probe expansion parity.
+
+Round-1 regression: the bucketed view padded every list to the largest
+list's pow2 size, so one hot list multiplied total HBM by the skew factor.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dingo_tpu.index.base import IndexParameter, IndexType
+from dingo_tpu.index.ivf_flat import TpuIvfFlat
+from dingo_tpu.index.ivf_layout import build_layout, expand_probes
+
+
+def test_layout_memory_bounded_under_skew():
+    """One list holding 50% of rows must not inflate the other 255 lists."""
+    nlist, n = 64, 20_000
+    assign = np.random.default_rng(0).integers(0, nlist, n).astype(np.int32)
+    assign[: n // 2] = 7  # hot list
+    valid = np.ones(n + 100, bool)
+    valid[n:] = False
+    assign = np.concatenate([assign, np.full(100, -1, np.int32)])
+    lay = build_layout(assign, valid, nlist)
+    total_rows = lay.nbuckets * lay.cap_list
+    # bounded: data + <=1 partial bucket per list (+pow2 rounding of cap)
+    assert total_rows <= n + (nlist + 1) * lay.cap_list
+    # the round-1 layout would be nlist * pow2(n/2) = 64 * 16384 rows
+    assert total_rows < nlist * 16384 / 4
+    assert lay.max_spill > 1
+    # every live slot appears exactly once
+    slots = lay.bucket_slot_h[lay.bucket_slot_h >= 0]
+    assert sorted(slots) == sorted(np.flatnonzero(valid & (assign >= 0)))
+    # probe_table covers exactly each list's buckets
+    probe = np.asarray(lay.probe_table)
+    coarse = np.asarray(lay.bucket_coarse)
+    for lst in (7, 0, nlist - 1):
+        buckets = probe[lst][probe[lst] >= 0]
+        assert (coarse[buckets] == lst).all()
+        got_slots = lay.bucket_slot_h[buckets]
+        got_slots = got_slots[got_slots >= 0]
+        want = np.flatnonzero(valid & (assign == lst))
+        assert sorted(got_slots) == sorted(want)
+
+
+def test_expand_probes_rank_order_and_budget():
+    nlist = 8
+    assign = np.repeat(np.arange(nlist), 40).astype(np.int32)
+    assign[:120] = 0  # list 0 spills
+    valid = np.ones(len(assign), bool)
+    lay = build_layout(assign, valid, nlist, cap_hint=32)
+    assert lay.max_spill >= 2
+    probes = jnp.asarray([[0, 3, 5], [5, 3, 0]], jnp.int32)
+    virt = np.asarray(expand_probes(probes, lay.probe_table, 3, lay.max_spill))
+    coarse = np.asarray(lay.bucket_coarse)
+    for row, order in zip(virt, ([0, 3, 5], [5, 3, 0])):
+        lists_seen = [coarse[v] for v in row if v >= 0]
+        # rank order preserved: first occurrences follow the probe order
+        firsts = [lists_seen.index(l) for l in order]
+        assert firsts == sorted(firsts)
+        # all probed lists' buckets present (budget not exceeded here)
+        assert set(lists_seen) == set(order)
+
+
+def test_ivf_flat_search_exact_under_skew():
+    """Skewed corpus: searching with nprobe=nlist must equal exact search."""
+    rng = np.random.default_rng(1)
+    d, nlist = 24, 16
+    hot = rng.standard_normal((1, d)).astype(np.float32)
+    x = np.concatenate([
+        hot + 0.01 * rng.standard_normal((3000, d)).astype(np.float32),
+        rng.standard_normal((1000, d)).astype(np.float32) * 5,
+    ])
+    ids = np.arange(len(x), dtype=np.int64)
+    idx = TpuIvfFlat(1, IndexParameter(
+        index_type=IndexType.IVF_FLAT, dimension=d, ncentroids=nlist,
+    ))
+    idx.upsert(ids, x)
+    idx.train()
+    q = x[[5, 3500]] + 0.001
+    res = idx.search(q, 10, nprobe=nlist)
+    d2 = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    want = np.argsort(d2, 1)[:, :10]
+    for qi, (r, w) in enumerate(zip(res, want)):
+        # near-duplicate corpus -> f32 ties at the tail; any symmetric-
+        # difference member must be within tie tolerance of the 10th best
+        cutoff = d2[qi, w[-1]]
+        for got in set(r.ids) - set(ids[w]):
+            assert d2[qi, got] <= cutoff + 1e-3, (got, d2[qi, got], cutoff)
+        assert len(set(r.ids) & set(ids[w])) >= 8
