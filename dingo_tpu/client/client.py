@@ -34,6 +34,7 @@ class DingoClient:
         self._coord_channel = grpc.insecure_channel(coordinator_addr)
         self.coordinator = ServiceStub(self._coord_channel, "CoordinatorService")
         self.version = ServiceStub(self._coord_channel, "VersionService")
+        self.meta = ServiceStub(self._coord_channel, "MetaService")
         self._store_addrs = dict(store_addrs)
         self._channels: Dict[str, grpc.Channel] = {}
         self._regions: List = []           # RegionDefinition list
@@ -117,6 +118,87 @@ class DingoClient:
         if resp.error.errcode:
             raise ClientError(resp.error.errmsg)
         return resp.child_region_id
+
+    # ---------------- table meta API (reference Java SDK table ops) -------
+    def create_schema(self, name: str) -> None:
+        resp = self.meta.CreateSchema(pb.CreateSchemaRequest(schema_name=name))
+        if resp.error.errcode:
+            raise ClientError(resp.error.errmsg)
+
+    def get_schemas(self) -> List[str]:
+        return list(self.meta.GetSchemas(pb.GetSchemasRequest()).schema_names)
+
+    def create_vector_table(
+        self, schema: str, name: str,
+        index_parameter: "pb.VectorIndexParameter",
+        partitions: Sequence[Tuple[int, int, int]] = ((0, 0, 1 << 40),),
+        replication: int = 0,
+    ):
+        """Create an index table: partitions = [(partition_id, id_lo, id_hi)].
+        Returns the TableDef pb (with region ids filled in)."""
+        req = pb.CreateTableRequest()
+        d = req.definition
+        d.schema_name, d.name = schema, name
+        d.table_type = 1
+        d.replication = replication
+        d.index_parameter.CopyFrom(index_parameter)
+        for pid, lo, hi in partitions:
+            p = d.partitions.add()
+            p.partition_id, p.id_lo, p.id_hi = pid, lo, hi
+        resp = self.meta.CreateTable(req)
+        if resp.error.errcode:
+            raise ClientError(resp.error.errmsg)
+        self.refresh_region_map()
+        return resp.definition
+
+    def get_table(self, schema: str, name: str):
+        resp = self.meta.GetTable(pb.GetTableRequest(
+            schema_name=schema, table_name=name))
+        return resp.definition if resp.found else None
+
+    def list_tables(self, schema: str):
+        return list(self.meta.GetTables(
+            pb.GetTablesRequest(schema_name=schema)).definitions)
+
+    def drop_table(self, schema: str, name: str) -> None:
+        resp = self.meta.DropTable(pb.DropTableRequest(
+            schema_name=schema, table_name=name))
+        if resp.error.errcode:
+            raise ClientError(resp.error.errmsg)
+        self.refresh_region_map()
+
+    def table_vector_add(self, table, ids, vectors, scalars=None) -> None:
+        """Route rows to the owning partition by id window."""
+        import numpy as _np
+
+        ids = _np.asarray(ids, _np.int64)
+        for p in table.partitions:
+            sel = [i for i, vid in enumerate(ids)
+                   if p.id_lo <= vid < p.id_hi]
+            if not sel:
+                continue
+            self.vector_add(
+                p.partition_id, ids[sel].tolist(),
+                _np.asarray(vectors)[sel],
+                [scalars[i] for i in sel] if scalars is not None else None,
+            )
+
+    def table_vector_search(self, table, queries, topk: int = 10, **params):
+        """Scatter over every partition, merge top-k client-side
+        (metric-aware: IP/COSINE similarity descends)."""
+        asc = table.index_parameter.metric_type in (
+            pb.METRIC_TYPE_L2, pb.METRIC_TYPE_HAMMING
+        )
+        per_part = [
+            self.vector_search(p.partition_id, queries, topk, **params)
+            for p in table.partitions
+        ]
+        out = []
+        for qi in range(len(per_part[0])):
+            allhits = [h for part in per_part for h in part[qi]]
+            allhits.sort(key=lambda t: t[1], reverse=not asc)
+            out.append(allhits[:topk])
+        return out
 
     def tso(self, count: int = 1) -> int:
         resp = self.coordinator.Tso(pb.TsoRequest(count=count))

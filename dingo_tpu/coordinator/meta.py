@@ -1,0 +1,269 @@
+"""Schema / table / index metadata layer on the coordinator.
+
+Reference: CoordinatorControl's schema+table meta and MetaService RPCs
+(src/coordinator/coordinator_control.h:187 schema/table state;
+src/server/meta_service.cc CreateTable/DropTable/GetTables/...). The
+reference seeds default schemas (root/meta/dingo) and stores table
+definitions whose partitions map to regions; the SDK then speaks in
+tables rather than raw regions.
+
+Here a table is a named definition whose partitions each own one region:
+vector/document partitions own an id-window region (vector key codec),
+plain TABLE partitions own a raw key-range region. Region placement,
+replication, split/merge stay CoordinatorControl's job — dropping a table
+drops its regions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+from dingo_tpu.coordinator.control import CoordinatorControl
+from dingo_tpu.engine.raw_engine import CF_META, RawEngine
+from dingo_tpu.index import codec as vcodec
+from dingo_tpu.index.base import IndexParameter
+from dingo_tpu.raft import wire
+from dingo_tpu.store.region import RegionType
+
+_PREFIX_SCHEMA = b"meta/schema/"
+_PREFIX_TABLE = b"meta/table/"
+_KEY_TABLE_ID = b"meta/next_table_id"
+
+#: reference's built-in schemas (coordinator seeds root/meta/dingo)
+DEFAULT_SCHEMAS = ("root", "meta", "dingo")
+
+
+class MetaError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class ColumnDefinition:
+    name: str
+    sql_type: str = "VARCHAR"
+    nullable: bool = True
+    primary: bool = False
+
+
+@dataclasses.dataclass
+class PartitionDefinition:
+    partition_id: int
+    #: vector/document partitions: [id_lo, id_hi) vector-id window
+    id_lo: int = 0
+    id_hi: int = 0
+    #: plain TABLE partitions: raw key range
+    start_key: bytes = b""
+    end_key: bytes = b""
+    region_id: int = 0
+
+
+@dataclasses.dataclass
+class TableDefinition:
+    table_id: int
+    schema_name: str
+    name: str
+    table_type: RegionType = RegionType.STORE
+    columns: List[ColumnDefinition] = dataclasses.field(default_factory=list)
+    partitions: List[PartitionDefinition] = dataclasses.field(
+        default_factory=list
+    )
+    index_parameter: Optional[IndexParameter] = None
+    replication: int = 0
+
+
+def _table_to_plain(t: TableDefinition) -> dict:
+    d = dataclasses.asdict(t)
+    d["table_type"] = t.table_type.value
+    if t.index_parameter is not None:
+        p = dataclasses.asdict(t.index_parameter)
+        p["index_type"] = t.index_parameter.index_type.value
+        p["metric"] = t.index_parameter.metric.value
+        d["index_parameter"] = p
+    return d
+
+
+def _table_from_plain(d: dict) -> TableDefinition:
+    from dingo_tpu.index.base import IndexType
+    from dingo_tpu.ops.distance import Metric
+
+    ip = d.get("index_parameter")
+    param = None
+    if ip is not None:
+        ip = dict(ip)
+        ip["index_type"] = IndexType(ip["index_type"])
+        ip["metric"] = Metric(ip["metric"])
+        param = IndexParameter(**ip)
+    return TableDefinition(
+        table_id=d["table_id"],
+        schema_name=d["schema_name"],
+        name=d["name"],
+        table_type=RegionType(d["table_type"]),
+        columns=[ColumnDefinition(**c) for c in d["columns"]],
+        partitions=[PartitionDefinition(**p) for p in d["partitions"]],
+        index_parameter=param,
+        replication=d.get("replication", 0),
+    )
+
+
+class MetaControl:
+    """Schema/table registry persisted in the coordinator's meta CF."""
+
+    def __init__(self, engine: RawEngine, control: CoordinatorControl):
+        self.engine = engine
+        self.control = control
+        self._lock = threading.Lock()
+        self.schemas: Dict[str, List[str]] = {}     # schema -> table names
+        self.tables: Dict[str, TableDefinition] = {}  # "schema.table" -> def
+        self._creating: set = set()   # names reserved by in-flight creates
+        self._next_table_id = 1
+        self._recover()
+        for s in DEFAULT_SCHEMAS:
+            if s not in self.schemas:
+                self._put_schema(s)
+
+    # -- persistence ---------------------------------------------------------
+    def _recover(self) -> None:
+        blob = self.engine.get(CF_META, _KEY_TABLE_ID)
+        if blob:
+            self._next_table_id = wire.decode(blob)
+        for k, v in self.engine.scan(CF_META, _PREFIX_SCHEMA,
+                                     _PREFIX_SCHEMA + b"\xff"):
+            self.schemas[wire.decode(v)] = []
+        for k, v in self.engine.scan(CF_META, _PREFIX_TABLE,
+                                     _PREFIX_TABLE + b"\xff"):
+            t = _table_from_plain(wire.decode(v))
+            self.tables[f"{t.schema_name}.{t.name}"] = t
+            self.schemas.setdefault(t.schema_name, []).append(t.name)
+
+    def _put_schema(self, name: str) -> None:
+        self.schemas[name] = self.schemas.get(name, [])
+        self.engine.put(CF_META, _PREFIX_SCHEMA + name.encode(),
+                        wire.encode(name))
+
+    def _put_table(self, t: TableDefinition) -> None:
+        self.engine.put(
+            CF_META, _PREFIX_TABLE + str(t.table_id).encode(),
+            wire.encode(_table_to_plain(t)),
+        )
+
+    # -- schemas -------------------------------------------------------------
+    def create_schema(self, name: str) -> None:
+        if not name:
+            raise MetaError("empty schema name")
+        with self._lock:
+            if name in self.schemas:
+                raise MetaError(f"schema {name!r} exists")
+            self._put_schema(name)
+
+    def drop_schema(self, name: str) -> None:
+        with self._lock:
+            if name not in self.schemas:
+                raise MetaError(f"schema {name!r} not found")
+            if self.schemas[name]:
+                raise MetaError(f"schema {name!r} not empty")
+            if name in DEFAULT_SCHEMAS:
+                raise MetaError(f"schema {name!r} is built-in")
+            del self.schemas[name]
+            self.engine.delete(CF_META, _PREFIX_SCHEMA + name.encode())
+
+    def get_schemas(self) -> List[str]:
+        with self._lock:
+            return sorted(self.schemas)
+
+    # -- tables --------------------------------------------------------------
+    def create_table(
+        self,
+        schema_name: str,
+        name: str,
+        partitions: List[PartitionDefinition],
+        columns: Optional[List[ColumnDefinition]] = None,
+        index_parameter: Optional[IndexParameter] = None,
+        table_type: Optional[RegionType] = None,
+        replication: int = 0,
+    ) -> TableDefinition:
+        """CreateTable (meta_service.cc): allocate the table id, create one
+        region per partition, persist the definition."""
+        if table_type is None:
+            table_type = (
+                RegionType.INDEX if index_parameter is not None
+                else RegionType.STORE
+            )
+        key = f"{schema_name}.{name}"
+        with self._lock:
+            if schema_name not in self.schemas:
+                raise MetaError(f"schema {schema_name!r} not found")
+            if key in self.tables or key in self._creating:
+                raise MetaError(f"table {key} exists")
+            if not partitions:
+                raise MetaError("table needs >= 1 partition")
+            # reserve the name: region creation below runs outside the lock
+            # (it is slow), and a concurrent same-name create must fail now
+            self._creating.add(key)
+            table_id = self._next_table_id
+            self._next_table_id += 1
+            self.engine.put(CF_META, _KEY_TABLE_ID,
+                            wire.encode(self._next_table_id))
+        created = []
+        try:
+            for p in partitions:
+                if table_type in (RegionType.INDEX, RegionType.DOCUMENT):
+                    start = vcodec.encode_vector_key(p.partition_id, p.id_lo)
+                    end = vcodec.encode_vector_key(p.partition_id, p.id_hi)
+                else:
+                    start, end = p.start_key, p.end_key
+                d = self.control.create_region(
+                    start, end,
+                    partition_id=p.partition_id,
+                    region_type=table_type,
+                    index_parameter=index_parameter,
+                    replication=replication or None,
+                )
+                p.region_id = d.region_id
+                created.append(d.region_id)
+        except Exception:
+            for rid in created:
+                self.control.drop_region(rid)
+            with self._lock:
+                self._creating.discard(key)
+            raise
+        t = TableDefinition(
+            table_id=table_id,
+            schema_name=schema_name,
+            name=name,
+            table_type=table_type,
+            columns=columns or [],
+            partitions=partitions,
+            index_parameter=index_parameter,
+            replication=replication,
+        )
+        with self._lock:
+            self._creating.discard(key)
+            self.tables[key] = t
+            self.schemas[schema_name].append(name)
+            self._put_table(t)
+        return t
+
+    def drop_table(self, schema_name: str, name: str) -> None:
+        key = f"{schema_name}.{name}"
+        with self._lock:
+            t = self.tables.get(key)
+            if t is None:
+                raise MetaError(f"table {key} not found")
+            del self.tables[key]
+            self.schemas[schema_name].remove(name)
+            self.engine.delete(
+                CF_META, _PREFIX_TABLE + str(t.table_id).encode()
+            )
+        for p in t.partitions:
+            self.control.drop_region(p.region_id)
+
+    def get_table(self, schema_name: str, name: str) -> Optional[TableDefinition]:
+        with self._lock:
+            return self.tables.get(f"{schema_name}.{name}")
+
+    def get_tables(self, schema_name: str) -> List[TableDefinition]:
+        with self._lock:
+            return [t for t in self.tables.values()
+                    if t.schema_name == schema_name]

@@ -33,8 +33,23 @@ MAX_TOPN_BATCH_PRODUCT = 10 * VECTOR_MAX_BATCH_COUNT
 class Storage:
     def __init__(self, engine, ts_provider: Optional[TsProvider] = None):
         """engine: MonoStoreEngine or RaftStoreEngine (same surface)."""
+        import threading
+
         self.engine = engine
         self.ts_provider = ts_provider or TsProvider()
+        self._locks_guard = threading.Lock()
+        self._region_locks: Dict[int, Any] = {}
+
+    def _region_lock(self, region: Region):
+        """Serializes read-check-write primitives per region (the reference
+        uses Latches/ConcurrencyManager for the same job, latch.h:27-95)."""
+        import threading
+
+        with self._locks_guard:
+            lock = self._region_locks.get(region.id)
+            if lock is None:
+                lock = self._region_locks[region.id] = threading.Lock()
+            return lock
 
     # ---------------- KV ----------------------------------------------------
 
@@ -57,36 +72,43 @@ class Storage:
         return ts
 
     def kv_put_if_absent(
-        self, region: Region, kvs: Sequence[Tuple[bytes, bytes]]
+        self, region: Region, kvs: Sequence[Tuple[bytes, bytes]],
+        is_atomic: bool = False,
     ) -> List[bool]:
-        """KvPutIfAbsent semantics: per-key success flags."""
+        """KvPutIfAbsent semantics: per-key success flags. is_atomic: all
+        keys must be absent or nothing is written (store_service.cc
+        KvBatchPutIfAbsent atomic arm)."""
         reader = MvccReader(self.engine.raw, CF_DEFAULT)
-        ts = self.ts_provider.get_ts()
-        wins, results = [], []
-        for k, v in kvs:
-            if reader.kv_get(k, MAX_TS) is None:
-                wins.append((k, v))
-                results.append(True)
-            else:
-                results.append(False)
-        if wins:
-            self.engine.write(
-                region, wd.KvPutData(cf=CF_DEFAULT, ts=ts, kvs=wins)
-            )
-        return results
+        with self._region_lock(region):
+            ts = self.ts_provider.get_ts()
+            wins, results = [], []
+            for k, v in kvs:
+                if reader.kv_get(k, MAX_TS) is None:
+                    wins.append((k, v))
+                    results.append(True)
+                else:
+                    results.append(False)
+            if is_atomic and not all(results):
+                return [False] * len(results)
+            if wins:
+                self.engine.write(
+                    region, wd.KvPutData(cf=CF_DEFAULT, ts=ts, kvs=wins)
+                )
+            return results
 
     def kv_compare_and_set(
         self, region: Region, key: bytes, expect: Optional[bytes], value: bytes
     ) -> bool:
         reader = MvccReader(self.engine.raw, CF_DEFAULT)
-        cur = reader.kv_get(key, MAX_TS)
-        if cur != expect:
-            return False
-        ts = self.ts_provider.get_ts()
-        self.engine.write(
-            region, wd.KvPutData(cf=CF_DEFAULT, ts=ts, kvs=[(key, value)])
-        )
-        return True
+        with self._region_lock(region):
+            cur = reader.kv_get(key, MAX_TS)
+            if cur != expect:
+                return False
+            ts = self.ts_provider.get_ts()
+            self.engine.write(
+                region, wd.KvPutData(cf=CF_DEFAULT, ts=ts, kvs=[(key, value)])
+            )
+            return True
 
     def kv_batch_delete(self, region: Region, keys: Sequence[bytes]) -> int:
         ts = self.ts_provider.get_ts()
@@ -130,9 +152,14 @@ class Storage:
         if vectors.nbytes > VECTOR_MAX_REQUEST_SIZE:
             raise InvalidParameter("request exceeds 32MiB")
         param = region.definition.index_parameter
-        if param and vectors.shape[1] != param.dimension:
+        from dingo_tpu.index.vector_reader import is_binary_dim_param
+
+        want = None
+        if param:
+            want = param.dimension // 8 if is_binary_dim_param(param)                 else param.dimension
+        if want is not None and vectors.shape[1] != want:
             raise InvalidParameter(
-                f"dimension {vectors.shape[1]} != {param.dimension}"
+                f"row width {vectors.shape[1]} != {want}"
             )
         lo, hi = region.id_window()
         ids = np.asarray(ids, np.int64)
@@ -153,7 +180,12 @@ class Storage:
         from dingo_tpu.common.failpoint import failpoint
 
         failpoint("before_vector_add")
-        vectors = np.asarray(vectors, np.float32)
+        from dingo_tpu.index.vector_reader import is_binary_dim_param
+
+        if is_binary_dim_param(region.definition.index_parameter):
+            vectors = np.asarray(vectors, np.uint8)
+        else:
+            vectors = np.asarray(vectors, np.float32)
         ids = np.asarray(ids, np.int64)
         self._validate_vector_batch(region, ids, vectors)
         ts = self.ts_provider.get_ts()
@@ -178,7 +210,14 @@ class Storage:
         self, region: Region, queries: np.ndarray, topk: int, **kw
     ) -> List[List[VectorWithData]]:
         """Storage::VectorBatchSearch (storage.cc:577)."""
-        queries = np.asarray(queries, np.float32)
+        from dingo_tpu.index.vector_reader import is_binary_dim_param
+
+        qdtype = (
+            np.uint8
+            if is_binary_dim_param(region.definition.index_parameter)
+            else np.float32
+        )
+        queries = np.asarray(queries, qdtype)
         if queries.ndim == 1:
             queries = queries[None, :]
         if len(queries) > VECTOR_MAX_BATCH_COUNT:

@@ -25,6 +25,10 @@ def new_index(index_id: int, parameter: IndexParameter) -> VectorIndex:
         from dingo_tpu.index.ivf_flat import TpuIvfFlat
 
         return TpuIvfFlat(index_id, parameter)
+    if t is IndexType.BINARY_IVF_FLAT:
+        from dingo_tpu.index.ivf_flat import TpuBinaryIvfFlat
+
+        return TpuBinaryIvfFlat(index_id, parameter)
     if t is IndexType.IVF_PQ:
         from dingo_tpu.index.ivf_pq import TpuIvfPq
 

@@ -275,24 +275,15 @@ class TpuFlat(_SlotStoreIndex):
         self.write_count_since_save = 0
 
 
-class TpuBinaryFlat(_SlotStoreIndex):
-    """Binary (uint8 bit-packed) exact hamming search — the reference's
-    faiss::IndexBinaryFlat variant (vector_index_flat.h binary template arm).
-    dimension is in BITS; the wire format is [n, dimension//8] uint8.
+class BinaryPm1Mixin:
+    """Shared bit-packed <-> ±1 codec for binary indexes (TpuBinaryFlat,
+    TpuBinaryIvfFlat). dimension is in BITS; wire rows are dimension//8
+    uint8. Unpacking happens ONCE at write time into a ±1 int8 store so
+    every search is an int8 MXU matmul —
+    hamming(a, b) = (nbits - <pm(a), pm(b)>) / 2."""
 
-    Device layout: vectors are unpacked ONCE at write time into a cached
-    +/-1 int8 matrix [capacity, nbits] so every search is a single int8
-    MXU matmul — hamming(a,b) = (nbits - <pm(a), pm(b)>) / 2. (Unpacking
-    inside the search kernel would redo a 32x blowup per query batch.)"""
-
-    def __init__(self, index_id: int, parameter: IndexParameter):
-        super().__init__(index_id, parameter)
-        if parameter.dimension <= 0 or parameter.dimension % 8:
-            raise InvalidParameter("binary dimension must be multiple of 8")
-        self.nbytes = parameter.dimension // 8
-        self.store = SlotStore(parameter.dimension, jnp.int8)
-        self._kernel_metric = Metric.INNER_PRODUCT
-        self._kernel_nbits = 0
+    dimension: int
+    nbytes: int
 
     def _unpack_pm1(self, packed: np.ndarray) -> np.ndarray:
         bits = np.unpackbits(packed, axis=1, bitorder="little")
@@ -303,7 +294,7 @@ class TpuBinaryFlat(_SlotStoreIndex):
         return np.packbits(pm1 > 0, axis=1, bitorder="little")
 
     def _convert_distances(self, dists: np.ndarray) -> np.ndarray:
-        # kernel returned IP of +/-1 vectors (descending); hamming ascending
+        # kernel returned IP of ±1 vectors (descending); hamming ascending
         return (self.dimension - dists) * 0.5
 
     def _prep_vectors(self, vectors: np.ndarray) -> np.ndarray:
@@ -319,6 +310,21 @@ class TpuBinaryFlat(_SlotStoreIndex):
         if queries.shape[1] != self.nbytes:
             raise InvalidParameter(f"binary query shape {queries.shape}")
         return self._unpack_pm1(queries).astype(np.float32)
+
+
+class TpuBinaryFlat(BinaryPm1Mixin, _SlotStoreIndex):
+    """Binary (uint8 bit-packed) exact hamming search — the reference's
+    faiss::IndexBinaryFlat variant (vector_index_flat.h binary template
+    arm); codec shared via BinaryPm1Mixin."""
+
+    def __init__(self, index_id: int, parameter: IndexParameter):
+        super().__init__(index_id, parameter)
+        if parameter.dimension <= 0 or parameter.dimension % 8:
+            raise InvalidParameter("binary dimension must be multiple of 8")
+        self.nbytes = parameter.dimension // 8
+        self.store = SlotStore(parameter.dimension, jnp.int8)
+        self._kernel_metric = Metric.INNER_PRODUCT
+        self._kernel_nbits = 0
 
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)

@@ -52,7 +52,7 @@ from dingo_tpu.index.base import (
     VectorIndex,
     strip_invalid,
 )
-from dingo_tpu.index.flat import _SlotStoreIndex, _pad_batch
+from dingo_tpu.index.flat import BinaryPm1Mixin, _SlotStoreIndex, _pad_batch
 from dingo_tpu.index.ivf_layout import BucketLayout, build_layout, expand_probes
 from dingo_tpu.index.slot_store import SlotStore, _next_pow2
 from dingo_tpu.ops.distance import (
@@ -112,7 +112,8 @@ def _ivf_scan_kernel(
         lists_r = jnp.take(probes, r, axis=1)        # [b] (-1 = padded rank)
         rank_ok = lists_r >= 0
         lists_c = jnp.where(rank_ok, lists_r, 0)
-        data = jnp.take(buckets, lists_c, axis=0)    # [b, cap_list, d]
+        # int8 stores (binary ivf): promote after the gather, not before
+        data = jnp.take(buckets, lists_c, axis=0).astype(jnp.float32)
         sq = jnp.take(bucket_sqnorm, lists_c, axis=0)
         val = jnp.take(bucket_valid, lists_c, axis=0) & rank_ok[:, None]
         slot = jnp.take(bucket_slot, lists_c, axis=0)
@@ -148,14 +149,19 @@ def _ivf_scan_kernel(
 
 
 class TpuIvfFlat(_SlotStoreIndex):
+    #: metric the bucketed scan kernel runs with (the binary subclass scans
+    #: with INNER_PRODUCT over ±1 vectors and converts to hamming after)
+    _scan_metric: Metric
+
     def __init__(self, index_id: int, parameter: IndexParameter):
         VectorIndex.__init__(self, index_id, parameter)
         if parameter.dimension <= 0:
             raise InvalidParameter(f"dimension {parameter.dimension}")
         if parameter.ncentroids <= 0:
             raise InvalidParameter(f"ncentroids {parameter.ncentroids}")
-        if parameter.metric is Metric.HAMMING:
+        if parameter.metric is Metric.HAMMING and type(self) is TpuIvfFlat:
             raise InvalidParameter("use BINARY_IVF_FLAT for hamming")
+        self._scan_metric = parameter.metric
         self.store = SlotStore(parameter.dimension, jnp.dtype(parameter.dtype))
         self.nlist = parameter.ncentroids
         self.centroids: Optional[jax.Array] = None       # [nlist, d]
@@ -313,9 +319,9 @@ class TpuIvfFlat(_SlotStoreIndex):
             vals, slots = ivf_list_search(
                 vprobes, qpad, self._buckets, self._bucket_sqnorm,
                 valid, lay.bucket_slot, k=int(topk),
-                ascending=metric_ascending(self.metric),
+                ascending=metric_ascending(self._scan_metric),
             )
-            dists = scores_to_distances(vals, self.metric)
+            dists = scores_to_distances(vals, self._scan_metric)
         else:
             dists, slots = _ivf_scan_kernel(
                 self._buckets,
@@ -325,7 +331,7 @@ class TpuIvfFlat(_SlotStoreIndex):
                 vprobes,
                 qpad,
                 k=int(topk),
-                metric=self.metric,
+                metric=self._scan_metric,
             )
         store = self.store
         lease = store.begin_search()
@@ -335,6 +341,7 @@ class TpuIvfFlat(_SlotStoreIndex):
             try:
                 dists_h, slots_h = jax.device_get((dists, slots))
                 ids = store.ids_of_slots(slots_h[:b])
+                dists_h = self._convert_distances(dists_h)
                 return [strip_invalid(i, d) for i, d in zip(ids, dists_h[:b])]
             finally:
                 lease.release()
@@ -377,6 +384,96 @@ class TpuIvfFlat(_SlotStoreIndex):
             if self.metric is Metric.COSINE:
                 vecs = np.asarray(normalize(jnp.asarray(vecs)))
             slots = self.store.put(np.asarray(data["ids"], np.int64), vecs)
+        else:
+            slots = np.empty(0, np.int64)
+        if self._assign_h.shape[0] < self.store.capacity:
+            grown = np.full((self.store.capacity,), -1, np.int32)
+            grown[: self._assign_h.shape[0]] = self._assign_h
+            self._assign_h = grown
+        if meta.get("trained"):
+            self.centroids = jnp.asarray(data["centroids"])
+            self._c_sqnorm = squared_norms(self.centroids)
+            self._assign_h[slots] = data["assign"]
+        self.apply_log_id = meta["apply_log_id"]
+        self._view_dirty = True
+        self.write_count_since_save = 0
+
+
+class TpuBinaryIvfFlat(BinaryPm1Mixin, TpuIvfFlat):
+    """Binary (bit-packed) IVF with hamming list scan.
+
+    Reference: faiss::IndexBinaryIVF behind the NewBinaryIVFFlat factory arm
+    (vector_index_factory.h:37-68; vector_index_ivf_flat.cc:60-62).
+    dimension is in BITS; the wire format is [n, dimension//8] uint8 rows.
+
+    TPU-first: vectors unpack once at write time into a ±1 int8 store (same
+    trick as TpuBinaryFlat), so the coarse quantizer is plain float k-means
+    over ±1 space and the list scan is an int8 MXU matmul —
+    hamming(a, b) = (nbits - <pm(a), pm(b)>) / 2. Centroids stay float
+    (fractional centroids order candidate lists strictly better than
+    re-binarized ones; faiss quantizes them because CPU hamming is its only
+    fast kernel, a constraint the MXU does not have).
+    """
+
+    def __init__(self, index_id: int, parameter: IndexParameter):
+        if parameter.dimension <= 0 or parameter.dimension % 8:
+            raise InvalidParameter("binary dimension must be multiple of 8")
+        super().__init__(index_id, parameter)
+        self.nbytes = parameter.dimension // 8
+        self.store = SlotStore(parameter.dimension, jnp.int8)
+        self._scan_metric = Metric.INNER_PRODUCT
+        self._assign_h = np.full((self.store.capacity,), -1, np.int32)
+
+    # packed <-> ±1 codec + distance conversion come from BinaryPm1Mixin
+
+    def train(self, vectors: Optional[np.ndarray] = None) -> None:
+        """Float k-means over ±1 space. An explicit train set arrives
+        bit-packed (the wire format); the implicit path samples the already-
+        unpacked store."""
+        if vectors is not None:
+            vectors = self._prep_vectors(vectors)
+        super().train(vectors)
+
+    # -- lifecycle (packed on disk) -----------------------------------------
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        snap = self.store.to_host()
+        extras = {}
+        if self.is_trained():
+            extras["centroids"] = np.asarray(self.centroids)
+            live = self.store.ids_by_slot >= 0
+            extras["assign"] = self._assign_h[np.flatnonzero(live)]
+        np.savez(
+            os.path.join(path, "binary_ivf_flat.npz"),
+            ids=snap["ids"],
+            vectors=self._repack(snap["vectors"]),
+            **extras,
+        )
+        meta = self._save_meta()
+        meta["nlist"] = self.nlist
+        meta["trained"] = self.is_trained()
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f)
+
+    def load(self, path: str) -> None:
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        self._check_meta(meta)
+        if meta["nlist"] != self.nlist:
+            raise InvalidParameter(
+                f"snapshot nlist {meta['nlist']} != {self.nlist}"
+            )
+        data = np.load(os.path.join(path, "binary_ivf_flat.npz"))
+        self.store = SlotStore(self.dimension, jnp.int8,
+                               max(len(data["ids"]), 1))
+        self._assign_h = np.full((self.store.capacity,), -1, np.int32)
+        self.centroids = None
+        self._c_sqnorm = None
+        if len(data["ids"]):
+            slots = self.store.put(
+                np.asarray(data["ids"], np.int64),
+                self._unpack_pm1(np.asarray(data["vectors"], np.uint8)),
+            )
         else:
             slots = np.empty(0, np.int64)
         if self._assign_h.shape[0] < self.store.capacity:

@@ -91,11 +91,28 @@ class ReaderContext:
         return vcodec.range_to_vector_ids(self.start_key, self.end_key)
 
 
+def is_binary_dim_param(param) -> bool:
+    """True when param describes a binary (bit-packed) index: dimension is
+    in bits, rows on the wire/data-CF are dimension//8 uint8 bytes."""
+    from dingo_tpu.index.base import IndexType as _IT
+
+    return param is not None and param.index_type in (
+        _IT.BINARY_FLAT, _IT.BINARY_IVF_FLAT
+    )
+
+
 def serialize_vector(v: np.ndarray) -> bytes:
+    """Data-CF row bytes: uint8 rows (binary indexes) stay raw bit-packed
+    bytes; everything else is little-endian f32."""
+    v = np.asarray(v)
+    if v.dtype == np.uint8:
+        return v.tobytes()
     return np.asarray(v, np.float32).tobytes()
 
 
-def deserialize_vector(b: bytes, dim: int) -> np.ndarray:
+def deserialize_vector(b: bytes, dim: int, binary: bool = False) -> np.ndarray:
+    if binary:
+        return np.frombuffer(b, np.uint8, count=dim // 8)
     return np.frombuffer(b, np.float32, count=dim)
 
 
@@ -112,6 +129,12 @@ class VectorReader:
         self.ctx = ctx
         self._data = MvccReader(ctx.engine, CF_DEFAULT)
         self._scalar = MvccReader(ctx.engine, CF_VECTOR_SCALAR)
+        self._binary = is_binary_dim_param(ctx.parameter)
+
+    def _deser(self, blob: bytes) -> np.ndarray:
+        return deserialize_vector(
+            blob, self.ctx.parameter.dimension, binary=self._binary
+        )
 
     # ---------------- public entry points (vector_reader.h:44-88) ----------
 
@@ -125,9 +148,17 @@ class VectorReader:
         vector_ids: Optional[Sequence[int]] = None,
         with_vector_data: bool = False,
         with_scalar_data: bool = False,
+        stage_us: Optional[dict] = None,
         **search_kw,
     ) -> List[List[VectorWithData]]:
-        queries = np.asarray(queries, np.float32)
+        """Batch search. When `stage_us` is a dict it receives per-stage
+        wall times in microseconds (prefilter/search/postfilter/backfill/
+        total) — the VectorSearchDebug contract (vector_reader.h:85-88)."""
+        import time as _time
+
+        t_start = _time.perf_counter_ns()
+        prefilter_ns = postfilter_ns = backfill_ns = 0
+        queries = np.asarray(queries, np.uint8 if self._binary else np.float32)
         if queries.ndim == 1:
             queries = queries[None, :]
         base = FilterSpec(ranges=[self.ctx.id_window()])
@@ -135,24 +166,30 @@ class VectorReader:
         radius = search_kw.pop("radius", 0.0)
         if filter_mode is VectorFilterMode.VECTOR_ID:
             # pre-filter on explicit ids (vector_reader.cc:216-222, :830)
+            t0 = _time.perf_counter_ns()
             ids = np.asarray(sorted(set(map(int, vector_ids or []))), np.int64)
             spec = FilterSpec(ranges=base.ranges, include_ids=ids)
+            prefilter_ns = _time.perf_counter_ns() - t0
             results = self._search_with_fallback(queries, topk, spec, **search_kw)
         elif filter_mode is VectorFilterMode.SCALAR and (
             filter_type is VectorFilterType.QUERY_PRE
         ):
             # scan scalar CF for candidates (vector_reader.cc:853)
+            t0 = _time.perf_counter_ns()
             cand = self._scan_scalar_candidates(scalar_filter)
             spec = FilterSpec(ranges=base.ranges, include_ids=cand)
+            prefilter_ns = _time.perf_counter_ns() - t0
             results = self._search_with_fallback(queries, topk, spec, **search_kw)
         elif filter_mode is VectorFilterMode.SCALAR:
             # post-filter with x10 over-fetch (vector_reader.cc:120-215)
             over = self._search_with_fallback(
                 queries, topk * POST_FILTER_OVERFETCH, base, **search_kw
             )
+            t0 = _time.perf_counter_ns()
             results = [
                 self._post_filter_scalar(r, scalar_filter, topk) for r in over
             ]
+            postfilter_ns = _time.perf_counter_ns() - t0
         else:
             results = self._search_with_fallback(queries, topk, base, **search_kw)
 
@@ -168,8 +205,19 @@ class VectorReader:
             ]
             out.append(row)
         if with_vector_data or with_scalar_data:
+            t0 = _time.perf_counter_ns()
             for row in out:
                 self._backfill(row, with_vector_data, with_scalar_data)
+            backfill_ns = _time.perf_counter_ns() - t0
+        if stage_us is not None:
+            total_ns = _time.perf_counter_ns() - t_start
+            stage_us["prefilter_us"] = prefilter_ns // 1000
+            stage_us["postfilter_us"] = postfilter_ns // 1000
+            stage_us["backfill_us"] = backfill_ns // 1000
+            stage_us["total_us"] = total_ns // 1000
+            stage_us["search_us"] = (
+                total_ns - prefilter_ns - postfilter_ns - backfill_ns
+            ) // 1000
         return out
 
     def _radius_cut(self, r: SearchResult, radius: float) -> SearchResult:
@@ -196,7 +244,7 @@ class VectorReader:
                 continue
             v = VectorWithData(int(vid))
             if with_vector_data and self.ctx.parameter:
-                v.vector = deserialize_vector(blob, self.ctx.parameter.dimension)
+                v.vector = self._deser(blob)
             if with_scalar_data:
                 sb = self._scalar.kv_get(key, self.ctx.read_ts)
                 v.scalar = deserialize_scalar(sb) if sb else {}
@@ -240,7 +288,7 @@ class VectorReader:
                 if with_scalar_data:
                     v.scalar = scalar
             if with_vector_data and self.ctx.parameter:
-                v.vector = deserialize_vector(blob, self.ctx.parameter.dimension)
+                v.vector = self._deser(blob)
             out.append(v)
             if len(out) >= limit:
                 break
@@ -274,18 +322,29 @@ class VectorReader:
         if self.ctx.parameter is None:
             raise VectorIndexError("brute force needs index parameter (dim)")
         dim = self.ctx.parameter.dimension
-        param = IndexParameter(
-            index_type=IndexType.FLAT,
-            dimension=dim,
-            metric=self.ctx.parameter.metric,
-        )
-        temp = TpuFlat(self.ctx.region_id, param)
+        if self._binary:
+            # binary regions brute-force over a temp binary flat index
+            from dingo_tpu.index.flat import TpuBinaryFlat
+
+            param = IndexParameter(
+                index_type=IndexType.BINARY_FLAT,
+                dimension=dim,
+                metric=self.ctx.parameter.metric,
+            )
+            temp = TpuBinaryFlat(self.ctx.region_id, param)
+        else:
+            param = IndexParameter(
+                index_type=IndexType.FLAT,
+                dimension=dim,
+                metric=self.ctx.parameter.metric,
+            )
+            temp = TpuFlat(self.ctx.region_id, param)
         lo, hi = self.ctx.id_window()
         batch_ids: List[int] = []
         batch_vecs: List[np.ndarray] = []
         for vid, blob in self._scan_data(lo, hi):
             batch_ids.append(vid)
-            batch_vecs.append(deserialize_vector(blob, dim))
+            batch_vecs.append(self._deser(blob))
             if len(batch_ids) >= BRUTEFORCE_BATCH:
                 temp.upsert(np.asarray(batch_ids, np.int64), np.stack(batch_vecs))
                 batch_ids, batch_vecs = [], []
@@ -357,9 +416,7 @@ class VectorReader:
             if with_vector and self.ctx.parameter:
                 blob = self._data.kv_get(key, self.ctx.read_ts)
                 if blob is not None:
-                    v.vector = deserialize_vector(
-                        blob, self.ctx.parameter.dimension
-                    )
+                    v.vector = self._deser(blob)
             if with_scalar:
                 sb = self._scalar.kv_get(key, self.ctx.read_ts)
                 v.scalar = deserialize_scalar(sb) if sb else {}

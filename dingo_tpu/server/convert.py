@@ -30,6 +30,7 @@ _ITYPE_TO_PB = {
     IndexType.DISKANN: pb.VECTOR_INDEX_TYPE_DISKANN,
     IndexType.BRUTEFORCE: pb.VECTOR_INDEX_TYPE_BRUTEFORCE,
     IndexType.BINARY_FLAT: pb.VECTOR_INDEX_TYPE_BINARY_FLAT,
+    IndexType.BINARY_IVF_FLAT: pb.VECTOR_INDEX_TYPE_BINARY_IVF_FLAT,
 }
 _PB_TO_ITYPE = {v: k for k, v in _ITYPE_TO_PB.items()}
 
@@ -157,5 +158,15 @@ def region_cmd_from_pb(c):
     )
 
 
-def queries_from_pb(vectors) -> np.ndarray:
+def queries_from_pb(vectors, binary: bool = False) -> np.ndarray:
+    if binary:
+        return np.stack([
+            np.frombuffer(v.binary_values, np.uint8) for v in vectors
+        ])
     return np.asarray([list(v.values) for v in vectors], np.float32)
+
+
+def is_binary_parameter(param) -> bool:
+    from dingo_tpu.index.vector_reader import is_binary_dim_param
+
+    return is_binary_dim_param(param)

@@ -29,6 +29,9 @@ from dingo_tpu.server.services import (
 SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
     "IndexService": {
         "VectorSearch": (pb.VectorSearchRequest, pb.VectorSearchResponse),
+        "VectorSearchDebug": (
+            pb.VectorSearchDebugRequest, pb.VectorSearchDebugResponse,
+        ),
         "VectorAdd": (pb.VectorAddRequest, pb.VectorAddResponse),
         "VectorDelete": (pb.VectorDeleteRequest, pb.VectorDeleteResponse),
         "VectorBatchQuery": (pb.VectorBatchQueryRequest, pb.VectorBatchQueryResponse),
@@ -39,6 +42,10 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
     "StoreService": {
         "KvGet": (pb.KvGetRequest, pb.KvGetResponse),
         "KvBatchPut": (pb.KvBatchPutRequest, pb.KvBatchPutResponse),
+        "KvPutIfAbsent": (pb.KvPutIfAbsentRequest, pb.KvPutIfAbsentResponse),
+        "KvCompareAndSet": (
+            pb.KvCompareAndSetRequest, pb.KvCompareAndSetResponse,
+        ),
         "KvBatchDelete": (pb.KvBatchDeleteRequest, pb.KvBatchDeleteResponse),
         "KvScan": (pb.KvScanRequest, pb.KvScanResponse),
         "TxnPrewrite": (pb.TxnPrewriteRequest, pb.TxnPrewriteResponse),
@@ -50,6 +57,15 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "KvScanBegin": (pb.KvScanBeginRequest, pb.KvScanBeginResponse),
         "KvScanContinue": (pb.KvScanContinueRequest, pb.KvScanContinueResponse),
         "KvScanRelease": (pb.KvScanReleaseRequest, pb.KvScanReleaseResponse),
+    },
+    "MetaService": {
+        "CreateSchema": (pb.CreateSchemaRequest, pb.CreateSchemaResponse),
+        "DropSchema": (pb.DropSchemaRequest, pb.DropSchemaResponse),
+        "GetSchemas": (pb.GetSchemasRequest, pb.GetSchemasResponse),
+        "CreateTable": (pb.CreateTableRequest, pb.CreateTableResponse),
+        "DropTable": (pb.DropTableRequest, pb.DropTableResponse),
+        "GetTable": (pb.GetTableRequest, pb.GetTableResponse),
+        "GetTables": (pb.GetTablesRequest, pb.GetTablesResponse),
     },
     "UtilService": {
         "VectorCalcDistance": (pb.VectorCalcDistanceRequest, pb.VectorCalcDistanceResponse),
@@ -157,12 +173,20 @@ class DingoServer:
         _register(self._server, "DebugService", DebugService())
         _register(self._server, "UtilService", UtilService())
 
-    def host_coordinator_role(self, control, tso, kv_control) -> None:
+    def host_coordinator_role(self, control, tso, kv_control,
+                              meta=None) -> None:
         """--role=coordinator service set."""
+        from dingo_tpu.server.services import MetaService
+
         _register(self._server, "CoordinatorService",
                   CoordinatorService(control, tso))
         _register(self._server, "VersionService", VersionService(kv_control))
         _register(self._server, "DebugService", DebugService())
+        if meta is None:
+            from dingo_tpu.coordinator.meta import MetaControl
+
+            meta = MetaControl(control.engine, control)
+        _register(self._server, "MetaService", MetaService(meta))
 
     def start(self) -> int:
         self._server.start()

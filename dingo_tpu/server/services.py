@@ -69,7 +69,10 @@ class IndexService:
         lat = METRICS.latency("vector_search", region.id)
         t0 = time.perf_counter_ns()
         try:
-            queries = convert.queries_from_pb(req.vectors)
+            binary = convert.is_binary_parameter(
+                region.definition.index_parameter
+            )
+            queries = convert.queries_from_pb(req.vectors, binary=binary)
             kw = convert.search_kwargs_from_pb(req.parameter)
             if req.parameter.nprobe:
                 kw["nprobe"] = req.parameter.nprobe
@@ -100,6 +103,41 @@ class IndexService:
         lat.observe_us((time.perf_counter_ns() - t0) / 1000.0)
         return resp
 
+    def VectorSearchDebug(self, req: pb.VectorSearchDebugRequest):
+        """VectorSearch + per-stage timings (the reference's SearchDebug
+        RPC, vector_reader.h:85-88 / index_service.h SearchDebug)."""
+        resp = pb.VectorSearchDebugResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        try:
+            binary = convert.is_binary_parameter(
+                region.definition.index_parameter
+            )
+            queries = convert.queries_from_pb(req.vectors, binary=binary)
+            kw = convert.search_kwargs_from_pb(req.parameter)
+            if req.parameter.nprobe:
+                kw["nprobe"] = req.parameter.nprobe
+            if req.parameter.ef_search:
+                kw["ef"] = req.parameter.ef_search
+            stage_us: Dict[str, int] = {}
+            results = self.node.storage.vector_batch_search(
+                region, queries, req.parameter.top_n or 10,
+                stage_us=stage_us, **kw,
+            )
+        except (VectorIndexError, ValueError) as e:
+            return _err(resp, 30001, str(e))
+        for row in results:
+            r = resp.batch_results.add()
+            for v in row:
+                item = r.results.add()
+                item.vector.id = v.id
+                item.distance = v.distance
+        for field in ("prefilter_us", "search_us", "postfilter_us",
+                      "backfill_us", "total_us"):
+            setattr(resp, field, stage_us.get(field, 0))
+        return resp
+
     def VectorAdd(self, req: pb.VectorAddRequest) -> pb.VectorAddResponse:
         resp = pb.VectorAddResponse()
         region = _region_or_err(self.node, req.context, resp)
@@ -107,9 +145,15 @@ class IndexService:
             return resp
         try:
             ids = np.asarray([v.vector.id for v in req.vectors], np.int64)
-            vectors = np.asarray(
-                [list(v.vector.values) for v in req.vectors], np.float32
-            )
+            if convert.is_binary_parameter(region.definition.index_parameter):
+                vectors = np.stack([
+                    np.frombuffer(v.vector.binary_values, np.uint8)
+                    for v in req.vectors
+                ])
+            else:
+                vectors = np.asarray(
+                    [list(v.vector.values) for v in req.vectors], np.float32
+                )
             scalars = [convert.scalar_from_pb(v.scalar_data) for v in req.vectors]
             ts = self.node.storage.vector_add(
                 region, ids, vectors, scalars,
@@ -255,6 +299,38 @@ class StoreService:
             resp.ts = self.node.storage.kv_put(
                 region, [(kv.key, kv.value) for kv in req.kvs],
                 ttl_ms=req.ttl_ms,
+            )
+        except NotLeader as e:
+            return _err(resp, 20001, f"not leader: {e.leader_hint}")
+        return resp
+
+    def KvPutIfAbsent(self, req: pb.KvPutIfAbsentRequest):
+        """KvPutIfAbsent / KvBatchPutIfAbsent (store_service.cc KV set)."""
+        resp = pb.KvPutIfAbsentResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        try:
+            states = self.node.storage.kv_put_if_absent(
+                region, [(kv.key, kv.value) for kv in req.kvs],
+                is_atomic=req.is_atomic,
+            )
+        except NotLeader as e:
+            return _err(resp, 20001, f"not leader: {e.leader_hint}")
+        resp.key_states.extend(states)
+        return resp
+
+    def KvCompareAndSet(self, req: pb.KvCompareAndSetRequest):
+        """KvCompareAndSet (store_service.cc): expect_value b'' means
+        'expect absent' (the reference's empty-value convention)."""
+        resp = pb.KvCompareAndSetResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        expect = req.expect_value if req.expect_value else None
+        try:
+            resp.key_state = self.node.storage.kv_compare_and_set(
+                region, req.kv.key, expect, req.kv.value
             )
         except NotLeader as e:
             return _err(resp, 20001, f"not leader: {e.leader_hint}")
@@ -754,4 +830,126 @@ class VersionService:
     def LeaseGrant(self, req: pb.LeaseGrantRequest) -> pb.LeaseGrantResponse:
         resp = pb.LeaseGrantResponse()
         resp.lease_id = self.kv.lease_grant(req.ttl_s).lease_id
+        return resp
+
+
+class MetaService:
+    """Schema/table meta RPCs (reference src/server/meta_service.cc)."""
+
+    def __init__(self, meta):
+        from dingo_tpu.coordinator.meta import MetaControl
+
+        self.meta: MetaControl = meta
+
+    @staticmethod
+    def _table_to_pb(t, out) -> None:
+        from dingo_tpu.store.region import RegionType
+
+        out.table_id = t.table_id
+        out.schema_name = t.schema_name
+        out.name = t.name
+        out.table_type = [RegionType.STORE, RegionType.INDEX,
+                          RegionType.DOCUMENT].index(t.table_type)
+        out.replication = t.replication
+        for c in t.columns:
+            col = out.columns.add()
+            col.name, col.sql_type = c.name, c.sql_type
+            col.nullable, col.primary = c.nullable, c.primary
+        for p in t.partitions:
+            pp = out.partitions.add()
+            pp.partition_id = p.partition_id
+            pp.id_lo, pp.id_hi = p.id_lo, p.id_hi
+            pp.start_key, pp.end_key = p.start_key, p.end_key
+            pp.region_id = p.region_id
+        if t.index_parameter is not None:
+            out.index_parameter.CopyFrom(
+                convert.index_parameter_to_pb(t.index_parameter)
+            )
+
+    def CreateSchema(self, req: pb.CreateSchemaRequest):
+        from dingo_tpu.coordinator.meta import MetaError
+
+        resp = pb.CreateSchemaResponse()
+        try:
+            self.meta.create_schema(req.schema_name)
+        except MetaError as e:
+            return _err(resp, 40001, str(e))
+        return resp
+
+    def DropSchema(self, req: pb.DropSchemaRequest):
+        from dingo_tpu.coordinator.meta import MetaError
+
+        resp = pb.DropSchemaResponse()
+        try:
+            self.meta.drop_schema(req.schema_name)
+        except MetaError as e:
+            return _err(resp, 40001, str(e))
+        return resp
+
+    def GetSchemas(self, req: pb.GetSchemasRequest):
+        resp = pb.GetSchemasResponse()
+        resp.schema_names.extend(self.meta.get_schemas())
+        return resp
+
+    def CreateTable(self, req: pb.CreateTableRequest):
+        from dingo_tpu.coordinator.meta import (
+            ColumnDefinition,
+            MetaError,
+            PartitionDefinition,
+        )
+        from dingo_tpu.store.region import RegionType
+
+        resp = pb.CreateTableResponse()
+        d = req.definition
+        columns = [
+            ColumnDefinition(c.name, c.sql_type or "VARCHAR",
+                             c.nullable, c.primary)
+            for c in d.columns
+        ]
+        partitions = [
+            PartitionDefinition(
+                partition_id=p.partition_id, id_lo=p.id_lo, id_hi=p.id_hi,
+                start_key=p.start_key, end_key=p.end_key,
+            )
+            for p in d.partitions
+        ]
+        param = (
+            convert.index_parameter_from_pb(d.index_parameter)
+            if d.HasField("index_parameter") else None
+        )
+        table_type = [RegionType.STORE, RegionType.INDEX,
+                      RegionType.DOCUMENT][d.table_type]
+        try:
+            t = self.meta.create_table(
+                d.schema_name, d.name, partitions,
+                columns=columns, index_parameter=param,
+                table_type=table_type, replication=d.replication,
+            )
+        except (MetaError, RuntimeError) as e:
+            return _err(resp, 40001, str(e))
+        self._table_to_pb(t, resp.definition)
+        return resp
+
+    def DropTable(self, req: pb.DropTableRequest):
+        from dingo_tpu.coordinator.meta import MetaError
+
+        resp = pb.DropTableResponse()
+        try:
+            self.meta.drop_table(req.schema_name, req.table_name)
+        except MetaError as e:
+            return _err(resp, 40001, str(e))
+        return resp
+
+    def GetTable(self, req: pb.GetTableRequest):
+        resp = pb.GetTableResponse()
+        t = self.meta.get_table(req.schema_name, req.table_name)
+        resp.found = t is not None
+        if t is not None:
+            self._table_to_pb(t, resp.definition)
+        return resp
+
+    def GetTables(self, req: pb.GetTablesRequest):
+        resp = pb.GetTablesResponse()
+        for t in self.meta.get_tables(req.schema_name):
+            self._table_to_pb(t, resp.definitions.add())
         return resp

@@ -226,3 +226,74 @@ def test_failpoint_injects_into_write_path(cluster):
     resp2 = client._stub(leader_sid, "IndexService").VectorAdd(req)
     assert resp2.error.errcode == 0
     dbg.FailPoint(pb.FailPointRequest(name="before_vector_add", remove=True))
+
+
+def test_kv_put_if_absent_and_compare_and_set(cluster):
+    """StoreService KV parity: KvPutIfAbsent / KvCompareAndSet
+    (store_service.cc KV RPC set)."""
+    client, control, nodes = cluster
+    client.kv_put(b"cas-key", b"v1")
+    d = client._region_for_key(b"cas-key")
+
+    req = pb.KvPutIfAbsentRequest()
+    req.context.region_id = d.region_id
+    for key, val in [(b"cas-key", b"loser"), (b"pia-new", b"winner")]:
+        kv = req.kvs.add()
+        kv.key = key
+        kv.value = val
+    resp = client._call_leader(d, "StoreService", "KvPutIfAbsent", req)
+    assert list(resp.key_states) == [False, True]
+    assert client.kv_get(b"cas-key") == b"v1"
+    assert client.kv_get(b"pia-new") == b"winner"
+
+    # atomic batch: one existing key poisons the whole batch
+    areq = pb.KvPutIfAbsentRequest(is_atomic=True)
+    areq.context.region_id = d.region_id
+    for key in (b"pia-new", b"pia-never"):
+        kv = areq.kvs.add()
+        kv.key = key
+        kv.value = b"x"
+    aresp = client._call_leader(d, "StoreService", "KvPutIfAbsent", areq)
+    assert list(aresp.key_states) == [False, False]
+    assert client.kv_get(b"pia-never") is None
+
+    creq = pb.KvCompareAndSetRequest(expect_value=b"v1")
+    creq.context.region_id = d.region_id
+    creq.kv.key = b"cas-key"
+    creq.kv.value = b"v2"
+    cresp = client._call_leader(d, "StoreService", "KvCompareAndSet", creq)
+    assert cresp.key_state is True
+    assert client.kv_get(b"cas-key") == b"v2"
+    # stale expect fails
+    cresp = client._call_leader(d, "StoreService", "KvCompareAndSet", creq)
+    assert cresp.key_state is False
+
+
+def test_vector_search_debug_stage_timings(cluster):
+    """VectorSearchDebug returns results + stage timings
+    (vector_reader.h:85-88)."""
+    client, control, nodes = cluster
+    param = pb.VectorIndexParameter(
+        index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=16,
+        metric_type=pb.METRIC_TYPE_L2,
+    )
+    d = client.create_index_region(9, 0, 1 << 30, param)
+    time.sleep(1.0)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((50, 16)).astype(np.float32)
+    client.vector_add(9, list(range(50)), x)
+    req = pb.VectorSearchDebugRequest()
+    req.context.region_id = d.region_id
+    v = req.vectors.add()
+    v.values.extend([0.1] * 16)
+    req.parameter.top_n = 3
+    resp = client._call_leader(d, "IndexService", "VectorSearchDebug", req)
+    assert resp.error.errcode == 0
+    assert len(resp.batch_results) == 1
+    assert len(resp.batch_results[0].results) == 3
+    assert resp.total_us > 0
+    assert resp.search_us > 0
+    assert resp.total_us >= (
+        resp.prefilter_us + resp.search_us + resp.postfilter_us
+        + resp.backfill_us
+    )
