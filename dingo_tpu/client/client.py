@@ -168,19 +168,27 @@ class DingoClient:
         self.refresh_region_map()
 
     def table_vector_add(self, table, ids, vectors, scalars=None) -> None:
-        """Route rows to the owning partition by id window."""
+        """Route rows to the owning partition by id window; ids outside
+        every partition's window are an error, not a silent drop."""
         import numpy as _np
 
         ids = _np.asarray(ids, _np.int64)
+        routed = _np.zeros(len(ids), bool)
         for p in table.partitions:
             sel = [i for i, vid in enumerate(ids)
                    if p.id_lo <= vid < p.id_hi]
             if not sel:
                 continue
+            routed[sel] = True
             self.vector_add(
                 p.partition_id, ids[sel].tolist(),
                 _np.asarray(vectors)[sel],
                 [scalars[i] for i in sel] if scalars is not None else None,
+            )
+        if not routed.all():
+            orphans = ids[~routed][:5].tolist()
+            raise ClientError(
+                f"ids outside every partition window: {orphans}"
             )
 
     def table_vector_search(self, table, queries, topk: int = 10, **params):

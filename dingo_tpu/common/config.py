@@ -75,6 +75,14 @@ FLAGS.define("gc_retention_ms", 3_600_000, mutable=True)
 FLAGS.define("use_pallas_fused_search", False, mutable=True,
              help_="route flat L2/IP searches through the fused Pallas "
                    "streaming kernel (no [b,n] HBM materialization)")
+FLAGS.define("use_mesh_sharded_flat", False, mutable=True,
+             help_="serve FLAT regions from a mesh-sharded index "
+                   "(TpuShardedFlat): rows over the 'data' axis, feature "
+                   "dim over 'dim', search fan-out/merge via XLA "
+                   "collectives over ICI")
+FLAGS.define("mesh_dim_axis", 1, mutable=True,
+             help_="size of the mesh 'dim' (tensor-parallel) axis used by "
+                   "mesh-sharded indexes; 'data' axis = n_devices // dim")
 FLAGS.define("use_pallas_ivf_search", False, mutable=True,
              help_="route trained IVF_FLAT searches through the Pallas "
                    "list-DMA kernel (streams only probed buckets to VMEM; "

@@ -161,7 +161,8 @@ class MetaControl:
         with self._lock:
             if name not in self.schemas:
                 raise MetaError(f"schema {name!r} not found")
-            if self.schemas[name]:
+            in_flight = any(k.startswith(name + ".") for k in self._creating)
+            if self.schemas[name] or in_flight:
                 raise MetaError(f"schema {name!r} not empty")
             if name in DEFAULT_SCHEMAS:
                 raise MetaError(f"schema {name!r} is built-in")

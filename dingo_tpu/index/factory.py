@@ -10,6 +10,15 @@ from dingo_tpu.index.base import IndexParameter, IndexType, InvalidParameter, Ve
 def new_index(index_id: int, parameter: IndexParameter) -> VectorIndex:
     t = parameter.index_type
     if t is IndexType.FLAT:
+        from dingo_tpu.common.config import FLAGS
+
+        if FLAGS.get("use_mesh_sharded_flat"):
+            import jax
+
+            if len(jax.devices()) > 1:
+                from dingo_tpu.parallel.sharded_flat import TpuShardedFlat
+
+                return TpuShardedFlat(index_id, parameter)
         from dingo_tpu.index.flat import TpuFlat
 
         return TpuFlat(index_id, parameter)

@@ -112,8 +112,12 @@ def _ivf_scan_kernel(
         lists_r = jnp.take(probes, r, axis=1)        # [b] (-1 = padded rank)
         rank_ok = lists_r >= 0
         lists_c = jnp.where(rank_ok, lists_r, 0)
-        # int8 stores (binary ivf): promote after the gather, not before
-        data = jnp.take(buckets, lists_c, axis=0).astype(jnp.float32)
+        data = jnp.take(buckets, lists_c, axis=0)
+        if not jnp.issubdtype(data.dtype, jnp.floating):
+            # int8 stores (binary ivf): promote after the gather; float
+            # stores (incl. bf16) keep their dtype — the einsum accumulates
+            # in f32 via preferred_element_type either way
+            data = data.astype(jnp.float32)
         sq = jnp.take(bucket_sqnorm, lists_c, axis=0)
         val = jnp.take(bucket_valid, lists_c, axis=0) & rank_ok[:, None]
         slot = jnp.take(bucket_slot, lists_c, axis=0)

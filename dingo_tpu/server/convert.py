@@ -158,6 +158,15 @@ def region_cmd_from_pb(c):
     )
 
 
+def fill_vector_pb(vector_pb, row: np.ndarray) -> None:
+    """Emit a stored row into a Vector message: packed uint8 rows go to
+    binary_values, float rows to values."""
+    if row.dtype == np.uint8:
+        vector_pb.binary_values = row.tobytes()
+    else:
+        vector_pb.values.extend(row.tolist())
+
+
 def queries_from_pb(vectors, binary: bool = False) -> np.ndarray:
     if binary:
         return np.stack([

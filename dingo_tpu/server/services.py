@@ -61,11 +61,13 @@ class IndexService:
     def __init__(self, node: StoreNode):
         self.node = node
 
-    def VectorSearch(self, req: pb.VectorSearchRequest) -> pb.VectorSearchResponse:
-        resp = pb.VectorSearchResponse()
+    def _do_search(self, req, resp, stage_us=None):
+        """Shared VectorSearch/VectorSearchDebug body: build kwargs (incl.
+        the radius range-search arm), run the reader, fill batch_results
+        (binary-aware vector payloads + scalar backfill)."""
         region = _region_or_err(self.node, req.context, resp)
         if region is None:
-            return resp
+            return resp, None
         lat = METRICS.latency("vector_search", region.id)
         t0 = time.perf_counter_ns()
         try:
@@ -86,10 +88,10 @@ class IndexService:
 
                 topn = min(max(topn, 128), RANGE_SEARCH_CAP)
             results = self.node.storage.vector_batch_search(
-                region, queries, topn, **kw
+                region, queries, topn, stage_us=stage_us, **kw
             )
         except (VectorIndexError, ValueError) as e:
-            return _err(resp, 30001, str(e))
+            return _err(resp, 30001, str(e)), None
         for row in results:
             r = resp.batch_results.add()
             for v in row:
@@ -97,42 +99,23 @@ class IndexService:
                 item.vector.id = v.id
                 item.distance = v.distance
                 if v.vector is not None:
-                    item.vector.values.extend(v.vector.tolist())
+                    convert.fill_vector_pb(item.vector, v.vector)
                 if v.scalar:
                     convert.scalar_to_pb(item.scalar_data, v.scalar)
         lat.observe_us((time.perf_counter_ns() - t0) / 1000.0)
+        return resp, region
+
+    def VectorSearch(self, req: pb.VectorSearchRequest) -> pb.VectorSearchResponse:
+        resp, _ = self._do_search(req, pb.VectorSearchResponse())
         return resp
 
     def VectorSearchDebug(self, req: pb.VectorSearchDebugRequest):
         """VectorSearch + per-stage timings (the reference's SearchDebug
         RPC, vector_reader.h:85-88 / index_service.h SearchDebug)."""
-        resp = pb.VectorSearchDebugResponse()
-        region = _region_or_err(self.node, req.context, resp)
-        if region is None:
-            return resp
-        try:
-            binary = convert.is_binary_parameter(
-                region.definition.index_parameter
-            )
-            queries = convert.queries_from_pb(req.vectors, binary=binary)
-            kw = convert.search_kwargs_from_pb(req.parameter)
-            if req.parameter.nprobe:
-                kw["nprobe"] = req.parameter.nprobe
-            if req.parameter.ef_search:
-                kw["ef"] = req.parameter.ef_search
-            stage_us: Dict[str, int] = {}
-            results = self.node.storage.vector_batch_search(
-                region, queries, req.parameter.top_n or 10,
-                stage_us=stage_us, **kw,
-            )
-        except (VectorIndexError, ValueError) as e:
-            return _err(resp, 30001, str(e))
-        for row in results:
-            r = resp.batch_results.add()
-            for v in row:
-                item = r.results.add()
-                item.vector.id = v.id
-                item.distance = v.distance
+        stage_us: Dict[str, int] = {}
+        resp, _ = self._do_search(
+            req, pb.VectorSearchDebugResponse(), stage_us=stage_us
+        )
         for field in ("prefilter_us", "search_us", "postfilter_us",
                       "backfill_us", "total_us"):
             setattr(resp, field, stage_us.get(field, 0))
@@ -197,7 +180,7 @@ class IndexService:
                 continue
             out.vector.id = row.id
             if row.vector is not None:
-                out.vector.values.extend(row.vector.tolist())
+                convert.fill_vector_pb(out.vector, row.vector)
             if row.scalar:
                 convert.scalar_to_pb(out.scalar_data, row.scalar)
         return resp
@@ -229,7 +212,7 @@ class IndexService:
             out = resp.vectors.add()
             out.vector.id = row.id
             if row.vector is not None:
-                out.vector.values.extend(row.vector.tolist())
+                convert.fill_vector_pb(out.vector, row.vector)
             if row.scalar:
                 convert.scalar_to_pb(out.scalar_data, row.scalar)
         return resp
