@@ -173,22 +173,26 @@ class DingoClient:
         import numpy as _np
 
         ids = _np.asarray(ids, _np.int64)
+        routing = []
         routed = _np.zeros(len(ids), bool)
         for p in table.partitions:
             sel = [i for i, vid in enumerate(ids)
                    if p.id_lo <= vid < p.id_hi]
-            if not sel:
-                continue
-            routed[sel] = True
-            self.vector_add(
-                p.partition_id, ids[sel].tolist(),
-                _np.asarray(vectors)[sel],
-                [scalars[i] for i in sel] if scalars is not None else None,
-            )
+            if sel:
+                routed[sel] = True
+                routing.append((p, sel))
+        # validate the whole batch BEFORE the first write so a routing
+        # error cannot leave a partial batch behind
         if not routed.all():
             orphans = ids[~routed][:5].tolist()
             raise ClientError(
                 f"ids outside every partition window: {orphans}"
+            )
+        for p, sel in routing:
+            self.vector_add(
+                p.partition_id, ids[sel].tolist(),
+                _np.asarray(vectors)[sel],
+                [scalars[i] for i in sel] if scalars is not None else None,
             )
 
     def table_vector_search(self, table, queries, topk: int = 10, **params):

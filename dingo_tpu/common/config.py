@@ -75,6 +75,9 @@ FLAGS.define("gc_retention_ms", 3_600_000, mutable=True)
 FLAGS.define("use_pallas_fused_search", False, mutable=True,
              help_="route flat L2/IP searches through the fused Pallas "
                    "streaming kernel (no [b,n] HBM materialization)")
+FLAGS.define("diskann_server_addr", "", mutable=True,
+             help_="endpoint of the --role=diskann server; required to "
+                   "create VECTOR_INDEX_TYPE_DISKANN indexes")
 FLAGS.define("use_mesh_sharded_flat", False, mutable=True,
              help_="serve FLAT regions from a mesh-sharded index "
                    "(TpuShardedFlat): rows over the 'data' axis, feature "

@@ -1,0 +1,4 @@
+from dingo_tpu.diskann.core import CoreState, DiskAnnCore
+from dingo_tpu.diskann.item import DiskAnnItemManager
+
+__all__ = ["CoreState", "DiskAnnCore", "DiskAnnItemManager"]

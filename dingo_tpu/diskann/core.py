@@ -1,0 +1,427 @@
+"""DiskAnnCore: disk-resident vector index with device-side PQ pruning.
+
+Reference role: the separate `--role=diskann` server (src/diskann/
+diskann_core.h:35) wraps vendored Microsoft DiskANN — a Vamana graph on
+SSD walked with beam search, PQ codes in RAM for pruning. That design is
+built around CPU pointer-chasing; a graph walk is the worst possible TPU
+program (data-dependent control flow, tiny reads).
+
+TPU-era redesign with the same storage economics (full vectors NEVER
+resident in fast memory):
+  disk   — raw vectors in an append-only memmap file (float32 [n, d]),
+           written during the IMPORT phase.
+  memory — coarse centroids [nlist, d] + residual PQ codes [n, m] uint8
+           (the same ~1 byte/dim/8 footprint DiskANN keeps in RAM).
+  search — device ADC over probed lists (ivf_layout spill buckets +
+           the shared _ivfpq_scan_kernel) produces topk*RERANK_FACTOR
+           candidates, then ONE strided disk gather reranks them with an
+           exact f32 einsum on device. Beam-search hops become a single
+           MXU pass + one batched IO.
+
+State machine mirrors DiskANNCoreState (diskann_item.h): UNINIT ->
+IMPORTING -> IMPORTED -> BUILDING -> BUILT -> LOADING -> LOADED (+FAILED);
+Reset/Close return to earlier states, Destroy removes files.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import shutil
+import threading
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dingo_tpu.index.base import IndexParameter, InvalidParameter
+from dingo_tpu.index.ivf_layout import build_layout, expand_probes_ranked
+from dingo_tpu.ops.distance import Metric, squared_norms
+from dingo_tpu.ops.kmeans import MAX_POINTS_PER_CENTROID, kmeans_assign, train_kmeans
+from dingo_tpu.ops.pq import pq_train, split_subvectors
+
+#: default ADC candidates fetched from disk per requested result; the
+#: prune is intentionally over-broad because disk reads scale with k (not
+#: n) and one strided gather amortizes: measured 50K x 128 clustered,
+#: nprobe=24: factor 8 -> recall@10 0.838, 16 -> 0.947, 32 -> 0.994
+RERANK_FACTOR = 32
+
+
+class CoreState(enum.Enum):
+    UNINIT = "uninit"
+    IMPORTING = "importing"
+    IMPORTED = "imported"
+    BUILDING = "building"
+    BUILT = "built"
+    LOADING = "loading"
+    LOADED = "loaded"
+    FAILED = "failed"
+
+
+class DiskAnnError(RuntimeError):
+    pass
+
+
+class DiskAnnCore:
+    def __init__(self, index_id: int, parameter: IndexParameter, data_dir: str):
+        if parameter.dimension <= 0:
+            raise InvalidParameter(f"dimension {parameter.dimension}")
+        if parameter.dimension % parameter.nsubvector:
+            raise InvalidParameter(
+                f"dimension {parameter.dimension} % m={parameter.nsubvector}"
+            )
+        if parameter.metric not in (Metric.L2, Metric.INNER_PRODUCT,
+                                    Metric.COSINE):
+            raise InvalidParameter(f"diskann metric {parameter.metric}")
+        self.id = index_id
+        self.parameter = parameter
+        self.dim = parameter.dimension
+        self.metric = parameter.metric
+        self.nlist = parameter.ncentroids
+        self.m = parameter.nsubvector
+        self.ksub = 1 << parameter.nbits_per_idx
+        self.dir = data_dir
+        os.makedirs(self.dir, exist_ok=True)
+        self.state = CoreState.UNINIT
+        self._lock = threading.Lock()
+        self.count = 0
+        self._ids: Optional[np.ndarray] = None         # [n] int64
+        self._mmap: Optional[np.memmap] = None         # [n, d] f32 on disk
+        self.centroids = None
+        self._c_sqnorm = None
+        self.codebooks = None
+        self._codes = None                             # [n, m] uint8 device
+        self._layout = None
+        self._code_buckets = None
+        self.last_error = ""
+        self._id_to_row: dict = {}
+        # restart recovery: a previous incarnation's import data on disk is
+        # adopted (count/ids restored) so appends stay consistent instead of
+        # silently pairing stale rows with a fresh count
+        if os.path.exists(self._ids_path()):
+            prev = np.load(self._ids_path())
+            self.count = len(prev)
+            self._id_to_row = {int(v): i for i, v in enumerate(prev)}
+            if self.count:
+                self.state = CoreState.IMPORTED
+
+    # -- paths ---------------------------------------------------------------
+    def _data_path(self) -> str:
+        return os.path.join(self.dir, "vectors.f32")
+
+    def _ids_path(self) -> str:
+        return os.path.join(self.dir, "ids.npy")
+
+    def _index_path(self) -> str:
+        return os.path.join(self.dir, "pq_index.npz")
+
+    def _meta_path(self) -> str:
+        return os.path.join(self.dir, "meta.json")
+
+    # -- import --------------------------------------------------------------
+    def push_data(self, ids: np.ndarray, vectors: np.ndarray,
+                  has_more: bool) -> int:
+        """Append a batch to the disk file (VectorPushData). Returns the
+        total row count so far."""
+        with self._lock:
+            # IMPORTED is re-enterable: restart recovery lands there and a
+            # caller may resume pushing before (re)building
+            if self.state not in (CoreState.UNINIT, CoreState.IMPORTING,
+                                  CoreState.IMPORTED):
+                raise DiskAnnError(f"push_data in state {self.state.value}")
+            self.state = CoreState.IMPORTING
+        vectors = np.asarray(vectors, np.float32)
+        ids = np.asarray(ids, np.int64)
+        if vectors.ndim != 2 or vectors.shape[1] != self.dim:
+            raise InvalidParameter(f"vector shape {vectors.shape}")
+        if len(ids) != len(vectors):
+            raise InvalidParameter("ids/vectors length mismatch")
+        if self.metric is Metric.COSINE:
+            norms = np.linalg.norm(vectors, axis=1, keepdims=True)
+            vectors = vectors / np.maximum(norms, 1e-30)
+        with self._lock:
+            # upsert semantics: an already-pushed id overwrites its row in
+            # place instead of appending a duplicate physical row
+            fresh_rows, fresh_ids = [], []
+            replace = []           # (row_index, vector)
+            for vid, row in zip(ids, vectors):
+                r = self._id_to_row.get(int(vid))
+                if r is None:
+                    self._id_to_row[int(vid)] = self.count + len(fresh_ids)
+                    fresh_ids.append(int(vid))
+                    fresh_rows.append(row)
+                else:
+                    replace.append((r, row))
+            if fresh_rows:
+                with open(self._data_path(), "ab") as f:
+                    f.write(np.stack(fresh_rows).tobytes())
+            if replace:
+                mm = np.memmap(self._data_path(), np.float32, "r+",
+                               shape=(self.count + len(fresh_ids), self.dim))
+                for r, row in replace:
+                    mm[r] = row
+                mm.flush()
+                del mm
+            prev = (
+                np.load(self._ids_path())
+                if os.path.exists(self._ids_path()) else
+                np.empty(0, np.int64)
+            )
+            np.save(self._ids_path(), np.concatenate(
+                [prev, np.asarray(fresh_ids, np.int64)]
+            ))
+            self.count += len(fresh_ids)
+            if not has_more:
+                self.state = CoreState.IMPORTED
+            return self.count
+
+    # -- build ---------------------------------------------------------------
+    def build(self) -> None:
+        """Train coarse quantizer + residual PQ on a disk sample, then
+        encode every row chunked through the device (VectorBuild)."""
+        with self._lock:
+            # a Build request while IMPORTING finalizes the import (the
+            # serving path streams rows with has_more=True and signals the
+            # end by asking for the build)
+            if self.state is CoreState.IMPORTING and self.count:
+                self.state = CoreState.IMPORTED
+            if self.state not in (CoreState.IMPORTED, CoreState.BUILT):
+                raise DiskAnnError(f"build in state {self.state.value}")
+            self.state = CoreState.BUILDING
+        try:
+            n = self.count
+            if n < max(self.nlist, self.ksub):
+                raise DiskAnnError(
+                    f"need >= {max(self.nlist, self.ksub)} rows, have {n}"
+                )
+            mm = np.memmap(self._data_path(), np.float32, "r",
+                           shape=(n, self.dim))
+            cap = min(n, MAX_POINTS_PER_CENTROID * self.nlist)
+            rng = np.random.default_rng(self.id)
+            sel = np.sort(rng.choice(n, cap, replace=False)) if cap < n \
+                else np.arange(n)
+            sample = jnp.asarray(np.array(mm[sel]))
+            centroids, _ = train_kmeans(sample, k=self.nlist, iters=10,
+                                        seed=self.id)
+            assign_s = kmeans_assign(sample, centroids)
+            resid = sample - jnp.take(centroids, assign_s, axis=0)
+            codebooks = pq_train(resid, m=self.m, ksub=self.ksub, iters=10,
+                                 seed=self.id)
+            # encode all rows, streaming from disk in chunks
+            codes = np.empty((n, self.m), np.uint8)
+            assign = np.empty(n, np.int32)
+            chunk = 65536
+            for i in range(0, n, chunk):
+                rows = jnp.asarray(np.array(mm[i:i + chunk]))
+                a = kmeans_assign(rows, centroids)
+                r = rows - jnp.take(centroids, a, axis=0)
+                subs = split_subvectors(r, self.m)       # [m, c, dsub]
+
+                def enc(sub, cb):
+                    d2 = (
+                        squared_norms(sub)[:, None]
+                        - 2.0 * jnp.einsum(
+                            "nd,kd->nk", sub, cb,
+                            precision=jax.lax.Precision.HIGHEST,
+                        )
+                        + squared_norms(cb)[None, :]
+                    )
+                    return jnp.argmin(d2, axis=1)
+
+                c = jax.vmap(enc)(subs, codebooks).T.astype(jnp.uint8)
+                codes[i:i + chunk] = np.asarray(c)
+                assign[i:i + chunk] = np.asarray(a)
+            np.savez(
+                self._index_path(),
+                centroids=np.asarray(centroids),
+                codebooks=np.asarray(codebooks),
+                codes=codes,
+                assign=assign,
+            )
+            with open(self._meta_path(), "w") as f:
+                json.dump({"count": n, "dim": self.dim, "m": self.m,
+                           "nlist": self.nlist,
+                           "metric": self.metric.value}, f)
+            with self._lock:
+                self.state = CoreState.BUILT
+        except Exception as e:
+            with self._lock:
+                self.state = CoreState.FAILED
+                self.last_error = str(e)
+            raise
+
+    # -- load ----------------------------------------------------------------
+    def load(self) -> None:
+        """Map the disk file + put codes/centroids on device (VectorLoad)."""
+        with self._lock:
+            if self.state not in (CoreState.BUILT, CoreState.LOADED,
+                                  CoreState.UNINIT, CoreState.IMPORTED):
+                raise DiskAnnError(f"load in state {self.state.value}")
+            if not os.path.exists(self._index_path()):
+                raise DiskAnnError("not built")
+            self.state = CoreState.LOADING
+        try:
+            with open(self._meta_path()) as f:
+                meta = json.load(f)
+            if meta["dim"] != self.dim or meta["m"] != self.m:
+                raise DiskAnnError("index file parameter mismatch")
+            n = meta["count"]
+            data = np.load(self._index_path())
+            self._mmap = np.memmap(self._data_path(), np.float32, "r",
+                                   shape=(n, self.dim))
+            self._ids = np.load(self._ids_path())[:n]
+            self.count = n
+            self.centroids = jnp.asarray(data["centroids"])
+            self._c_sqnorm = squared_norms(self.centroids)
+            self.codebooks = jnp.asarray(data["codebooks"])
+            self._codes = jnp.asarray(data["codes"])
+            lay = build_layout(
+                data["assign"], np.ones(n, bool), self.nlist
+            )
+            self._layout = lay
+            self._code_buckets = lay.gather_rows(self._codes)
+            with self._lock:
+                self.state = CoreState.LOADED
+        except Exception as e:
+            with self._lock:
+                self.state = CoreState.FAILED
+                self.last_error = str(e)
+            raise
+
+    def try_load(self) -> bool:
+        """Load if an index file exists (VectorTryLoad); False otherwise."""
+        if not os.path.exists(self._index_path()):
+            return False
+        self.load()
+        return True
+
+    # -- search --------------------------------------------------------------
+    def search(self, queries: np.ndarray, topk: int,
+               nprobe: Optional[int] = None,
+               rerank_factor: Optional[int] = None,
+               ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """ADC prune on device -> exact disk rerank. Returns per-query
+        (ids [k], distances [k])."""
+        from dingo_tpu.index.flat import _pad_batch
+        from dingo_tpu.index.ivf_flat import _probe_lists
+        from dingo_tpu.index.ivf_pq import _ivfpq_scan_kernel
+
+        with self._lock:
+            if self.state is not CoreState.LOADED:
+                raise DiskAnnError(f"search in state {self.state.value}")
+            # snapshot device/disk state under the lock: a concurrent
+            # close()/reset() nulls the attributes, but these locals keep
+            # their objects alive for the duration of this search
+            mmap = self._mmap
+            ids_arr = self._ids
+            lay = self._layout
+            code_buckets = self._code_buckets
+            centroids = self.centroids
+            c_sqnorm = self._c_sqnorm
+            codebooks = self.codebooks
+            count = self.count
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if self.metric is Metric.COSINE:
+            norms = np.linalg.norm(queries, axis=1, keepdims=True)
+            queries = queries / np.maximum(norms, 1e-30)
+        b = queries.shape[0]
+        k = int(topk)
+        kprime = min(count, k * (rerank_factor or RERANK_FACTOR))
+        nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
+        qpad = jnp.asarray(_pad_batch(queries))
+        probes = _probe_lists(qpad, centroids, c_sqnorm, nprobe)
+        vprobes, coarse_pos = expand_probes_ranked(
+            probes, lay.probe_table, nprobe, lay.max_spill
+        )
+        lut_bytes = qpad.shape[0] * nprobe * self.m * self.ksub * 4
+        _, rows = _ivfpq_scan_kernel(
+            code_buckets, lay.bucket_valid, lay.bucket_slot,
+            lay.bucket_coarse, probes, vprobes, coarse_pos, qpad,
+            centroids, codebooks, k=kprime,
+            precompute_lut=lut_bytes <= 256 * 1024 * 1024,
+        )
+        rows = np.asarray(rows)[:b]                   # [b, k'] row indices
+        # exact rerank: one batched disk gather + einsum on device
+        safe = np.where(rows >= 0, rows, 0)
+        cand = np.asarray(mmap[safe.reshape(-1)]).reshape(
+            b, kprime, self.dim
+        )
+        dc = jnp.asarray(cand)
+        qd = jnp.asarray(queries)
+        dots = jnp.einsum(
+            "bd,bkd->bk", qd, dc,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        if self.metric is Metric.L2:
+            exact = (
+                squared_norms(qd)[:, None] - 2.0 * dots
+                + jnp.einsum("bkd,bkd->bk", dc, dc,
+                             precision=jax.lax.Precision.HIGHEST)
+            )
+            order = jnp.argsort(
+                jnp.where(jnp.asarray(rows) >= 0, exact, jnp.inf), axis=1
+            )[:, :k]
+        else:
+            exact = dots
+            order = jnp.argsort(
+                jnp.where(jnp.asarray(rows) >= 0, -exact, jnp.inf), axis=1
+            )[:, :k]
+        order_h = np.asarray(order)
+        exact_h = np.asarray(exact)
+        out = []
+        for qi in range(b):
+            sel = order_h[qi]
+            valid = rows[qi][sel] >= 0
+            sel = sel[valid]
+            out.append((
+                ids_arr[rows[qi][sel]],
+                exact_h[qi][sel],
+            ))
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+    def status(self) -> CoreState:
+        with self._lock:
+            return self.state
+
+    def close(self) -> None:
+        """Unload device/memory state; disk files stay (VectorClose)."""
+        with self._lock:
+            self._mmap = None
+            self._codes = None
+            self._code_buckets = None
+            self._layout = None
+            self.centroids = None
+            self.codebooks = None
+            if self.state in (CoreState.LOADED, CoreState.LOADING):
+                self.state = CoreState.BUILT
+
+    def reset(self, delete_data_file: bool = False) -> None:
+        """Back to importable state (VectorReset)."""
+        self.close()
+        with self._lock:
+            if delete_data_file:
+                for p in (self._data_path(), self._ids_path(),
+                          self._index_path(), self._meta_path()):
+                    if os.path.exists(p):
+                        os.remove(p)
+                self.count = 0
+                self._id_to_row.clear()
+                self.state = CoreState.UNINIT
+            else:
+                self.state = (
+                    CoreState.IMPORTED if self.count else CoreState.UNINIT
+                )
+
+    def destroy(self) -> None:
+        self.close()
+        with self._lock:
+            shutil.rmtree(self.dir, ignore_errors=True)
+            self.count = 0
+            self._id_to_row.clear()
+            self.state = CoreState.UNINIT

@@ -1,0 +1,82 @@
+"""DiskAnnItemManager: per-index registry + async build worker.
+
+Reference: DiskANNItem per-index state machine (diskann_item.h:43) +
+DiskANNItemManager singleton (diskann_item_manager.h:50) with dedicated
+build/load worker sets (conf/diskann.template.yaml). Here one background
+worker thread drains a build queue (builds are device-heavy; serializing
+them matches the reference's bounded build worker set).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Dict, Optional
+
+from dingo_tpu.diskann.core import CoreState, DiskAnnCore, DiskAnnError
+from dingo_tpu.index.base import IndexParameter
+
+
+class DiskAnnItemManager:
+    def __init__(self, root_dir: str):
+        self.root = root_dir
+        os.makedirs(root_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._items: Dict[int, DiskAnnCore] = {}
+        self._build_q: "queue.Queue[Optional[int]]" = queue.Queue()
+        self._worker = threading.Thread(
+            target=self._build_loop, name="diskann-build", daemon=True
+        )
+        self._worker.start()
+
+    # -- registry ------------------------------------------------------------
+    def create(self, index_id: int, parameter: IndexParameter) -> DiskAnnCore:
+        with self._lock:
+            if index_id in self._items:
+                raise DiskAnnError(f"index {index_id} exists")
+            core = DiskAnnCore(
+                index_id, parameter, os.path.join(self.root, str(index_id))
+            )
+            self._items[index_id] = core
+            return core
+
+    def get(self, index_id: int) -> Optional[DiskAnnCore]:
+        with self._lock:
+            return self._items.get(index_id)
+
+    def destroy(self, index_id: int) -> None:
+        with self._lock:
+            core = self._items.pop(index_id, None)
+        if core is not None:
+            core.destroy()
+
+    def all_items(self):
+        with self._lock:
+            return dict(self._items)
+
+    # -- async build ---------------------------------------------------------
+    def submit_build(self, index_id: int) -> None:
+        core = self.get(index_id)
+        if core is None:
+            raise DiskAnnError(f"index {index_id} not found")
+        if core.status() not in (CoreState.IMPORTED, CoreState.BUILT):
+            raise DiskAnnError(f"build in state {core.status().value}")
+        self._build_q.put(index_id)
+
+    def _build_loop(self) -> None:
+        while True:
+            index_id = self._build_q.get()
+            if index_id is None:
+                return
+            core = self.get(index_id)
+            if core is None:
+                continue
+            try:
+                core.build()
+            except Exception:
+                pass  # state/last_error carry the failure to Status()
+
+    def stop(self) -> None:
+        self._build_q.put(None)
+        self._worker.join(timeout=5)

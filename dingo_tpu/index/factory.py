@@ -42,6 +42,10 @@ def new_index(index_id: int, parameter: IndexParameter) -> VectorIndex:
         from dingo_tpu.index.ivf_pq import TpuIvfPq
 
         return TpuIvfPq(index_id, parameter)
+    if t is IndexType.DISKANN:
+        from dingo_tpu.index.diskann import TpuDiskann
+
+        return TpuDiskann(index_id, parameter)
     if t is IndexType.HNSW:
         from dingo_tpu.index.hnsw import TpuHnsw
 

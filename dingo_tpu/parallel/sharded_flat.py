@@ -192,6 +192,13 @@ class TpuShardedFlat(VectorIndex):
         ids = np.asarray(ids, np.int64)
         if len(ids) != len(vectors):
             raise InvalidParameter("ids/vectors length mismatch")
+        if len(ids) != len(np.unique(ids)):
+            # duplicate ids map to one slot; an XLA scatter with repeated
+            # indices has an undefined winner, so keep only the LAST
+            # occurrence (upsert last-write-wins, matching TpuFlat)
+            last = {int(v): i for i, v in enumerate(ids)}
+            keep = sorted(last.values())
+            ids, vectors = ids[keep], vectors[keep]
         new = sum(1 for v in ids if int(v) not in self._id_to_gslot)
         free = sum(len(f) for f in self._free_per_shard)
         if new > free:
@@ -324,6 +331,10 @@ class TpuShardedFlat(VectorIndex):
             meta = json.load(f)
         if meta["dimension"] != self.dimension:
             raise InvalidParameter("snapshot dimension mismatch")
+        if meta["metric"] != self.metric.value:
+            raise InvalidParameter(
+                f"snapshot metric {meta['metric']} != {self.metric.value}"
+            )
         data = np.load(os.path.join(path, "sharded_flat.npz"))
         self.cap_per_shard = 0
         self._id_to_gslot.clear()

@@ -152,15 +152,36 @@ def _wait(server, crontab, node=None) -> None:
         while not stop:
             time.sleep(0.2)
     finally:
-        crontab.stop()
+        if crontab is not None:
+            crontab.stop()
         server.stop()
         if node is not None:
             node.stop()
 
 
+def serve_diskann(args) -> None:
+    """--role=diskann: the separate build/search server (main.cc:1340)."""
+    import tempfile
+
+    from dingo_tpu.diskann.item import DiskAnnItemManager
+
+    root = args.data_dir or tempfile.mkdtemp(prefix="dingo-diskann-")
+    manager = DiskAnnItemManager(root)
+    server = DingoServer(args.port)
+    server.host_diskann_role(manager)
+    port = server.start()
+    print(f"diskann server on 127.0.0.1:{port} data={root}", flush=True)
+    try:
+        _wait(server, None)
+    finally:
+        manager.stop()
+        server.stop()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="dingo-server")
-    p.add_argument("--role", choices=["coordinator", "store", "index"],
+    p.add_argument("--role",
+                   choices=["coordinator", "store", "index", "diskann"],
                    required=True)
     p.add_argument("--id", default="s0")
     p.add_argument("--port", type=int, default=0)
@@ -177,6 +198,8 @@ def main(argv=None) -> int:
         Config.load(args.config).apply_flag_overrides(FLAGS)
     if args.role == "coordinator":
         serve_coordinator(args)
+    elif args.role == "diskann":
+        serve_diskann(args)
     else:
         serve_store(args)   # store and index are one binary role-wise here
     return 0

@@ -58,6 +58,22 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "KvScanContinue": (pb.KvScanContinueRequest, pb.KvScanContinueResponse),
         "KvScanRelease": (pb.KvScanReleaseRequest, pb.KvScanReleaseResponse),
     },
+    "DiskAnnService": {
+        "DiskAnnNew": (pb.DiskAnnNewRequest, pb.DiskAnnNewResponse),
+        "DiskAnnPushData": (
+            pb.DiskAnnPushDataRequest, pb.DiskAnnPushDataResponse,
+        ),
+        "DiskAnnBuild": (pb.DiskAnnBuildRequest, pb.DiskAnnBuildResponse),
+        "DiskAnnLoad": (pb.DiskAnnLoadRequest, pb.DiskAnnLoadResponse),
+        "DiskAnnSearch": (pb.DiskAnnSearchRequest, pb.DiskAnnSearchResponse),
+        "DiskAnnStatus": (pb.DiskAnnStatusRequest, pb.DiskAnnStatusResponse),
+        "DiskAnnCount": (pb.DiskAnnCountRequest, pb.DiskAnnCountResponse),
+        "DiskAnnReset": (pb.DiskAnnResetRequest, pb.DiskAnnResetResponse),
+        "DiskAnnClose": (pb.DiskAnnCloseRequest, pb.DiskAnnCloseResponse),
+        "DiskAnnDestroy": (
+            pb.DiskAnnDestroyRequest, pb.DiskAnnDestroyResponse,
+        ),
+    },
     "MetaService": {
         "CreateSchema": (pb.CreateSchemaRequest, pb.CreateSchemaResponse),
         "DropSchema": (pb.DropSchemaRequest, pb.DropSchemaResponse),
@@ -172,6 +188,13 @@ class DingoServer:
         _register(self._server, "NodeService", NodeService(node))
         _register(self._server, "DebugService", DebugService())
         _register(self._server, "UtilService", UtilService())
+
+    def host_diskann_role(self, manager) -> None:
+        """--role=diskann service set (main.cc:1340)."""
+        from dingo_tpu.diskann.service import DiskAnnService
+
+        _register(self._server, "DiskAnnService", DiskAnnService(manager))
+        _register(self._server, "DebugService", DebugService())
 
     def host_coordinator_role(self, control, tso, kv_control,
                               meta=None) -> None:

@@ -260,6 +260,11 @@ def test_hnsw_empty_search():
 # ---------------- factory ----------------
 
 
-def test_factory_unimplemented_type_raises_cleanly():
-    with pytest.raises(NotSupported):
-        new_index(1, IndexParameter(index_type=IndexType.DISKANN, dimension=8))
+def test_factory_diskann_requires_server_addr():
+    """Every index type is now creatable; DISKANN without a configured
+    --role=diskann endpoint fails with a clear error, not NotSupported."""
+    from dingo_tpu.index.base import VectorIndexError
+
+    with pytest.raises(VectorIndexError, match="diskann_server_addr"):
+        new_index(1, IndexParameter(index_type=IndexType.DISKANN,
+                                    dimension=8))
