@@ -75,6 +75,9 @@ FLAGS.define("gc_retention_ms", 3_600_000, mutable=True)
 FLAGS.define("use_pallas_fused_search", False, mutable=True,
              help_="route flat L2/IP searches through the fused Pallas "
                    "streaming kernel (no [b,n] HBM materialization)")
+FLAGS.define("wal_checkpoint_bytes", 64 * 1024 * 1024, mutable=True,
+             help_="WalEngine folds the WAL into a checkpoint once it "
+                   "exceeds this size, bounding restart replay time")
 FLAGS.define("diskann_server_addr", "", mutable=True,
              help_="endpoint of the --role=diskann server; required to "
                    "create VECTOR_INDEX_TYPE_DISKANN indexes")

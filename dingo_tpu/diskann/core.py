@@ -101,9 +101,16 @@ class DiskAnnCore:
         # adopted (count/ids restored) so appends stay consistent instead of
         # silently pairing stale rows with a fresh count
         if os.path.exists(self._ids_path()):
-            prev = np.load(self._ids_path())
+            prev = np.fromfile(self._ids_path(), np.int64)
             self.count = len(prev)
             self._id_to_row = {int(v): i for i, v in enumerate(prev)}
+            # a crash between the row append and the ids append can leave
+            # orphan rows in vectors.f32; truncate so future appends align
+            want = self.count * self.dim * 4
+            if (os.path.exists(self._data_path())
+                    and os.path.getsize(self._data_path()) > want):
+                with open(self._data_path(), "r+b") as f:
+                    f.truncate(want)
             if self.count:
                 self.state = CoreState.IMPORTED
 
@@ -112,7 +119,7 @@ class DiskAnnCore:
         return os.path.join(self.dir, "vectors.f32")
 
     def _ids_path(self) -> str:
-        return os.path.join(self.dir, "ids.npy")
+        return os.path.join(self.dir, "ids.bin")   # append-only int64
 
     def _index_path(self) -> str:
         return os.path.join(self.dir, "pq_index.npz")
@@ -157,6 +164,7 @@ class DiskAnnCore:
             if fresh_rows:
                 with open(self._data_path(), "ab") as f:
                     f.write(np.stack(fresh_rows).tobytes())
+                    f.flush()
             if replace:
                 mm = np.memmap(self._data_path(), np.float32, "r+",
                                shape=(self.count + len(fresh_ids), self.dim))
@@ -164,14 +172,10 @@ class DiskAnnCore:
                     mm[r] = row
                 mm.flush()
                 del mm
-            prev = (
-                np.load(self._ids_path())
-                if os.path.exists(self._ids_path()) else
-                np.empty(0, np.int64)
-            )
-            np.save(self._ids_path(), np.concatenate(
-                [prev, np.asarray(fresh_ids, np.int64)]
-            ))
+            if fresh_ids:
+                # append-only: O(batch) per push, not O(total) rewrites
+                with open(self._ids_path(), "ab") as f:
+                    f.write(np.asarray(fresh_ids, np.int64).tobytes())
             self.count += len(fresh_ids)
             if not has_more:
                 self.state = CoreState.IMPORTED
@@ -271,7 +275,7 @@ class DiskAnnCore:
             data = np.load(self._index_path())
             self._mmap = np.memmap(self._data_path(), np.float32, "r",
                                    shape=(n, self.dim))
-            self._ids = np.load(self._ids_path())[:n]
+            self._ids = np.fromfile(self._ids_path(), np.int64)[:n]
             self.count = n
             self.centroids = jnp.asarray(data["centroids"])
             self._c_sqnorm = squared_norms(self.centroids)

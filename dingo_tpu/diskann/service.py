@@ -13,12 +13,7 @@ from dingo_tpu.diskann.core import CoreState, DiskAnnError
 from dingo_tpu.diskann.item import DiskAnnItemManager
 from dingo_tpu.index.base import InvalidParameter
 from dingo_tpu.server import convert, pb
-
-
-def _err(resp, code: int, msg: str):
-    resp.error.errcode = code
-    resp.error.errmsg = msg
-    return resp
+from dingo_tpu.server.services import _err
 
 
 class DiskAnnService:

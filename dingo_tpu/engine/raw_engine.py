@@ -20,8 +20,9 @@ import bisect
 import io
 import json
 import os
-import pickle
 import struct
+
+from dingo_tpu.raft import wire
 import threading
 from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
@@ -223,13 +224,20 @@ class MemEngine(RawEngine):
                     kv.put(k, v)
 
     def checkpoint(self, path: str) -> None:
+        """Atomic: state is written to a temp file and renamed, so a crash
+        mid-checkpoint leaves the previous checkpoint intact."""
         os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "mem.ckpt"), "wb") as f:
-            pickle.dump(self.snapshot_state(), f, protocol=4)
+        target = os.path.join(path, "mem.ckpt")
+        tmp = target + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(wire.encode(self.snapshot_state()))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, target)
 
     def restore_checkpoint(self, path: str) -> None:
         with open(os.path.join(path, "mem.ckpt"), "rb") as f:
-            self.load_state(pickle.load(f))
+            self.load_state(wire.decode(f.read()))
 
 
 _WAL_MAGIC = 0xD1460A11
@@ -244,15 +252,27 @@ class WalEngine(MemEngine):
     raft snapshots); compaction == checkpoint + WAL truncation.
     """
 
-    def __init__(self, path: str, fsync: bool = False):
+    def __init__(self, path: str, fsync: bool = False,
+                 checkpoint_threshold_bytes: Optional[int] = None):
         super().__init__()
+        from dingo_tpu.common.config import FLAGS
+
         self.path = path
         self.fsync = fsync
+        self.checkpoint_threshold_bytes = (
+            checkpoint_threshold_bytes
+            if checkpoint_threshold_bytes is not None
+            else FLAGS.get("wal_checkpoint_bytes")
+        )
         os.makedirs(path, exist_ok=True)
         self._wal_path = os.path.join(path, "wal.log")
         self._ckpt_dir = os.path.join(path, "checkpoint")
+        import threading
+
+        self._wal_lock = threading.Lock()
         self._recover()
         self._wal = open(self._wal_path, "ab")
+        self._wal_bytes = os.path.getsize(self._wal_path)
 
     def _recover(self) -> None:
         if os.path.isdir(self._ckpt_dir):
@@ -261,6 +281,7 @@ class WalEngine(MemEngine):
             except FileNotFoundError:
                 pass
         if os.path.exists(self._wal_path):
+            good = 0
             with open(self._wal_path, "rb") as f:
                 while True:
                     hdr = f.read(8)
@@ -272,26 +293,53 @@ class WalEngine(MemEngine):
                     blob = f.read(ln)
                     if len(blob) < ln:
                         break
+                    try:
+                        ops = wire.decode(blob)
+                    except wire.WireError:
+                        break  # torn/corrupt tail
                     batch = WriteBatch()
-                    batch.ops = pickle.loads(blob)
+                    batch.ops = [tuple(op) for op in ops]
                     MemEngine.write(self, batch)
+                    good = f.tell()
+            # truncate the torn tail BEFORE reopening for append: new
+            # records written after garbage would be unreachable by the
+            # next restart's replay (silent loss of acked writes)
+            if os.path.getsize(self._wal_path) > good:
+                with open(self._wal_path, "r+b") as f:
+                    f.truncate(good)
 
     def write(self, batch: WriteBatch) -> None:
-        blob = pickle.dumps(batch.ops, protocol=4)
-        self._wal.write(struct.pack(">II", _WAL_MAGIC, len(blob)) + blob)
-        self._wal.flush()
-        if self.fsync:
-            os.fsync(self._wal.fileno())
-        super().write(batch)
+        blob = wire.encode([list(op) for op in batch.ops])
+        # one lock serializes WAL append + memtable apply + rotation:
+        # multiple raft apply threads share this engine, and a rotation
+        # closing self._wal mid-append would drop an acked write
+        with self._wal_lock:
+            self._wal.write(struct.pack(">II", _WAL_MAGIC, len(blob)) + blob)
+            self._wal.flush()
+            if self.fsync:
+                os.fsync(self._wal.fileno())
+            self._wal_bytes += 8 + len(blob)
+            super().write(batch)
+            # bounded restart: once the WAL outgrows the threshold, fold it
+            # into a checkpoint and truncate (RocksDB flush+compaction
+            # analog; round-1 replayed an unbounded WAL on every start)
+            if self._wal_bytes >= self.checkpoint_threshold_bytes:
+                self._checkpoint_locked()
 
     def checkpoint(self, path: Optional[str] = None) -> None:
         """Checkpoint + truncate WAL (RocksDB checkpoint analog used by the
         raft snapshot path, dingo_filesystem_adaptor.h:42-115)."""
-        target = path or self._ckpt_dir
-        super().checkpoint(target)
-        if target == self._ckpt_dir or path is None:
-            self._wal.close()
-            self._wal = open(self._wal_path, "wb")
+        if path is not None and path != self._ckpt_dir:
+            super().checkpoint(path)   # snapshot elsewhere; WAL untouched
+            return
+        with self._wal_lock:
+            self._checkpoint_locked()
+
+    def _checkpoint_locked(self) -> None:
+        super().checkpoint(self._ckpt_dir)
+        self._wal.close()
+        self._wal = open(self._wal_path, "wb")
+        self._wal_bytes = 0
 
     def close(self) -> None:
         self._wal.close()

@@ -19,8 +19,9 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-import pickle
 import time
+
+from dingo_tpu.raft import wire
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from dingo_tpu.engine.raw_engine import (
@@ -98,6 +99,32 @@ class WriteRecord:
     op: Op
 
 
+def _enc_lock(lock: "LockRecord") -> bytes:
+    return wire.encode({
+        "lock_ts": lock.lock_ts, "primary": lock.primary,
+        "op": lock.op.value, "ttl_ms": lock.ttl_ms,
+        "for_update_ts": lock.for_update_ts, "create_ms": lock.create_ms,
+    })
+
+
+def _dec_lock(blob: bytes) -> "LockRecord":
+    d = wire.decode(blob)
+    return LockRecord(
+        lock_ts=d["lock_ts"], primary=d["primary"], op=Op(d["op"]),
+        ttl_ms=d["ttl_ms"], for_update_ts=d["for_update_ts"],
+        create_ms=d["create_ms"],
+    )
+
+
+def _enc_write(rec: "WriteRecord") -> bytes:
+    return wire.encode({"start_ts": rec.start_ts, "op": rec.op.value})
+
+
+def _dec_write(blob: bytes) -> "WriteRecord":
+    d = wire.decode(blob)
+    return WriteRecord(start_ts=d["start_ts"], op=Op(d["op"]))
+
+
 def _lock_key(key: bytes) -> bytes:
     return Codec.encode_bytes(key)
 
@@ -117,7 +144,7 @@ class TxnEngine:
     # -- low-level reads ----------------------------------------------------
     def get_lock(self, key: bytes) -> Optional[LockRecord]:
         blob = self.raw.get(CF_TXN_LOCK, _lock_key(key))
-        return pickle.loads(blob) if blob else None
+        return _dec_lock(blob) if blob else None
 
     def _writes_desc(self, key: bytes, from_ts: int):
         """Write records for key with commit_ts <= from_ts, newest first."""
@@ -125,7 +152,7 @@ class TxnEngine:
         end = Codec.encode_key(key, 0)
         for k, v in self.raw.scan(CF_TXN_WRITE, start, end + b"\x00"):
             _, commit_ts = Codec.decode_key(k)
-            yield commit_ts, pickle.loads(v)
+            yield commit_ts, _dec_write(v)
 
     # -- replicated batch helper -------------------------------------------
     def _apply(self, puts, deletes) -> None:
@@ -169,7 +196,7 @@ class TxnEngine:
                 for_update_ts=for_update_ts,
                 create_ms=int(time.time() * 1000),
             )
-            puts.append((CF_TXN_LOCK, _lock_key(m.key), pickle.dumps(new_lock)))
+            puts.append((CF_TXN_LOCK, _lock_key(m.key), _enc_lock(new_lock)))
             if m.op is Op.PUT:
                 puts.append(
                     (CF_TXN_DATA, Codec.encode_key(m.key, start_ts), m.value)
@@ -208,7 +235,7 @@ class TxnEngine:
             puts.append((
                 CF_TXN_WRITE,
                 Codec.encode_key(key, commit_ts),
-                pickle.dumps(rec),
+                _enc_write(rec),
             ))
             deletes.append((CF_TXN_LOCK, _lock_key(key)))
         self._apply(puts, deletes)
@@ -228,7 +255,7 @@ class TxnEngine:
             puts.append((
                 CF_TXN_WRITE,
                 Codec.encode_key(key, start_ts),
-                pickle.dumps(WriteRecord(start_ts=start_ts, op=Op.ROLLBACK)),
+                _enc_write(WriteRecord(start_ts=start_ts, op=Op.ROLLBACK)),
             ))
         self._apply(puts, deletes)
 
@@ -261,7 +288,7 @@ class TxnEngine:
             puts.append((
                 CF_TXN_LOCK,
                 _lock_key(key),
-                pickle.dumps(LockRecord(
+                _enc_lock(LockRecord(
                     lock_ts=start_ts, primary=primary, op=Op.PESSIMISTIC,
                     ttl_ms=ttl_ms, for_update_ts=for_update_ts,
                     create_ms=int(time.time() * 1000),
@@ -312,7 +339,7 @@ class TxnEngine:
         if keys is None:
             keys = []
             for k, blob in self.raw.scan(CF_TXN_LOCK):
-                lock: LockRecord = pickle.loads(blob)
+                lock: LockRecord = _dec_lock(blob)
                 if lock.lock_ts == start_ts:
                     keys.append(Codec.decode_bytes(k)[0])
         if not keys:
@@ -340,7 +367,7 @@ class TxnEngine:
             raise TxnNotFound(f"no lock for txn {start_ts}")
         lock.ttl_ms = max(lock.ttl_ms, advise_ttl_ms)
         lock.create_ms = int(time.time() * 1000)
-        self._apply([(CF_TXN_LOCK, _lock_key(primary), pickle.dumps(lock))], [])
+        self._apply([(CF_TXN_LOCK, _lock_key(primary), _enc_lock(lock))], [])
         return lock.ttl_ms
 
     # -- reads ---------------------------------------------------------------
@@ -375,7 +402,7 @@ class TxnEngine:
         # Locks gate the whole range — including keys with no write record
         # yet (a first-write lock must still fail the snapshot scan).
         for k, blob in self.raw.scan(CF_TXN_LOCK, enc_start, enc_end):
-            lock: LockRecord = pickle.loads(blob)
+            lock: LockRecord = _dec_lock(blob)
             if lock.op is not Op.PESSIMISTIC and lock.lock_ts <= read_ts:
                 raise KeyIsLocked(Codec.decode_bytes(k)[0], lock)
         for k, v in self.raw.scan(CF_TXN_WRITE, enc_start, enc_end):
@@ -385,7 +412,7 @@ class TxnEngine:
                 resolved = False
             if resolved or commit_ts > read_ts:
                 continue
-            rec: WriteRecord = pickle.loads(v)
+            rec: WriteRecord = _dec_write(v)
             if rec.op is Op.PUT:
                 value = self.raw.get(
                     CF_TXN_DATA, Codec.encode_key(key, rec.start_ts)
@@ -413,7 +440,7 @@ class TxnEngine:
             if key != current:
                 current = key
                 kept_newest = False
-            rec: WriteRecord = pickle.loads(v)
+            rec: WriteRecord = _dec_write(v)
             if commit_ts > safe_ts:
                 continue
             if rec.op is Op.ROLLBACK:

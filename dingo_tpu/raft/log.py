@@ -14,8 +14,9 @@ raft indices are 1-based like the paper.
 from __future__ import annotations
 
 import os
-import pickle
 import struct
+
+from dingo_tpu.raft import wire
 import threading
 from typing import List, Optional, Tuple
 
@@ -43,6 +44,7 @@ class RaftLog:
     def _replay(self) -> None:
         if not os.path.exists(self._path):
             return
+        good = 0
         with open(self._path, "rb") as f:
             while True:
                 hdr = f.read(8)
@@ -54,7 +56,10 @@ class RaftLog:
                 blob = f.read(ln)
                 if len(blob) < ln:
                     break
-                rec = pickle.loads(blob)
+                try:
+                    rec = wire.decode(blob)
+                except wire.WireError:
+                    break  # torn/corrupt tail
                 kind = rec[0]
                 if kind == "append":
                     _, index, term, payload = rec
@@ -65,11 +70,17 @@ class RaftLog:
                     self._apply_compaction(index, term)
                 elif kind == "hard":
                     _, self._hard_term, self._hard_vote = rec
+                good = f.tell()
+        # truncate a torn tail so post-recovery appends are not written
+        # after garbage (unreachable by the next replay = acked-entry loss)
+        if os.path.getsize(self._path) > good:
+            with open(self._path, "r+b") as f:
+                f.truncate(good)
 
     def _write_rec(self, rec) -> None:
         if self._fh is None:
             return
-        blob = pickle.dumps(rec, protocol=4)
+        blob = wire.encode(list(rec))
         self._fh.write(struct.pack(">II", _REC_MAGIC, len(blob)) + blob)
         self._fh.flush()
 

@@ -90,7 +90,7 @@ def serve_store(args) -> None:
         args.id, transport, coordinator=None, raw_engine=engine,
         snapshot_root=args.data_dir,
     )
-    node.meta.recover()
+    node.recover()
     gc = GCSafePointManager()
     streams = StreamManager()
 

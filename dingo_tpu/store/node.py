@@ -89,6 +89,26 @@ class StoreNode:
                     region.vector_index_wrapper.stop()
             self.meta.delete_region(region_id)
 
+    def recover(self) -> int:
+        """Full restart recovery (main.cc:1074-1076 ordering): reload region
+        meta, re-add each region's raft member, and rebuild in-memory
+        vector/document indexes from the engine (the dual-write contract:
+        the engine is the source of truth, indexes are rebuildable views).
+        Returns the number of recovered regions."""
+        n = self.meta.recover()
+        for region in self.meta.get_all_regions():
+            with self._lock:
+                if self.engine.get_node(region.id) is None:
+                    self.engine.add_node(
+                        region, region.definition.peers, **self.raft_kw
+                    )
+                wrapper = region.vector_index_wrapper
+                if wrapper is not None and wrapper.own_index is None:
+                    self.index_manager.rebuild(region)
+                if region.document_index is not None:
+                    self.rebuild_document_index(region)
+        return n
+
     def get_region(self, region_id: int) -> Optional[Region]:
         return self.meta.get_region(region_id)
 

@@ -1,0 +1,145 @@
+"""WalEngine durability: auto checkpoint rotation bounds restart replay
+(round-1 VERDICT weak #10: unbounded WAL replay into dicts)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dingo_tpu.engine.raw_engine import CF_DEFAULT, WalEngine, WriteBatch
+
+
+def put(engine, key: bytes, value: bytes):
+    engine.write(WriteBatch().put(CF_DEFAULT, key, value))
+
+
+def test_wal_rotates_at_threshold(tmp_path):
+    eng = WalEngine(str(tmp_path), checkpoint_threshold_bytes=4096)
+    payload = b"x" * 512
+    for i in range(64):
+        put(eng, f"k{i:04d}".encode(), payload)
+    # rotation happened at least once: WAL is far below total written bytes
+    assert os.path.getsize(tmp_path / "wal.log") < 8 * 1024
+    assert os.path.exists(tmp_path / "checkpoint" / "mem.ckpt")
+    eng.close()
+
+    # restart: checkpoint + short WAL tail reproduce every row
+    eng2 = WalEngine(str(tmp_path), checkpoint_threshold_bytes=4096)
+    for i in range(64):
+        assert eng2.get(CF_DEFAULT, f"k{i:04d}".encode()) == payload
+    eng2.close()
+
+
+def test_torn_wal_tail_recovers_prefix(tmp_path):
+    eng = WalEngine(str(tmp_path), checkpoint_threshold_bytes=1 << 30)
+    for i in range(10):
+        put(eng, f"k{i}".encode(), b"v")
+    eng.close()
+    # simulate a crash mid-append: chop bytes off the tail
+    wal = tmp_path / "wal.log"
+    data = wal.read_bytes()
+    wal.write_bytes(data[:-7])
+    eng2 = WalEngine(str(tmp_path))
+    assert eng2.get(CF_DEFAULT, b"k8") == b"v"
+    assert eng2.get(CF_DEFAULT, b"k9") is None  # torn record dropped
+    # engine stays writable after recovery
+    put(eng2, b"k9", b"v2")
+    assert eng2.get(CF_DEFAULT, b"k9") == b"v2"
+    eng2.close()
+
+
+def test_checkpoint_is_atomic(tmp_path):
+    """A crash mid-checkpoint must not destroy the previous checkpoint."""
+    eng = WalEngine(str(tmp_path), checkpoint_threshold_bytes=1 << 30)
+    put(eng, b"a", b"1")
+    eng.checkpoint()
+    # leftover temp file from a crashed later checkpoint is ignored
+    with open(tmp_path / "checkpoint" / "mem.ckpt.tmp", "wb") as f:
+        f.write(b"garbage")
+    eng.close()
+    eng2 = WalEngine(str(tmp_path))
+    assert eng2.get(CF_DEFAULT, b"a") == b"1"
+    eng2.close()
+
+
+def test_store_node_full_restart_recovery(tmp_path):
+    """StoreNode.recover(): region meta + raft member + index rebuild from
+    a durable engine after restart (main.cc:1074-1076 recovery ordering)."""
+    import time
+
+    from dingo_tpu.coordinator.control import CoordinatorControl
+    from dingo_tpu.engine.raw_engine import MemEngine
+    from dingo_tpu.index import codec as vcodec
+    from dingo_tpu.index.base import IndexParameter, IndexType
+    from dingo_tpu.raft.transport import LocalTransport
+    from dingo_tpu.store.node import StoreNode
+    from dingo_tpu.store.region import RegionType
+
+    control = CoordinatorControl(MemEngine(), replication=1)
+    raw = WalEngine(str(tmp_path), checkpoint_threshold_bytes=16384)
+    node = StoreNode("s0", LocalTransport(), control, raw_engine=raw,
+                     raft_kw={"seed": 0})
+    node.start_heartbeat(0.1)
+    d = control.create_region(
+        vcodec.encode_vector_key(1, 0), vcodec.encode_vector_key(1, 1 << 30),
+        partition_id=1, region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(index_type=IndexType.FLAT,
+                                       dimension=16),
+    )
+    time.sleep(1.0)
+    region = node.get_region(d.region_id)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((300, 16)).astype(np.float32)
+    node.storage.vector_add(region, np.arange(300, dtype=np.int64), x)
+    node.stop()
+    raw.close()
+
+    raw2 = WalEngine(str(tmp_path), checkpoint_threshold_bytes=16384)
+    node2 = StoreNode("s0", LocalTransport(), None, raw_engine=raw2,
+                      raft_kw={"seed": 0})
+    assert node2.recover() == 1
+    time.sleep(0.6)  # single-member raft re-elects
+    region2 = node2.get_region(d.region_id)
+    res = node2.storage.vector_batch_search(region2, x[:2], 3)
+    assert res[0][0].id == 0 and res[1][0].id == 1
+    # region is writable again after recovery
+    node2.storage.vector_add(region2, np.asarray([900], np.int64), x[:1])
+    node2.stop()
+    raw2.close()
+
+
+def test_torn_tail_then_append_survives_second_restart(tmp_path):
+    """Review repro: recovery must truncate the torn tail BEFORE appending,
+    or post-recovery writes land after garbage and vanish on restart #2."""
+    eng = WalEngine(str(tmp_path), checkpoint_threshold_bytes=1 << 30)
+    for i in range(5):
+        put(eng, f"k{i}".encode(), b"v")
+    eng.close()
+    wal = tmp_path / "wal.log"
+    wal.write_bytes(wal.read_bytes()[:-3])  # torn tail
+    eng2 = WalEngine(str(tmp_path))
+    put(eng2, b"new", b"acked")             # written after recovery
+    eng2.close()
+    eng3 = WalEngine(str(tmp_path))         # restart #2
+    assert eng3.get(CF_DEFAULT, b"new") == b"acked"
+    assert eng3.get(CF_DEFAULT, b"k3") == b"v"
+    eng3.close()
+
+
+def test_raft_log_torn_tail_then_append(tmp_path):
+    from dingo_tpu.raft.log import RaftLog
+
+    log = RaftLog(str(tmp_path / "r.log"))
+    for i in range(5):
+        log.append(1, f"p{i}".encode())
+    log.close()
+    p = tmp_path / "r.log"
+    p.write_bytes(p.read_bytes()[:-3])
+    log2 = RaftLog(str(p))
+    assert log2.last_index() == 4           # torn record 5 dropped
+    log2.append(1, b"after")                # acked post-recovery
+    log2.close()
+    log3 = RaftLog(str(p))
+    assert log3.last_index() == 5
+    assert log3.entry_at(5)[1] == b"after"
+    log3.close()
