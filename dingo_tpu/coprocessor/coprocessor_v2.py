@@ -1,0 +1,189 @@
+"""CoprocessorV2: typed-schema pushdown over serial-encoded table rows.
+
+Reference: src/coprocessor/coprocessor_v2.{h,cc} — holds original/result
+serial schemas + selection column indexes (coprocessor_v2.h:102-111), runs
+rel-expression bytecode (rel::RelRunner from dingo-libexpr,
+coprocessor_v2.cc:209-216) against each decoded row during a scan, then
+projects (selection) and optionally aggregates (AggregationManager,
+aggregation.h). This module plays the same role over dingo_tpu's pieces:
+`common/serial.py` typed rows, the `coprocessor/expr.py` VM as the
+expression engine, and a grouped aggregation manager.
+
+Row wire format: a row VALUE is the concatenation of `serial.encode_value`
+for each column in schema order (order-preserving typed encoding, so rows
+are also memcomparable per column).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from dingo_tpu.common import serial
+from dingo_tpu.coprocessor.expr import Expr
+
+
+class CoprocessorError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class SchemaColumn:
+    name: str
+    sql_type: str = "VARCHAR"    # BIGINT/DOUBLE/VARCHAR/BOOL/BYTES
+    index: int = 0
+
+
+class AggOpV2(enum.Enum):
+    """AggregationManager operator set (aggregation.h)."""
+
+    SUM = 1
+    COUNT = 2
+    COUNT_WITH_NULL = 3
+    MAX = 4
+    MIN = 5
+    SUM0 = 6     # like SUM but 0 (not NULL) over an empty group
+
+
+@dataclasses.dataclass
+class AggregationSpec:
+    op: AggOpV2
+    column_index: int            # original-schema column; -1 for COUNT(*)
+
+
+@dataclasses.dataclass
+class CoprocessorDef:
+    """pb::store::Coprocessor analog."""
+
+    original_schema: List[SchemaColumn]
+    selection: List[int] = dataclasses.field(default_factory=list)
+    filter_expr: Optional[list] = None          # expr.py wire tree
+    group_by: List[int] = dataclasses.field(default_factory=list)
+    aggregations: List[AggregationSpec] = dataclasses.field(
+        default_factory=list
+    )
+
+
+def encode_row(values: Sequence[Any]) -> bytes:
+    """Row value bytes: concatenated typed encodings in schema order."""
+    return b"".join(serial.encode_value(v) for v in values)
+
+
+def decode_row(blob: bytes, ncols: int) -> List[Any]:
+    out, offset = [], 0
+    for _ in range(ncols):
+        v, offset = serial.decode_value(blob, offset)
+        out.append(v)
+    return out
+
+
+class _Group:
+    __slots__ = ("accs", "counts")
+
+    def __init__(self, n: int):
+        self.accs: List[Any] = [None] * n
+        self.counts = [0] * n
+
+
+class CoprocessorV2:
+    """Filter -> project | group+aggregate over decoded rows."""
+
+    def __init__(self, defn: CoprocessorDef):
+        self.defn = defn
+        ncols = len(defn.original_schema)
+        for idx in defn.selection + defn.group_by:
+            if not 0 <= idx < ncols:
+                raise CoprocessorError(f"column index {idx} out of range")
+        for a in defn.aggregations:
+            if a.column_index >= ncols or a.column_index < -1:
+                # -1 is the COUNT(*) sentinel; anything else negative is a
+                # caller bug that would silently aggregate the literal 1
+                raise CoprocessorError(
+                    f"aggregation column {a.column_index} out of range"
+                )
+        self._names = [c.name for c in defn.original_schema]
+        self._expr = (
+            Expr(defn.filter_expr) if defn.filter_expr is not None else None
+        )
+
+    # -- row-at-a-time (RawCoprocessor::Filter contract) ---------------------
+    def decode(self, value: bytes) -> List[Any]:
+        return decode_row(value, len(self.defn.original_schema))
+
+    def filter_row(self, row: List[Any]) -> bool:
+        if self._expr is None:
+            return True
+        fields = dict(zip(self._names, row))
+        try:
+            return bool(self._expr.eval(fields))
+        except TypeError:
+            # SQL WHERE semantics: a NULL operand makes the predicate
+            # unknown, and unknown rows are not selected
+            return False
+
+    def project(self, row: List[Any]) -> List[Any]:
+        if not self.defn.selection:
+            return row
+        return [row[i] for i in self.defn.selection]
+
+    # -- scan execution (CoprocessorV2::Execute contract) --------------------
+    def execute(
+        self, kvs: Iterable[Tuple[bytes, bytes]], limit: int = 0
+    ) -> List[Tuple[bytes, bytes]]:
+        """Run over scan output. Without aggregations: (key, projected-row)
+        for rows passing the filter, stopping at `limit` matches (0 =
+        unlimited). With aggregations: one row per group (limit applies to
+        the grouped output), key = encoded group-by values (b"" for the
+        global group)."""
+        if not self.defn.aggregations:
+            out = []
+            for k, v in kvs:
+                row = self.decode(v)
+                if self.filter_row(row):
+                    out.append((k, encode_row(self.project(row))))
+                    if limit and len(out) >= limit:
+                        break
+            return out
+
+        groups: Dict[bytes, _Group] = {}
+        nagg = len(self.defn.aggregations)
+        for _k, v in kvs:
+            row = self.decode(v)
+            if not self.filter_row(row):
+                continue
+            gkey = encode_row([row[i] for i in self.defn.group_by])
+            g = groups.get(gkey)
+            if g is None:
+                g = groups[gkey] = _Group(nagg)
+            for i, spec in enumerate(self.defn.aggregations):
+                val = row[spec.column_index] if spec.column_index >= 0 else 1
+                op = spec.op
+                if op is AggOpV2.COUNT_WITH_NULL:
+                    g.counts[i] += 1
+                    continue
+                if val is None:
+                    continue
+                g.counts[i] += 1
+                acc = g.accs[i]
+                if op in (AggOpV2.SUM, AggOpV2.SUM0):
+                    g.accs[i] = val if acc is None else acc + val
+                elif op is AggOpV2.COUNT:
+                    pass  # counts[i] carries it
+                elif op is AggOpV2.MAX:
+                    g.accs[i] = val if acc is None else max(acc, val)
+                elif op is AggOpV2.MIN:
+                    g.accs[i] = val if acc is None else min(acc, val)
+        out = []
+        for gkey in sorted(groups):
+            g = groups[gkey]
+            row_out: List[Any] = []
+            for i, spec in enumerate(self.defn.aggregations):
+                if spec.op in (AggOpV2.COUNT, AggOpV2.COUNT_WITH_NULL):
+                    row_out.append(g.counts[i])
+                elif spec.op is AggOpV2.SUM0:
+                    row_out.append(0 if g.accs[i] is None else g.accs[i])
+                else:
+                    row_out.append(g.accs[i])
+            out.append((gkey, encode_row(row_out)))
+        return out[:limit] if limit else out

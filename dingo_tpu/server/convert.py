@@ -179,3 +179,31 @@ def is_binary_parameter(param) -> bool:
     from dingo_tpu.index.vector_reader import is_binary_dim_param
 
     return is_binary_dim_param(param)
+
+
+def coprocessor_from_pb(m) -> "object | None":
+    """pb.Coprocessor -> CoprocessorV2 (None when the field is unset)."""
+    if not m.original_schema:
+        return None
+    from dingo_tpu.coprocessor.coprocessor_v2 import (
+        AggOpV2,
+        AggregationSpec,
+        CoprocessorDef,
+        CoprocessorV2,
+        SchemaColumn,
+    )
+
+    defn = CoprocessorDef(
+        original_schema=[
+            SchemaColumn(c.name, c.sql_type or "VARCHAR", c.index)
+            for c in m.original_schema
+        ],
+        selection=list(m.selection),
+        filter_expr=wire.decode(m.filter_expr) if m.filter_expr else None,
+        group_by=list(m.group_by),
+        aggregations=[
+            AggregationSpec(AggOpV2(a.op), a.column_index)
+            for a in m.aggregations
+        ],
+    )
+    return CoprocessorV2(defn)

@@ -116,6 +116,23 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "RequeueRegionCmd": (pb.RequeueRegionCmdRequest, pb.RequeueRegionCmdResponse),
         "GetGCSafePoint": (pb.GetGCSafePointRequest, pb.GetGCSafePointResponse),
     },
+    "JobService": {
+        "ListJobs": (pb.ListJobsRequest, pb.ListJobsResponse),
+    },
+    "ClusterStatService": {
+        "GetClusterStat": (
+            pb.GetClusterStatRequest, pb.GetClusterStatResponse,
+        ),
+    },
+    "RegionControlService": {
+        "RegionSnapshot": (
+            pb.RegionSnapshotRequest, pb.RegionSnapshotResponse,
+        ),
+        "RegionRebuildIndex": (
+            pb.RegionRebuildIndexRequest, pb.RegionRebuildIndexResponse,
+        ),
+        "RegionDetail": (pb.RegionDetailRequest, pb.RegionDetailResponse),
+    },
     "RaftService": {
         "RaftMessage": (pb.RaftMessageRequest, pb.RaftMessageResponse),
     },
@@ -188,6 +205,10 @@ class DingoServer:
         _register(self._server, "NodeService", NodeService(node))
         _register(self._server, "DebugService", DebugService())
         _register(self._server, "UtilService", UtilService())
+        from dingo_tpu.server.services import RegionControlService
+
+        _register(self._server, "RegionControlService",
+                  RegionControlService(node))
 
     def host_diskann_role(self, manager) -> None:
         """--role=diskann service set (main.cc:1340)."""
@@ -210,6 +231,11 @@ class DingoServer:
 
             meta = MetaControl(control.engine, control)
         _register(self._server, "MetaService", MetaService(meta))
+        from dingo_tpu.server.services import ClusterStatService, JobService
+
+        _register(self._server, "JobService", JobService(control))
+        _register(self._server, "ClusterStatService",
+                  ClusterStatService(control))
 
     def start(self) -> int:
         self._server.start()

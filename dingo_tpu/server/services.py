@@ -335,10 +335,24 @@ class StoreService:
         region = _region_or_err(self.node, req.context, resp)
         if region is None:
             return resp
+        try:
+            cop = convert.coprocessor_from_pb(req.coprocessor)
+        except ValueError as e:
+            return _err(resp, 60001, f"bad coprocessor: {e}")
         pairs = self.node.storage.kv_scan(
             region, req.range.start_key, req.range.end_key,
-            limit=req.limit, keys_only=req.keys_only,
+            # coprocessor filtering happens after the scan; a pre-filter
+            # limit would truncate the candidate set
+            limit=0 if cop is not None else req.limit,
+            keys_only=req.keys_only and cop is None,
         )
+        if cop is not None:
+            try:
+                pairs = cop.execute(pairs)
+            except ValueError as e:
+                return _err(resp, 60002, f"coprocessor execute: {e}")
+            if req.limit:
+                pairs = pairs[: req.limit]
         for k, v in pairs:
             kv = resp.kvs.add()
             kv.key = k
@@ -445,12 +459,23 @@ class StoreService:
         if region is None:
             return resp
         try:
+            cop = convert.coprocessor_from_pb(req.coprocessor)
+        except ValueError as e:
+            return _err(resp, 60001, f"bad coprocessor: {e}")
+        try:
             pairs = self._txn(region).scan(
                 req.range.start_key, req.range.end_key, req.start_ts,
-                limit=req.limit,
+                limit=0 if cop is not None else req.limit,
             )
         except TxnError as e:
             return _err(resp, 40001, str(e))
+        if cop is not None:
+            import struct as _struct
+
+            try:
+                pairs = cop.execute(pairs, limit=req.limit)
+            except (ValueError, IndexError, _struct.error) as e:
+                return _err(resp, 60002, f"coprocessor execute: {e}")
         for k, v in pairs:
             kv = resp.kvs.add()
             kv.key = k
@@ -935,4 +960,115 @@ class MetaService:
         resp = pb.GetTablesResponse()
         for t in self.meta.get_tables(req.schema_name):
             self._table_to_pb(t, resp.definitions.add())
+        return resp
+
+
+class JobService:
+    """Job introspection (reference JobService, main.cc registry): lists
+    the coordinator's queued/active region commands."""
+
+    def __init__(self, control: CoordinatorControl):
+        self.control = control
+
+    def ListJobs(self, req: pb.ListJobsRequest):
+        resp = pb.ListJobsResponse()
+        with self.control._lock:
+            for store_id, cmds in self.control.store_ops.items():
+                for cmd in cmds:
+                    if cmd.status == "done" and not req.include_done:
+                        continue
+                    j = resp.jobs.add()
+                    j.cmd_id = cmd.cmd_id
+                    j.region_id = cmd.region_id
+                    j.cmd_type = cmd.cmd_type.value
+                    j.status = cmd.status
+                    j.store_id = store_id
+                    j.retries = cmd.retries
+        return resp
+
+
+class ClusterStatService:
+    """Cluster-level stats (reference ClusterStatService)."""
+
+    def __init__(self, control: CoordinatorControl):
+        self.control = control
+
+    def GetClusterStat(self, req: pb.GetClusterStatRequest):
+        from dingo_tpu.coordinator.control import StoreState
+
+        resp = pb.GetClusterStatResponse()
+        with self.control._lock:
+            stores = list(self.control.stores.values())
+            resp.store_count = len(stores)
+            resp.alive_store_count = sum(
+                1 for s in stores if s.state is StoreState.NORMAL
+            )
+            resp.region_count = len(self.control.regions)
+            resp.pending_job_count = sum(
+                1 for cmds in self.control.store_ops.values()
+                for c in cmds if c.status != "done"
+            )
+            for s in stores:
+                st = resp.stores.add()
+                st.store_id = s.store_id
+                st.state = s.state.value
+                st.region_count = len(s.region_ids)
+                st.leader_count = len(s.leader_region_ids)
+                st.last_heartbeat_ms = s.last_heartbeat_ms
+        return resp
+
+
+class RegionControlService:
+    """Store-side forced region operations (reference RegionControlService):
+    snapshot / index rebuild / detailed state dump."""
+
+    def __init__(self, node: StoreNode):
+        self.node = node
+
+    def RegionSnapshot(self, req: pb.RegionSnapshotRequest):
+        resp = pb.RegionSnapshotResponse()
+        region = self.node.get_region(req.region_id)
+        if region is None:
+            return _err(resp, 10001, f"region {req.region_id} not found")
+        if region.vector_index_wrapper is None:
+            return _err(resp, 70001, "region has no vector index")
+        try:
+            resp.path = self.node.index_manager.save_index(region)
+        except (AssertionError, OSError) as e:
+            return _err(resp, 70002, f"snapshot failed: {e}")
+        return resp
+
+    def RegionRebuildIndex(self, req: pb.RegionRebuildIndexRequest):
+        resp = pb.RegionRebuildIndexResponse()
+        region = self.node.get_region(req.region_id)
+        if region is None:
+            return _err(resp, 10001, f"region {req.region_id} not found")
+        if region.vector_index_wrapper is not None:
+            self.node.index_manager.rebuild(region)
+        elif region.document_index is not None:
+            self.node.rebuild_document_index(region)
+        else:
+            return _err(resp, 70001, "region has no index")
+        return resp
+
+    def RegionDetail(self, req: pb.RegionDetailRequest):
+        resp = pb.RegionDetailResponse()
+        region = self.node.get_region(req.region_id)
+        if region is None:
+            return _err(resp, 10001, f"region {req.region_id} not found")
+        resp.definition.CopyFrom(convert.region_def_to_pb(region.definition))
+        resp.state = region.state.value
+        raft = self.node.engine.get_node(region.id)
+        if raft is not None:
+            resp.is_leader = raft.is_leader()
+            resp.raft_term = raft.current_term
+            resp.raft_commit_index = raft.commit_index
+            resp.raft_last_applied = raft.last_applied
+        wrapper = region.vector_index_wrapper
+        if wrapper is not None and wrapper.own_index is not None:
+            resp.index_count = wrapper.own_index.get_count()
+            resp.index_apply_log_id = wrapper.apply_log_id
+        resp.change_log.extend(
+            f"{ts:.3f} {msg}" for ts, msg in region.change_log[-20:]
+        )
         return resp

@@ -166,3 +166,48 @@ def test_binary_ivf_table_over_grpc(cluster):
     assert sresp.error.errcode == 0, sresp.error.errmsg
     top = sresp.batch_results[0].results[0]
     assert top.vector.id == 7 and top.distance == 0.0
+
+
+def test_introspection_services(cluster):
+    """Job / ClusterStat / RegionControl introspection (main.cc service
+    registry rows)."""
+    client, control, meta, nodes = cluster
+    param = pb.VectorIndexParameter(
+        index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=8,
+        metric_type=pb.METRIC_TYPE_L2,
+    )
+    client.create_vector_table("dingo", "intros", param,
+                               partitions=[(41, 0, 100)])
+    time.sleep(1.2)
+    from dingo_tpu.server.rpc import ServiceStub
+
+    cs = ServiceStub(client._coord_channel, "ClusterStatService")
+    resp = cs.GetClusterStat(pb.GetClusterStatRequest())
+    assert resp.store_count == 3
+    assert resp.alive_store_count == 3
+    assert resp.region_count >= 1
+    assert len(resp.stores) == 3
+
+    js = ServiceStub(client._coord_channel, "JobService")
+    jobs = js.ListJobs(pb.ListJobsRequest(include_done=True))
+    assert len(jobs.jobs) >= 1  # region creates flowed through the queue
+    assert all(j.cmd_type for j in jobs.jobs)
+
+    # region detail on a store hosting an index region (write one row so
+    # the raft log has a committed entry)
+    client.refresh_region_map()
+    d = next(r for r in client._regions if r.partition_id == 41)
+    client.vector_add(41, [1], np.zeros((1, 8), np.float32))
+    leader = control.region_leaders.get(d.region_id, "s0")
+    rc = client._stub(leader, "RegionControlService")
+    detail = rc.RegionDetail(pb.RegionDetailRequest(region_id=d.region_id))
+    assert detail.error.errcode == 0
+    assert detail.definition.region_id == d.region_id
+    assert detail.is_leader
+    assert detail.raft_commit_index >= 1
+    missing = rc.RegionDetail(pb.RegionDetailRequest(region_id=999999))
+    assert missing.error.errcode == 10001
+
+    rb = rc.RegionRebuildIndex(
+        pb.RegionRebuildIndexRequest(region_id=d.region_id))
+    assert rb.error.errcode == 0

@@ -1,0 +1,41 @@
+"""Report generator (reference src/report/ role): junitxml -> JSON + HTML."""
+
+import json
+import subprocess
+import sys
+
+
+JUNIT = """<?xml version="1.0"?>
+<testsuites>
+ <testsuite name="pytest" time="1.5">
+  <testcase classname="tests.test_a" name="test_ok" time="0.5"/>
+  <testcase classname="tests.test_a" name="test_bad" time="0.2">
+    <failure message="assert 1 == 2">trace</failure>
+  </testcase>
+  <testcase classname="tests.test_b" name="test_skip" time="0.0">
+    <skipped message="no tpu"/>
+  </testcase>
+ </testsuite>
+</testsuites>
+"""
+
+
+def test_report_generation(tmp_path):
+    junit = tmp_path / "junit.xml"
+    junit.write_text(JUNIT)
+    out = tmp_path / "out"
+    proc = subprocess.run(
+        [sys.executable, "tools/report.py", str(junit), str(out)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1  # failures present -> nonzero
+    data = json.loads((out / "report.json").read_text())
+    assert data["total"] == 3 and data["passed"] == 1
+    assert data["failed"] == 1 and data["skipped"] == 1
+    names = {s["name"] for s in data["suites"]}
+    assert names == {"tests.test_a", "tests.test_b"}
+    page = (out / "report.html").read_text()
+    assert "test_bad" in page and "assert 1 == 2" in page
+    # failing suites render auto-expanded; passing ones collapsed
+    assert "<details open>" in page
+    assert "('', '')" not in page

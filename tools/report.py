@@ -1,0 +1,133 @@
+"""Test-report generator: pytest junitxml -> JSON summary + static HTML.
+
+Reference: src/report/ (673 LoC) — Allure + static web report generators
+wired into the unit_test main (test/unit_test/main.cc:24-27). Same role
+here for the pytest suite:
+
+    python -m pytest tests/ -q --junitxml=/tmp/junit.xml
+    python tools/report.py /tmp/junit.xml out_dir/
+
+writes out_dir/report.json (machine-readable) and out_dir/report.html
+(single-file static page, suites grouped, failures expanded).
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import os
+import sys
+import xml.etree.ElementTree as ET
+from typing import Dict, List
+
+
+def parse_junit(path: str) -> Dict:
+    root = ET.parse(path).getroot()
+    suites = root.iter("testsuite") if root.tag == "testsuites" else [root]
+    out: Dict = {"suites": [], "total": 0, "passed": 0, "failed": 0,
+                 "errors": 0, "skipped": 0, "time_s": 0.0}
+    by_file: Dict[str, List[dict]] = {}
+    for suite in suites:
+        out["time_s"] += float(suite.get("time", 0))
+        for case in suite.iter("testcase"):
+            rec = {
+                "classname": case.get("classname", ""),
+                "name": case.get("name", ""),
+                "time_s": float(case.get("time", 0)),
+                "status": "passed",
+                "detail": "",
+            }
+            for tag, status in (("failure", "failed"), ("error", "errors"),
+                                ("skipped", "skipped")):
+                node = case.find(tag)
+                if node is not None:
+                    rec["status"] = (
+                        "failed" if tag == "failure"
+                        else "error" if tag == "error" else "skipped"
+                    )
+                    rec["detail"] = (node.get("message") or "")[:2000]
+                    out[status] += 1
+                    break
+            else:
+                out["passed"] += 1
+            out["total"] += 1
+            by_file.setdefault(rec["classname"] or "(no suite)", []).append(rec)
+    for name in sorted(by_file):
+        cases = by_file[name]
+        out["suites"].append({
+            "name": name,
+            "total": len(cases),
+            "passed": sum(1 for c in cases if c["status"] == "passed"),
+            "time_s": round(sum(c["time_s"] for c in cases), 3),
+            "cases": cases,
+        })
+    out["time_s"] = round(out["time_s"], 3)
+    return out
+
+
+_PAGE = """<!doctype html><html><head><meta charset="utf-8">
+<title>dingo-tpu test report</title><style>
+body{{font-family:system-ui,sans-serif;margin:2rem;max-width:70rem}}
+.ok{{color:#0a7d36}} .bad{{color:#c0182b;font-weight:600}}
+.skip{{color:#8a6d00}} table{{border-collapse:collapse;width:100%}}
+td,th{{padding:.25rem .6rem;border-bottom:1px solid #ddd;text-align:left}}
+summary{{cursor:pointer;padding:.3rem 0;font-weight:600}}
+pre{{background:#f6f6f6;padding:.6rem;overflow-x:auto}}
+</style></head><body>
+<h1>dingo-tpu test report</h1>
+<p>{total} tests &middot; <span class="ok">{passed} passed</span>
+&middot; <span class="bad">{failed} failed</span>
+&middot; {errors} errors &middot; <span class="skip">{skipped} skipped</span>
+&middot; {time_s}s</p>
+{suites}
+</body></html>"""
+
+
+def render_html(data: Dict) -> str:
+    parts = []
+    for suite in data["suites"]:
+        ok = suite["passed"] == suite["total"]
+        rows = []
+        for c in suite["cases"]:
+            cls = {"passed": "ok", "skipped": "skip"}.get(c["status"], "bad")
+            detail = (
+                f"<pre>{html.escape(c['detail'])}</pre>" if c["detail"] else ""
+            )
+            rows.append(
+                f"<tr><td>{html.escape(c['name'])}</td>"
+                f"<td class='{cls}'>{c['status']}</td>"
+                f"<td>{c['time_s']:.3f}s</td></tr>"
+                + (f"<tr><td colspan=3>{detail}</td></tr>" if detail else "")
+            )
+        parts.append(
+            f"<details{'' if ok else ' open'}>"
+            f"<summary class='{'ok' if ok else 'bad'}'>"
+            f"{html.escape(suite['name'])} — {suite['passed']}/"
+            f"{suite['total']} ({suite['time_s']}s)</summary>"
+            f"<table><tr><th>test</th><th>status</th><th>time</th></tr>"
+            + "".join(rows) + "</table></details>"
+        )
+    return _PAGE.format(suites="\n".join(parts), **{
+        k: data[k] for k in
+        ("total", "passed", "failed", "errors", "skipped", "time_s")
+    })
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 2:
+        print("usage: report.py <junit.xml> <out_dir>", file=sys.stderr)
+        return 2
+    junit, out_dir = argv
+    os.makedirs(out_dir, exist_ok=True)
+    data = parse_junit(junit)
+    with open(os.path.join(out_dir, "report.json"), "w") as f:
+        json.dump(data, f, indent=1)
+    with open(os.path.join(out_dir, "report.html"), "w") as f:
+        f.write(render_html(data))
+    print(f"{data['passed']}/{data['total']} passed -> {out_dir}/report.html")
+    return 0 if data["failed"] + data["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
