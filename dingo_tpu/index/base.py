@@ -81,6 +81,9 @@ class IndexParameter:
     nlinks: int = 32              # M
     # storage dtype for device-resident vectors
     dtype: str = "float32"
+    # keep full vectors in HOST memory (IVF_PQ/DiskANN-class indexes whose
+    # search path reads only codes; lifts the HBM cap at 10M x 768 scale)
+    host_vectors: bool = False
 
 
 @dataclasses.dataclass

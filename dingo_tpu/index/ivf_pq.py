@@ -45,7 +45,7 @@ from dingo_tpu.index.base import (
 from dingo_tpu.index.flat import _SlotStoreIndex, _flat_search_kernel, _pad_batch
 from dingo_tpu.index.ivf_flat import _probe_lists
 from dingo_tpu.index.ivf_layout import build_layout, expand_probes_ranked
-from dingo_tpu.index.slot_store import SlotStore, _next_pow2
+from dingo_tpu.index.slot_store import HostSlotStore, SlotStore, _next_pow2
 from dingo_tpu.ops.distance import Metric, normalize, pairwise_l2sqr, squared_norms
 from dingo_tpu.ops.kmeans import (
     MAX_POINTS_PER_CENTROID,
@@ -54,6 +54,49 @@ from dingo_tpu.ops.kmeans import (
 )
 from dingo_tpu.ops.pq import pq_train, split_subvectors
 from dingo_tpu.ops.topk import merge_topk
+
+
+HOST_SCAN_CHUNK = 65536
+#: rows encoded per device round during train-time (re)encode
+ENCODE_CHUNK = 131072
+
+
+def _chunked_host_scan(vecs_h, sqnorm_h, mask_h, qpad, k, metric):
+    """Exact scan streaming host chunks through the flat kernel with a
+    running top-k merge (the untrained fallback for host-resident stores;
+    slot ids stay global)."""
+    from dingo_tpu.ops.distance import metric_ascending, scores_to_distances
+
+    b = qpad.shape[0]
+    neg_inf = jnp.float32(-jnp.inf)
+    best_v = jnp.full((b, k), neg_inf)
+    best_s = jnp.full((b, k), -1, jnp.int32)
+    n = vecs_h.shape[0]
+    asc = metric_ascending(metric)
+    for i in range(0, n, HOST_SCAN_CHUNK):
+        hi = min(n, i + HOST_SCAN_CHUNK)
+        if not mask_h[i:hi].any():
+            continue
+        pad = HOST_SCAN_CHUNK - (hi - i)
+        chunk = np.asarray(vecs_h[i:hi], np.float32)
+        sq = np.asarray(sqnorm_h[i:hi], np.float32)
+        m = mask_h[i:hi]
+        if pad:
+            chunk = np.concatenate(
+                [chunk, np.zeros((pad, chunk.shape[1]), np.float32)]
+            )
+            sq = np.concatenate([sq, np.zeros(pad, np.float32)])
+            m = np.concatenate([m, np.zeros(pad, bool)])
+        d, sl = _flat_search_kernel(
+            jnp.asarray(chunk), jnp.asarray(sq), jnp.asarray(m), qpad,
+            k=k, metric=metric, nbits=0,
+        )
+        # kernel returns wire distances; merge in score space
+        vals = -d if asc else d
+        gsl = jnp.where(sl >= 0, sl + i, -1)
+        best_v, best_s = merge_topk(best_v, best_s, vals, gsl, k)
+    best_s = jnp.where(jnp.isneginf(best_v), -1, best_s)
+    return scores_to_distances(best_v, metric), best_s
 
 
 @functools.partial(jax.jit, static_argnames=())
@@ -172,7 +215,8 @@ class TpuIvfPq(_SlotStoreIndex):
             raise InvalidParameter("only nbits=8 supported (uint8 codes)")
         if p.metric is Metric.HAMMING:
             raise InvalidParameter("hamming not valid for IVF_PQ")
-        self.store = SlotStore(p.dimension, jnp.dtype(p.dtype))
+        store_cls = HostSlotStore if p.host_vectors else SlotStore
+        self.store = store_cls(p.dimension, jnp.dtype(p.dtype))
         self.nlist = p.ncentroids
         self.m = p.nsubvector
         self.ksub = 1 << p.nbits_per_idx
@@ -250,9 +294,28 @@ class TpuIvfPq(_SlotStoreIndex):
     def is_trained(self) -> bool:
         return self.codebooks is not None
 
+    def _rows_at_slots(self, slots: np.ndarray) -> np.ndarray:
+        """Host rows for the given slots (one H2D-free slice for host
+        stores; one bounded D2H gather for device stores)."""
+        if isinstance(self.store, HostSlotStore):
+            return np.asarray(self.store.vecs[slots], np.float32)
+        return np.asarray(
+            jnp.take(self.store.vecs, jnp.asarray(slots, jnp.int32), axis=0),
+            np.float32,
+        )
+
     def train(self, vectors: Optional[np.ndarray] = None) -> None:
+        cap = MAX_POINTS_PER_CENTROID * self.nlist
+        rng = np.random.default_rng(self.id)
         if vectors is None:
-            vectors = self.store.to_host()["vectors"]
+            # sample slots instead of materializing every live row (the
+            # host-vectors mode exists precisely because all rows at once
+            # do not fit anywhere fast)
+            live = np.flatnonzero(self.store.ids_by_slot >= 0)
+            sel = live if len(live) <= cap else np.sort(
+                rng.choice(live, cap, replace=False)
+            )
+            vectors = self._rows_at_slots(sel)
         vectors = np.asarray(vectors, np.float32)
         min_train = max(self.nlist, self.ksub)
         if len(vectors) < min_train:
@@ -261,12 +324,8 @@ class TpuIvfPq(_SlotStoreIndex):
             )
         if self.metric is Metric.COSINE:
             vectors = np.asarray(normalize(jnp.asarray(vectors)))
-        cap = MAX_POINTS_PER_CENTROID * self.nlist
         if len(vectors) > cap:
-            sel = np.random.default_rng(self.id).choice(
-                len(vectors), cap, replace=False
-            )
-            vectors = vectors[sel]
+            vectors = vectors[rng.choice(len(vectors), cap, replace=False)]
         dv = jnp.asarray(vectors)
         self.centroids, _ = train_kmeans(dv, k=self.nlist, iters=10, seed=self.id)
         self._c_sqnorm = squared_norms(self.centroids)
@@ -274,17 +333,20 @@ class TpuIvfPq(_SlotStoreIndex):
         resid = dv - jnp.take(self.centroids, assign, axis=0)
         self.codebooks = pq_train(resid, m=self.m, ksub=self.ksub, iters=10,
                                   seed=self.id)
-        # encode everything stored
+        # encode everything stored, CHUNKED — the working set on device is
+        # one chunk of rows, never the whole index
         self._codes = jnp.zeros((self.store.capacity, self.m), jnp.uint8)
         self._ensure_code_capacity()
         live = np.flatnonzero(self.store.ids_by_slot >= 0)
-        if len(live):
-            _, vecs = self.store.gather(self.store.ids_by_slot[live])
-            dvv = jnp.asarray(vecs)
+        for i in range(0, len(live), ENCODE_CHUNK):
+            sl = live[i:i + ENCODE_CHUNK]
+            dvv = jnp.asarray(self._rows_at_slots(sl))
+            if self.metric is Metric.COSINE:
+                dvv = normalize(dvv)
             a = kmeans_assign(dvv, self.centroids)
             codes = _encode_residual(dvv, a, self.centroids, self.codebooks)
-            self._assign_h[live] = np.asarray(a)
-            self._codes = self._codes.at[jnp.asarray(live, jnp.int32)].set(codes)
+            self._assign_h[sl] = np.asarray(a)
+            self._codes = self._codes.at[jnp.asarray(sl, jnp.int32)].set(codes)
         self._view_dirty = True
 
     # -- bucketed view -------------------------------------------------------
@@ -327,13 +389,19 @@ class TpuIvfPq(_SlotStoreIndex):
             # Hybrid contract: exact flat scan until trained
             # (vector_index_ivf_pq.h:113-115).
             if filter_spec is None or filter_spec.is_empty():
-                mask = store.device_mask()
+                mask_h = store.valid_h
             else:
-                mask = jnp.asarray(filter_spec.slot_mask(store.ids_by_slot))
-            dists, slots = _flat_search_kernel(
-                store.vecs, store.sqnorm, mask, qpad,
-                k=int(topk), metric=self.metric, nbits=0,
-            )
+                mask_h = filter_spec.slot_mask(store.ids_by_slot)                     & store.valid_h
+            if isinstance(store, HostSlotStore):
+                dists, slots = _chunked_host_scan(
+                    store.vecs, store.sqnorm, mask_h, qpad,
+                    k=int(topk), metric=self.metric,
+                )
+            else:
+                dists, slots = _flat_search_kernel(
+                    store.vecs, store.sqnorm, jnp.asarray(mask_h), qpad,
+                    k=int(topk), metric=self.metric, nbits=0,
+                )
         else:
             if self._view_dirty:
                 self._rebuild_view()
@@ -395,7 +463,10 @@ class TpuIvfPq(_SlotStoreIndex):
         if meta["nlist"] != self.nlist or meta["m"] != self.m:
             raise InvalidParameter("snapshot nlist/m mismatch")
         data = np.load(os.path.join(path, "ivf_pq.npz"))
-        self.store = SlotStore(self.dimension, jnp.dtype(self.parameter.dtype),
+        store_cls = (
+            HostSlotStore if self.parameter.host_vectors else SlotStore
+        )
+        self.store = store_cls(self.dimension, jnp.dtype(self.parameter.dtype),
                                max(len(data["ids"]), 1))
         self._assign_h = np.full((self.store.capacity,), -1, np.int32)
         self._codes = None

@@ -73,8 +73,7 @@ class SlotStore:
         self.dim = dim
         self.dtype = dtype
         self.capacity = max(MIN_CAPACITY, _next_pow2(capacity))
-        self.vecs = jnp.zeros((self.capacity, dim), dtype)
-        self.sqnorm = jnp.zeros((self.capacity,), jnp.float32)
+        self.vecs, self.sqnorm = self._alloc_storage(self.capacity)
         self.ids_by_slot = np.full((self.capacity,), -1, np.int64)
         self.valid_h = np.zeros((self.capacity,), np.bool_)
         self._dmask: Optional[jax.Array] = None   # lazy device copy of valid_h
@@ -85,6 +84,21 @@ class SlotStore:
         # (it translates them to -1/dropped instead of to the wrong id).
         self._inflight: int = 0
         self._limbo: list[int] = []
+
+    # -- storage hooks (HostSlotStore overrides with numpy) ----------------
+    def _alloc_storage(self, capacity: int):
+        return (
+            jnp.zeros((capacity, self.dim), self.dtype),
+            jnp.zeros((capacity,), jnp.float32),
+        )
+
+    def _grow_storage(self, pad: int):
+        return (
+            jnp.concatenate(
+                [self.vecs, jnp.zeros((pad, self.dim), self.dtype)]
+            ),
+            jnp.concatenate([self.sqnorm, jnp.zeros((pad,), jnp.float32)]),
+        )
 
     # -- bookkeeping -------------------------------------------------------
     def __len__(self) -> int:
@@ -215,10 +229,7 @@ class SlotStore:
     def _grow(self, new_capacity: int) -> None:
         new_capacity = _next_pow2(new_capacity)
         pad = new_capacity - self.capacity
-        self.vecs = jnp.concatenate(
-            [self.vecs, jnp.zeros((pad, self.dim), self.dtype)]
-        )
-        self.sqnorm = jnp.concatenate([self.sqnorm, jnp.zeros((pad,), jnp.float32)])
+        self.vecs, self.sqnorm = self._grow_storage(pad)
         self.ids_by_slot = np.concatenate(
             [self.ids_by_slot, np.full((pad,), -1, np.int64)]
         )
@@ -277,3 +288,48 @@ class SearchLease:
 
 def _next_pow2(n: int) -> int:
     return 1 << max(0, (int(n) - 1)).bit_length()
+
+
+class HostSlotStore(SlotStore):
+    """SlotStore variant keeping the vectors in HOST memory.
+
+    For indexes whose SEARCH path never reads full vectors from the device
+    (IVF_PQ serves from codes; DiskANN from disk), device-resident vectors
+    only cap the index size at HBM: 10M x 768 f32 is ~30 GB, far beyond a
+    v5e chip. This store keeps [capacity, d] in numpy; training/encoding
+    stream chunks to the device, and the untrained exact fallback scans
+    host chunks with a running top-k merge.
+    """
+
+    def _np_dtype(self):
+        return np.dtype(jnp.zeros((), self.dtype).dtype.name)
+
+    def _alloc_storage(self, capacity: int):
+        return (
+            np.zeros((capacity, self.dim), self._np_dtype()),
+            np.zeros((capacity,), np.float32),
+        )
+
+    def _grow_storage(self, pad: int):
+        return (
+            np.concatenate(
+                [self.vecs, np.zeros((pad, self.dim), self.vecs.dtype)]
+            ),
+            np.concatenate([self.sqnorm, np.zeros((pad,), np.float32)]),
+        )
+
+    def _write_segment(self, start: int, rows: np.ndarray) -> None:
+        n = rows.shape[0]
+        rows32 = rows.astype(np.float32)
+        self.vecs[start:start + n] = rows.astype(self.vecs.dtype)
+        self.sqnorm[start:start + n] = (rows32 * rows32).sum(1)
+
+    def gather(self, ids: np.ndarray):
+        slots = self.slots_of(ids)
+        found = slots >= 0
+        safe = np.where(found, slots, 0)
+        return found, self.vecs[safe]
+
+    def memory_size(self) -> int:
+        # host bytes; device footprint is the caller's codes/centroids
+        return int(self.vecs.nbytes + self.sqnorm.nbytes)
