@@ -75,6 +75,10 @@ FLAGS.define("gc_retention_ms", 3_600_000, mutable=True)
 FLAGS.define("use_pallas_fused_search", False, mutable=True,
              help_="route flat L2/IP searches through the fused Pallas "
                    "streaming kernel (no [b,n] HBM materialization)")
+FLAGS.define("ivfpq_rerank_factor", 8, mutable=True,
+             help_="host-vectors IVF_PQ reranks topk*factor ADC candidates "
+                   "exactly from host rows (1 disables); same prune+rerank "
+                   "recipe as the diskann role")
 FLAGS.define("wal_checkpoint_bytes", 64 * 1024 * 1024, mutable=True,
              help_="WalEngine folds the WAL into a checkpoint once it "
                    "exceeds this size, bounding restart replay time")

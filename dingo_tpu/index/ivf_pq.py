@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dingo_tpu.common.config import FLAGS
 from dingo_tpu.index.base import (
     FilterSpec,
     IndexParameter,
@@ -97,6 +98,46 @@ def _chunked_host_scan(vecs_h, sqnorm_h, mask_h, qpad, k, metric):
         best_v, best_s = merge_topk(best_v, best_s, vals, gsl, k)
     best_s = jnp.where(jnp.isneginf(best_v), -1, best_s)
     return scores_to_distances(best_v, metric), best_s
+
+
+def _exact_rerank_host(store, queries, cand_slots, k, metric):
+    """Exact rerank of ADC candidates from a host-resident store:
+    one host gather + one device einsum (prune+rerank, diskann/core.py
+    recipe). Returns (wire distances [b, k], slots [b, k])."""
+    from dingo_tpu.ops.distance import scores_to_distances
+
+    b, kprime = cand_slots.shape
+    safe = np.where(cand_slots >= 0, cand_slots, 0)
+    flat_idx = safe.reshape(-1)
+    rows = np.asarray(store.vecs[flat_idx], np.float32).reshape(
+        b, kprime, -1
+    )
+    dc = jnp.asarray(rows)
+    qd = jnp.asarray(queries, jnp.float32)
+    dots = jnp.einsum(
+        "bd,bkd->bk", qd, dc,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    if metric is Metric.L2:
+        # candidate norms come from the store's cache, gathered host-side
+        # in the same fancy-index as the rows
+        c_sq = jnp.asarray(store.sqnorm[flat_idx].reshape(b, kprime))
+        scores = -(squared_norms(qd)[:, None] - 2.0 * dots + c_sq)
+    else:
+        scores = dots
+    scores = jnp.where(jnp.asarray(cand_slots) >= 0, scores,
+                       jnp.float32(-jnp.inf))
+    vals, pos = jax.lax.top_k(scores, min(k, kprime))
+    slots_out = jnp.take_along_axis(jnp.asarray(cand_slots), pos, axis=1)
+    slots_out = jnp.where(jnp.isneginf(vals), -1, slots_out)
+    if min(k, kprime) < k:
+        pad = k - min(k, kprime)
+        vals = jnp.pad(vals, ((0, 0), (0, pad)),
+                       constant_values=float("-inf"))
+        slots_out = jnp.pad(slots_out, ((0, 0), (0, pad)),
+                            constant_values=-1)
+    return scores_to_distances(vals, metric), slots_out
 
 
 @functools.partial(jax.jit, static_argnames=())
@@ -385,56 +426,96 @@ class TpuIvfPq(_SlotStoreIndex):
         b = queries.shape[0]
         qpad = jnp.asarray(_pad_batch(queries))
         store = self.store
-        if not self.is_trained():
-            # Hybrid contract: exact flat scan until trained
-            # (vector_index_ivf_pq.h:113-115).
-            if filter_spec is None or filter_spec.is_empty():
-                mask_h = store.valid_h
-            else:
-                mask_h = filter_spec.slot_mask(store.ids_by_slot)                     & store.valid_h
-            if isinstance(store, HostSlotStore):
-                dists, slots = _chunked_host_scan(
-                    store.vecs, store.sqnorm, mask_h, qpad,
-                    k=int(topk), metric=self.metric,
-                )
-            else:
-                dists, slots = _flat_search_kernel(
-                    store.vecs, store.sqnorm, jnp.asarray(mask_h), qpad,
-                    k=int(topk), metric=self.metric, nbits=0,
-                )
-        else:
-            if self._view_dirty:
-                self._rebuild_view()
-            nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
-            lay = self._layout
-            probes = _probe_lists(qpad, self.centroids, self._c_sqnorm, nprobe)
-            vprobes, coarse_pos = expand_probes_ranked(
-                probes, lay.probe_table, nprobe, lay.max_spill
-            )
-            valid = self._bucket_valid_for_filter(filter_spec)
-            # share one residual LUT across a list's spill buckets when the
-            # [b, nprobe, m, ksub] table fits comfortably in HBM
-            lut_bytes = qpad.shape[0] * nprobe * self.m * self.ksub * 4
-            dists, slots = _ivfpq_scan_kernel(
-                self._code_buckets,
-                valid,
-                lay.bucket_slot,
-                lay.bucket_coarse,
-                probes,
-                vprobes,
-                coarse_pos,
-                qpad,
-                self.centroids,
-                self.codebooks,
-                k=int(topk),
-                precompute_lut=lut_bytes <= 256 * 1024 * 1024,
-            )
+        # lease BEFORE any kernel dispatch: slots produced by the kernel
+        # must stay stable (limbo-parked, not reassigned) until resolve
+        # translates and, in rerank mode, gathers host rows for them
         lease = store.begin_search()
+        try:
+            rerank = False
+            if not self.is_trained():
+                # Hybrid contract: exact flat scan until trained
+                # (vector_index_ivf_pq.h:113-115).
+                filtered = (
+                    filter_spec is not None and not filter_spec.is_empty()
+                )
+                if isinstance(store, HostSlotStore):
+                    mask_h = (
+                        filter_spec.slot_mask(store.ids_by_slot) if filtered
+                        else store.valid_h
+                    )
+                    dists, slots = _chunked_host_scan(
+                        store.vecs, store.sqnorm, mask_h, qpad,
+                        k=int(topk), metric=self.metric,
+                    )
+                else:
+                    mask = (
+                        jnp.asarray(filter_spec.slot_mask(store.ids_by_slot))
+                        if filtered else store.device_mask()
+                    )
+                    dists, slots = _flat_search_kernel(
+                        store.vecs, store.sqnorm, mask, qpad,
+                        k=int(topk), metric=self.metric, nbits=0,
+                    )
+            else:
+                if self._view_dirty:
+                    self._rebuild_view()
+                nprobe = min(
+                    nprobe or self.parameter.default_nprobe, self.nlist
+                )
+                lay = self._layout
+                probes = _probe_lists(
+                    qpad, self.centroids, self._c_sqnorm, nprobe
+                )
+                vprobes, coarse_pos = expand_probes_ranked(
+                    probes, lay.probe_table, nprobe, lay.max_spill
+                )
+                valid = self._bucket_valid_for_filter(filter_spec)
+                # share one residual LUT across a list's spill buckets when
+                # the [b, nprobe, m, ksub] table fits comfortably in HBM
+                lut_bytes = qpad.shape[0] * nprobe * self.m * self.ksub * 4
+                rerank = (
+                    isinstance(store, HostSlotStore)
+                    and FLAGS.get("ivfpq_rerank_factor") > 1
+                )
+                kprime = (
+                    min(len(store),
+                        int(topk) * FLAGS.get("ivfpq_rerank_factor"))
+                    if rerank else int(topk)
+                )
+                dists, slots = _ivfpq_scan_kernel(
+                    self._code_buckets,
+                    valid,
+                    lay.bucket_slot,
+                    lay.bucket_coarse,
+                    probes,
+                    vprobes,
+                    coarse_pos,
+                    qpad,
+                    self.centroids,
+                    self.codebooks,
+                    k=max(int(topk), kprime),
+                    precompute_lut=lut_bytes <= 256 * 1024 * 1024,
+                )
+        except Exception:
+            lease.release()
+            raise
         dists.copy_to_host_async()
         slots.copy_to_host_async()
+
         def resolve() -> List[SearchResult]:
             try:
-                dists_h, slots_h = jax.device_get((dists, slots))
+                if rerank:
+                    # ADC was a prune; the exact rows sit in host memory
+                    # (host_vectors mode), so rerank at RESOLVE time — the
+                    # dispatch above stays non-blocking and the device keeps
+                    # pipelining (diskann/core.py prune+rerank recipe)
+                    cand = np.asarray(jax.device_get(slots))[:b]
+                    d_r, s_r = _exact_rerank_host(
+                        store, qpad[:b], cand, int(topk), self.metric
+                    )
+                    dists_h, slots_h = jax.device_get((d_r, s_r))
+                else:
+                    dists_h, slots_h = jax.device_get((dists, slots))
                 ids = store.ids_of_slots(slots_h[:b])
                 return [strip_invalid(i, d) for i, d in zip(ids, dists_h[:b])]
             finally:

@@ -55,6 +55,7 @@ def index_parameter_to_pb(p: Optional[IndexParameter]) -> pb.VectorIndexParamete
     out.default_nprobe = p.default_nprobe
     out.efconstruction = p.efconstruction
     out.nlinks = p.nlinks
+    out.host_vectors = p.host_vectors
     return out
 
 
@@ -71,6 +72,7 @@ def index_parameter_from_pb(m: pb.VectorIndexParameter) -> Optional[IndexParamet
         default_nprobe=m.default_nprobe or 80,
         efconstruction=m.efconstruction or 200,
         nlinks=m.nlinks or 32,
+        host_vectors=m.host_vectors,
     )
 
 
