@@ -122,13 +122,25 @@ class _SlotStoreIndex(VectorIndex):
         queries = self._prep_queries(queries)
         b = queries.shape[0]
         qpad = jnp.asarray(_pad_batch(queries))
-        if filter_spec is None or filter_spec.is_empty():
-            mask = self.store.device_mask()
-        else:
-            mask = jnp.asarray(filter_spec.slot_mask(self.store.ids_by_slot))
-        dists, slots = self._run_search_kernel(qpad, mask, int(topk))
         store = self.store
+        # lease BEFORE dispatch: kernel-produced slots must stay limbo-
+        # parked (not reassigned) until resolve translates them
         lease = store.begin_search()
+        try:
+            with store.device_lock:
+                # mask capture AND dispatch under the device lock: a
+                # concurrent donated write or growth would invalidate the
+                # vecs reference / change the capacity mid-dispatch
+                if filter_spec is None or filter_spec.is_empty():
+                    mask = store.device_mask()
+                else:
+                    mask = jnp.asarray(
+                        filter_spec.slot_mask(store.ids_by_slot)
+                    )
+                dists, slots = self._run_search_kernel(qpad, mask, int(topk))
+        except Exception:
+            lease.release()
+            raise
         # Start the D2H copy as soon as the kernel finishes: the tunnel's
         # fetch RTT then overlaps across in-flight searches instead of
         # serializing at resolve time.

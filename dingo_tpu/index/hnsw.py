@@ -199,17 +199,22 @@ class TpuHnsw(_SlotStoreIndex):
             pad_rows = np.zeros((bb - b, ef), slots.dtype)
             slots = np.concatenate([slots, pad_rows])
             valid = np.concatenate([valid, np.zeros((bb - b, ef), bool)])
-        dists, out_slots = _rerank_kernel(
-            self.store.vecs,
-            self.store.sqnorm,
-            qpad,
-            jnp.asarray(np.where(slots >= 0, slots, 0), jnp.int32),
-            jnp.asarray(valid),
-            k=int(topk),
-            ascending=self.metric is Metric.L2,
-        )
         store = self.store
-        lease = store.begin_search()
+        lease = store.begin_search()   # slots stable until resolve
+        try:
+            with store.device_lock:    # vecs/sqnorm are donatable
+                dists, out_slots = _rerank_kernel(
+                    store.vecs,
+                    store.sqnorm,
+                    qpad,
+                    jnp.asarray(np.where(slots >= 0, slots, 0), jnp.int32),
+                    jnp.asarray(valid),
+                    k=int(topk),
+                    ascending=self.metric is Metric.L2,
+                )
+        except Exception:
+            lease.release()
+            raise
         dists.copy_to_host_async()
         out_slots.copy_to_host_async()
         def resolve() -> List[SearchResult]:

@@ -263,10 +263,11 @@ class TpuIvfFlat(_SlotStoreIndex):
         """Group live slots into fixed-width spill buckets (ivf_layout.py)."""
         lay = build_layout(self._assign_h, self.store.valid_h, self.nlist)
         self._layout = lay
-        self._buckets = lay.gather_rows(self.store.vecs)
-        self._bucket_sqnorm = jnp.take(self.store.sqnorm, lay.gather_idx).reshape(
-            lay.nbuckets, lay.cap_list
-        )
+        with self.store.device_lock:   # gather reads store.vecs (donatable)
+            self._buckets = lay.gather_rows(self.store.vecs)
+            self._bucket_sqnorm = jnp.take(
+                self.store.sqnorm, lay.gather_idx
+            ).reshape(lay.nbuckets, lay.cap_list)
         self._view_dirty = False
 
     def _bucket_valid_for_filter(self, filter_spec: Optional[FilterSpec]):
@@ -304,41 +305,51 @@ class TpuIvfFlat(_SlotStoreIndex):
         nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
         qpad = jnp.asarray(_pad_batch(queries))
         lay = self._layout
-        probes = _probe_lists(qpad, self.centroids, self._c_sqnorm, nprobe)
-        vprobes = expand_probes(probes, lay.probe_table, nprobe, lay.max_spill)
-        valid = self._bucket_valid_for_filter(filter_spec)
-        from dingo_tpu.common.config import FLAGS
-
-        if (
-            FLAGS.get("use_pallas_ivf_search")
-            and self.metric in (Metric.L2, Metric.INNER_PRODUCT, Metric.COSINE)
-            and self.store.vecs.dtype == jnp.float32
-            # kernel keeps top-k in a 128-lane output block; larger k (and
-            # its unrolled select rounds) stays on the XLA path
-            and int(topk) <= 64
-        ):
-            from dingo_tpu.ops.distance import metric_ascending
-            from dingo_tpu.ops.pallas_ivf import ivf_list_search
-
-            vals, slots = ivf_list_search(
-                vprobes, qpad, self._buckets, self._bucket_sqnorm,
-                valid, lay.bucket_slot, k=int(topk),
-                ascending=metric_ascending(self._scan_metric),
+        # lease BEFORE dispatch: kernel slots must stay limbo-parked until
+        # resolve translates them (delete+reinsert would misattribute)
+        lease = self.store.begin_search()
+        try:
+            probes = _probe_lists(qpad, self.centroids, self._c_sqnorm, nprobe)
+            vprobes = expand_probes(
+                probes, lay.probe_table, nprobe, lay.max_spill
             )
-            dists = scores_to_distances(vals, self._scan_metric)
-        else:
-            dists, slots = _ivf_scan_kernel(
-                self._buckets,
-                self._bucket_sqnorm,
-                valid,
-                lay.bucket_slot,
-                vprobes,
-                qpad,
-                k=int(topk),
-                metric=self._scan_metric,
-            )
+            valid = self._bucket_valid_for_filter(filter_spec)
+            from dingo_tpu.common.config import FLAGS
+
+            if (
+                FLAGS.get("use_pallas_ivf_search")
+                and self.metric in (
+                    Metric.L2, Metric.INNER_PRODUCT, Metric.COSINE
+                )
+                and self.store.vecs.dtype == jnp.float32
+                # kernel keeps top-k in a 128-lane output block; larger k
+                # (and its unrolled select rounds) stays on the XLA path
+                and int(topk) <= 64
+            ):
+                from dingo_tpu.ops.distance import metric_ascending
+                from dingo_tpu.ops.pallas_ivf import ivf_list_search
+
+                vals, slots = ivf_list_search(
+                    vprobes, qpad, self._buckets, self._bucket_sqnorm,
+                    valid, lay.bucket_slot, k=int(topk),
+                    ascending=metric_ascending(self._scan_metric),
+                )
+                dists = scores_to_distances(vals, self._scan_metric)
+            else:
+                dists, slots = _ivf_scan_kernel(
+                    self._buckets,
+                    self._bucket_sqnorm,
+                    valid,
+                    lay.bucket_slot,
+                    vprobes,
+                    qpad,
+                    k=int(topk),
+                    metric=self._scan_metric,
+                )
+        except Exception:
+            lease.release()
+            raise
         store = self.store
-        lease = store.begin_search()
         dists.copy_to_host_async()
         slots.copy_to_host_async()
         def resolve() -> List[SearchResult]:

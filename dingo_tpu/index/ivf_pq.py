@@ -340,10 +340,12 @@ class TpuIvfPq(_SlotStoreIndex):
         stores; one bounded D2H gather for device stores)."""
         if isinstance(self.store, HostSlotStore):
             return np.asarray(self.store.vecs[slots], np.float32)
-        return np.asarray(
-            jnp.take(self.store.vecs, jnp.asarray(slots, jnp.int32), axis=0),
-            np.float32,
-        )
+        with self.store.device_lock:  # vecs reference is donatable
+            return np.asarray(
+                jnp.take(self.store.vecs, jnp.asarray(slots, jnp.int32),
+                         axis=0),
+                np.float32,
+            )
 
     def train(self, vectors: Optional[np.ndarray] = None) -> None:
         cap = MAX_POINTS_PER_CENTROID * self.nlist
@@ -452,10 +454,11 @@ class TpuIvfPq(_SlotStoreIndex):
                         jnp.asarray(filter_spec.slot_mask(store.ids_by_slot))
                         if filtered else store.device_mask()
                     )
-                    dists, slots = _flat_search_kernel(
-                        store.vecs, store.sqnorm, mask, qpad,
-                        k=int(topk), metric=self.metric, nbits=0,
-                    )
+                    with store.device_lock:
+                        dists, slots = _flat_search_kernel(
+                            store.vecs, store.sqnorm, mask, qpad,
+                            k=int(topk), metric=self.metric, nbits=0,
+                        )
             else:
                 if self._view_dirty:
                     self._rebuild_view()

@@ -26,6 +26,8 @@ save/rebuild, mirroring the reference's rebuild-on-too-many-deletes policy.
 
 from __future__ import annotations
 
+import threading
+
 import functools
 from typing import Optional, Tuple
 
@@ -84,6 +86,12 @@ class SlotStore:
         # (it translates them to -1/dropped instead of to the wrong id).
         self._inflight: int = 0
         self._limbo: list[int] = []
+        # Serializes DONATED device writes against kernel dispatch: the DUS
+        # write path donates vecs/sqnorm (invalidating the old Array), so a
+        # concurrent search must not dispatch with a stale reference (the
+        # reference uses a per-index RWLock, vector_index_flat.h:129).
+        # Held only across dispatch, never across device execution.
+        self.device_lock = threading.RLock()
 
     # -- storage hooks (HostSlotStore overrides with numpy) ----------------
     def _alloc_storage(self, capacity: int):
@@ -159,17 +167,19 @@ class SlotStore:
         sslots = slots[order]
         svecs = vectors[order]
         run_starts = np.flatnonzero(np.diff(sslots) != 1) + 1
-        for seg_lo, seg_hi in zip(
-            np.concatenate([[0], run_starts]),
-            np.concatenate([run_starts, [n]]),
-        ):
-            self._write_segment(int(sslots[seg_lo]), svecs[seg_lo:seg_hi])
+        with self.device_lock:
+            for seg_lo, seg_hi in zip(
+                np.concatenate([[0], run_starts]),
+                np.concatenate([run_starts, [n]]),
+            ):
+                self._write_segment(int(sslots[seg_lo]), svecs[seg_lo:seg_hi])
         self.valid_h[slots] = True
         self._dmask = None
         return slots
 
     def _write_segment(self, start: int, rows: np.ndarray) -> None:
-        """One contiguous run, chunked into pow2 buckets <= MAX_WRITE_BUCKET."""
+        """One contiguous run, chunked into pow2 buckets <= MAX_WRITE_BUCKET.
+        Callers arrive via put(), which holds device_lock."""
         off = 0
         total = rows.shape[0]
         while off < total:
@@ -229,7 +239,8 @@ class SlotStore:
     def _grow(self, new_capacity: int) -> None:
         new_capacity = _next_pow2(new_capacity)
         pad = new_capacity - self.capacity
-        self.vecs, self.sqnorm = self._grow_storage(pad)
+        with self.device_lock:
+            self.vecs, self.sqnorm = self._grow_storage(pad)
         self.ids_by_slot = np.concatenate(
             [self.ids_by_slot, np.full((pad,), -1, np.int64)]
         )
@@ -246,15 +257,20 @@ class SlotStore:
         slots = self.slots_of(ids)
         found = slots >= 0
         safe = np.where(found, slots, 0)
-        vecs = np.asarray(jnp.take(self.vecs, jnp.asarray(safe, jnp.int32), axis=0))
+        with self.device_lock:   # vecs reference is donatable
+            vecs = np.asarray(
+                jnp.take(self.vecs, jnp.asarray(safe, jnp.int32), axis=0)
+            )
         return found, vecs
 
     def to_host(self) -> dict:
         """Compacted host snapshot {ids, vectors} of live rows (save path)."""
         live = self.ids_by_slot >= 0
+        with self.device_lock:
+            vecs_h = np.asarray(self.vecs)
         return {
             "ids": self.ids_by_slot[live],
-            "vectors": np.asarray(self.vecs)[live],
+            "vectors": vecs_h[live],
         }
 
     @classmethod

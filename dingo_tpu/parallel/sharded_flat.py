@@ -25,6 +25,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import threading
 from typing import List, Optional
 
 import jax
@@ -88,6 +89,9 @@ class TpuShardedFlat(VectorIndex):
         self.ids_by_gslot = np.empty(0, np.int64)
         self._id_to_gslot: dict = {}
         self._free_per_shard: List[List[int]] = []
+        # serializes donated scatters/growth against search dispatch (the
+        # donated buffers invalidate the old array references)
+        self._device_lock = threading.RLock()
         self._alloc(MIN_CAP_PER_SHARD)
 
     # -- slot management -----------------------------------------------------
@@ -132,7 +136,7 @@ class TpuShardedFlat(VectorIndex):
 
             self._store.vecs = jax.jit(
                 grow2d, out_shardings=sharding2d, donate_argnums=0
-            )(self._store.vecs)
+            )(self._store.vecs)  # under _device_lock via callers
             self._store.sqnorm = jax.jit(
                 functools.partial(grow1d, fill=0.0),
                 out_shardings=sharding1d, donate_argnums=0,
@@ -185,7 +189,8 @@ class TpuShardedFlat(VectorIndex):
         while cap < need:
             cap *= 2
         if cap != self.cap_per_shard:
-            self._alloc(cap)
+            with self._device_lock:
+                self._alloc(cap)
 
     def upsert(self, ids: np.ndarray, vectors: np.ndarray) -> None:
         vectors = self._prep(vectors)
@@ -206,7 +211,8 @@ class TpuShardedFlat(VectorIndex):
             cap = self.cap_per_shard
             while cap < need:
                 cap *= 2
-            self._alloc(cap)
+            with self._device_lock:
+                self._alloc(cap)
         slots = np.empty(len(ids), np.int64)
         for i, vid in enumerate(ids):
             vid = int(vid)
@@ -217,13 +223,14 @@ class TpuShardedFlat(VectorIndex):
                 self.ids_by_gslot[s] = vid
             slots[i] = s
         row_sq = (vectors.astype(np.float64) ** 2).sum(1).astype(np.float32)
-        self._store.vecs, self._store.sqnorm, self._store.valid = (
-            _scatter_rows(
-                self._store.vecs, self._store.sqnorm, self._store.valid,
-                jnp.asarray(slots, jnp.int32), jnp.asarray(vectors),
-                jnp.asarray(row_sq), jnp.ones(len(ids), bool),
+        with self._device_lock:
+            self._store.vecs, self._store.sqnorm, self._store.valid = (
+                _scatter_rows(
+                    self._store.vecs, self._store.sqnorm, self._store.valid,
+                    jnp.asarray(slots, jnp.int32), jnp.asarray(vectors),
+                    jnp.asarray(row_sq), jnp.ones(len(ids), bool),
+                )
             )
-        )
         self.write_count_since_save += len(ids)
 
     def add(self, ids: np.ndarray, vectors: np.ndarray) -> None:
@@ -249,13 +256,15 @@ class TpuShardedFlat(VectorIndex):
         if doomed:
             slots = jnp.asarray(np.asarray(doomed, np.int64), jnp.int32)
             zrows = jnp.zeros((len(doomed), self.dimension), jnp.float32)
-            self._store.vecs, self._store.sqnorm, self._store.valid = (
-                _scatter_rows(
-                    self._store.vecs, self._store.sqnorm, self._store.valid,
-                    slots, zrows, jnp.zeros(len(doomed), jnp.float32),
-                    jnp.zeros(len(doomed), bool),
+            with self._device_lock:
+                self._store.vecs, self._store.sqnorm, self._store.valid = (
+                    _scatter_rows(
+                        self._store.vecs, self._store.sqnorm,
+                        self._store.valid,
+                        slots, zrows, jnp.zeros(len(doomed), jnp.float32),
+                        jnp.zeros(len(doomed), bool),
+                    )
                 )
-            )
             self.write_count_since_save += len(doomed)
         return len(doomed)
 
@@ -266,23 +275,27 @@ class TpuShardedFlat(VectorIndex):
     def search_async(self, queries, topk, filter_spec: Optional[FilterSpec] = None,
                      **kw):
         queries = self._prep(np.atleast_2d(np.asarray(queries, np.float32)))
-        if filter_spec is None or filter_spec.is_empty():
-            valid = self._store.valid
-        else:
-            mask = filter_spec.slot_mask(self.ids_by_gslot)
-            valid = jax.device_put(
-                jnp.asarray(mask) & self._store.valid,
-                NamedSharding(self.mesh, P("data")),
-            )
         q = jax.device_put(
             jnp.asarray(queries), NamedSharding(self.mesh, P(None, "dim"))
         )
-        vals, gslots = self._store._search_jit(
-            self._store.vecs, self._store.sqnorm, valid, q, int(topk)
-        )
+        with self._device_lock:
+            # capture valid/vecs AND the gslot translation table inside the
+            # lock: a concurrent donated scatter invalidates the arrays and
+            # a growth remaps the gslot space
+            if filter_spec is None or filter_spec.is_empty():
+                valid = self._store.valid
+            else:
+                mask = filter_spec.slot_mask(self.ids_by_gslot)
+                valid = jax.device_put(
+                    jnp.asarray(mask) & self._store.valid,
+                    NamedSharding(self.mesh, P("data")),
+                )
+            vals, gslots = self._store._search_jit(
+                self._store.vecs, self._store.sqnorm, valid, q, int(topk)
+            )
+            ids_by_gslot = self.ids_by_gslot.copy()
         vals.copy_to_host_async()
         gslots.copy_to_host_async()
-        ids_by_gslot = self.ids_by_gslot.copy()
         ascending = self.metric is Metric.L2
 
         def resolve() -> List[SearchResult]:
