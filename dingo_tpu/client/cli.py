@@ -29,6 +29,10 @@ _ITYPES = {
     "ivf_flat": pb.VECTOR_INDEX_TYPE_IVF_FLAT,
     "ivf_pq": pb.VECTOR_INDEX_TYPE_IVF_PQ,
     "hnsw": pb.VECTOR_INDEX_TYPE_HNSW,
+    "binary_flat": pb.VECTOR_INDEX_TYPE_BINARY_FLAT,
+    "binary_ivf_flat": pb.VECTOR_INDEX_TYPE_BINARY_IVF_FLAT,
+    "bruteforce": pb.VECTOR_INDEX_TYPE_BRUTEFORCE,
+    "diskann": pb.VECTOR_INDEX_TYPE_DISKANN,
 }
 
 
@@ -89,6 +93,48 @@ def build_parser() -> argparse.ArgumentParser:
     node = sub.add_parser("node").add_subparsers(dest="cmd")
     ninfo = node.add_parser("info")
     ninfo.add_argument("--store", dest="target_store", required=True)
+
+    meta = sub.add_parser("meta").add_subparsers(dest="cmd")
+    meta.add_parser("schemas")
+    cs = meta.add_parser("create-schema")
+    cs.add_argument("name")
+    ct = meta.add_parser("create-table")
+    ct.add_argument("--schema", default="dingo")
+    ct.add_argument("name")
+    ct.add_argument("--type", choices=sorted(_ITYPES), default="flat")
+    ct.add_argument("--dim", type=int, required=True)
+    ct.add_argument("--partitions", type=int, default=1)
+    ct.add_argument("--rows-per-partition", type=int, default=1 << 30)
+    ct.add_argument("--partition-base", type=int, default=0,
+                    help="first partition id (default: after the highest "
+                         "in use, so tables never collide)")
+    lt = meta.add_parser("tables")
+    lt.add_argument("--schema", default="dingo")
+    gt = meta.add_parser("table")
+    gt.add_argument("--schema", default="dingo")
+    gt.add_argument("name")
+    dt = meta.add_parser("drop-table")
+    dt.add_argument("--schema", default="dingo")
+    dt.add_argument("name")
+
+    cluster = sub.add_parser("cluster").add_subparsers(dest="cmd")
+    cluster.add_parser("stat")
+    jobs = cluster.add_parser("jobs")
+    jobs.add_argument("--include-done", action="store_true")
+    detail = cluster.add_parser("region-detail")
+    detail.add_argument("--store", dest="target_store", required=True)
+    detail.add_argument("--region", type=int, required=True)
+    rbi = cluster.add_parser("rebuild-index")
+    rbi.add_argument("--store", dest="target_store", required=True)
+    rbi.add_argument("--region", type=int, required=True)
+    snap = cluster.add_parser("snapshot-index")
+    snap.add_argument("--store", dest="target_store", required=True)
+    snap.add_argument("--region", type=int, required=True)
+
+    sdbg = sub.add_parser("search-debug")
+    sdbg.add_argument("--partition", type=int, default=0)
+    sdbg.add_argument("--dim", type=int, required=True)
+    sdbg.add_argument("--topk", type=int, default=5)
 
     sub.add_parser("repl")
     return p
@@ -157,6 +203,132 @@ def run_command(client: DingoClient, args) -> int:
             "store_id": r.store_id,
             "regions": list(r.region_ids),
             "leader_regions": list(r.leader_region_ids),
+        }))
+    elif g == "meta" and c == "schemas":
+        print(json.dumps(client.get_schemas()))
+    elif g == "meta" and c == "create-schema":
+        client.create_schema(args.name)
+        print("OK")
+    elif g == "meta" and c == "create-table":
+        param = pb.VectorIndexParameter(
+            index_type=_ITYPES[args.type], dimension=args.dim,
+            metric_type=(
+                pb.METRIC_TYPE_HAMMING if args.type.startswith("binary")
+                else pb.METRIC_TYPE_L2
+            ),
+        )
+        base = args.partition_base
+        if not base:
+            taken = [
+                p.partition_id
+                for schema in client.get_schemas()
+                for t in client.list_tables(schema)
+                for p in t.partitions
+            ]
+            base = max(taken, default=0) + 1
+        parts = [
+            (base + i, i * args.rows_per_partition,
+             (i + 1) * args.rows_per_partition)
+            for i in range(args.partitions)
+        ]
+        t = client.create_vector_table(args.schema, args.name, param,
+                                       partitions=parts)
+        print(json.dumps({
+            "table_id": t.table_id,
+            "regions": [p.region_id for p in t.partitions],
+        }))
+    elif g == "meta" and c == "tables":
+        for t in client.list_tables(args.schema):
+            print(json.dumps({"name": t.name, "table_id": t.table_id,
+                              "partitions": len(t.partitions)}))
+    elif g == "meta" and c == "table":
+        t = client.get_table(args.schema, args.name)
+        if t is None:
+            print("(not found)", file=sys.stderr)
+            return 1
+        print(json.dumps({
+            "name": t.name, "table_id": t.table_id,
+            "partitions": [
+                {"partition_id": p.partition_id, "id_lo": p.id_lo,
+                 "id_hi": p.id_hi, "region_id": p.region_id}
+                for p in t.partitions
+            ],
+        }))
+    elif g == "meta" and c == "drop-table":
+        client.drop_table(args.schema, args.name)
+        print("OK")
+    elif g == "cluster" and c == "stat":
+        from dingo_tpu.server.rpc import ServiceStub
+
+        stub = ServiceStub(client._coord_channel, "ClusterStatService")
+        r = stub.GetClusterStat(pb.GetClusterStatRequest())
+        print(json.dumps({
+            "stores": r.store_count, "alive": r.alive_store_count,
+            "regions": r.region_count, "pending_jobs": r.pending_job_count,
+            "per_store": [
+                {"id": st.store_id, "state": st.state,
+                 "regions": st.region_count, "leaders": st.leader_count}
+                for st in r.stores
+            ],
+        }))
+    elif g == "cluster" and c == "jobs":
+        from dingo_tpu.server.rpc import ServiceStub
+
+        stub = ServiceStub(client._coord_channel, "JobService")
+        r = stub.ListJobs(pb.ListJobsRequest(include_done=args.include_done))
+        for j in r.jobs:
+            print(json.dumps({
+                "cmd_id": j.cmd_id, "region": j.region_id,
+                "type": j.cmd_type, "status": j.status, "store": j.store_id,
+            }))
+    elif g == "cluster" and c == "region-detail":
+        stub = client._stub(args.target_store, "RegionControlService")
+        r = stub.RegionDetail(pb.RegionDetailRequest(region_id=args.region))
+        if r.error.errcode:
+            print(r.error.errmsg, file=sys.stderr)
+            return 1
+        print(json.dumps({
+            "region_id": r.definition.region_id, "state": r.state,
+            "is_leader": r.is_leader, "raft_term": r.raft_term,
+            "commit_index": r.raft_commit_index,
+            "last_applied": r.raft_last_applied,
+            "index_count": r.index_count,
+            "index_apply_log_id": r.index_apply_log_id,
+        }))
+    elif g == "cluster" and c == "rebuild-index":
+        stub = client._stub(args.target_store, "RegionControlService")
+        r = stub.RegionRebuildIndex(
+            pb.RegionRebuildIndexRequest(region_id=args.region))
+        print("OK" if r.error.errcode == 0 else r.error.errmsg)
+    elif g == "cluster" and c == "snapshot-index":
+        stub = client._stub(args.target_store, "RegionControlService")
+        r = stub.RegionSnapshot(
+            pb.RegionSnapshotRequest(region_id=args.region))
+        print(r.path if r.error.errcode == 0 else r.error.errmsg)
+    elif g == "search-debug":
+        rng = np.random.default_rng(1)
+        q = rng.standard_normal(args.dim).astype(np.float32)
+        regions = client._regions_for_vector_ids(args.partition)
+        if not regions:
+            print(f"no indexed region in partition {args.partition}",
+                  file=sys.stderr)
+            return 1
+        d = regions[0]
+        req = pb.VectorSearchDebugRequest()
+        req.context.region_id = d.region_id
+        req.vectors.add().values.extend(q.tolist())
+        req.parameter.top_n = args.topk
+        r = client._call_leader(d, "IndexService", "VectorSearchDebug", req)
+        print(json.dumps({
+            "results": [
+                [i.vector.id, round(i.distance, 4)]
+                for i in r.batch_results[0].results
+            ],
+            "stage_us": {
+                "prefilter": r.prefilter_us, "search": r.search_us,
+                "postfilter": r.postfilter_us, "backfill": r.backfill_us,
+                "total": r.total_us,
+            },
         }))
     elif g == "repl":
         return run_repl(client)

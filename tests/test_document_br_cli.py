@@ -193,3 +193,52 @@ def test_cli_end_to_end(grpc_cluster, capsys):
     from dingo_tpu.client.client import DingoClient
     from dingo_tpu.server import pb as _pb
     assert main(base + ["coordinator", "tso"]) == 0
+
+
+def test_cli_meta_cluster_groups(grpc_cluster, capsys):
+    """New CLI groups: meta (schema/table ops), cluster (stat/jobs/
+    region-detail), search-debug."""
+    from dingo_tpu.client.cli import main
+
+    base = grpc_cluster
+    assert main(base + ["meta", "schemas"]) == 0
+    assert "dingo" in json.loads(capsys.readouterr().out.strip())
+    assert main(base + ["meta", "create-schema", "cliapp"]) == 0
+    capsys.readouterr()
+    assert main(base + ["meta", "create-table", "--schema", "cliapp",
+                        "clitab", "--dim", "8"]) == 0
+    created = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert created["table_id"] > 0 and created["regions"]
+    time.sleep(1.0)
+    assert main(base + ["meta", "tables", "--schema", "cliapp"]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert any(r["name"] == "clitab" for r in rows)
+    assert main(base + ["meta", "table", "--schema", "cliapp", "clitab"]) == 0
+    t = json.loads(capsys.readouterr().out.strip())
+    region_id = t["partitions"][0]["region_id"]
+    pid = t["partitions"][0]["partition_id"]
+
+    assert main(base + ["vector", "add-random", "--dim", "8",
+                        "--count", "20", "--partition", str(pid)]) == 0
+    capsys.readouterr()
+    assert main(base + ["cluster", "stat"]) == 0
+    stat = json.loads(capsys.readouterr().out.strip())
+    assert stat["stores"] == 2 and stat["regions"] >= 1
+    assert main(base + ["cluster", "jobs", "--include-done"]) == 0
+    capsys.readouterr()
+    # region-detail on whichever store leads it
+    ok = False
+    for sid in ("s0", "s1"):
+        if main(base + ["cluster", "region-detail", "--store", sid,
+                        "--region", str(region_id)]) == 0:
+            detail = json.loads(capsys.readouterr().out.strip())
+            ok = ok or detail["region_id"] == region_id
+        else:
+            capsys.readouterr()
+    assert ok
+    assert main(base + ["search-debug", "--dim", "8",
+                        "--partition", str(pid)]) == 0
+    dbg = json.loads(capsys.readouterr().out.strip())
+    assert dbg["stage_us"]["total"] > 0
+    assert main(base + ["meta", "drop-table", "--schema", "cliapp",
+                        "clitab"]) == 0

@@ -211,3 +211,21 @@ def test_introspection_services(cluster):
     rb = rc.RegionRebuildIndex(
         pb.RegionRebuildIndexRequest(region_id=d.region_id))
     assert rb.error.errcode == 0
+
+
+def test_create_table_rejects_overlapping_ranges(cluster):
+    """Two tables must not cover the same key space: client routing matches
+    the first covering range, so overlap silently cross-writes tables."""
+    client, control, meta, nodes = cluster
+    param = pb.VectorIndexParameter(
+        index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=8,
+        metric_type=pb.METRIC_TYPE_L2,
+    )
+    client.create_vector_table("dingo", "ov1", param,
+                               partitions=[(51, 0, 1000)])
+    with pytest.raises(Exception, match="overlaps"):
+        client.create_vector_table("dingo", "ov2", param,
+                                   partitions=[(51, 500, 1500)])
+    # disjoint partition id is fine
+    client.create_vector_table("dingo", "ov3", param,
+                               partitions=[(52, 0, 1000)])
