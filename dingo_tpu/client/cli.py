@@ -105,7 +105,7 @@ def build_parser() -> argparse.ArgumentParser:
     ct.add_argument("--dim", type=int, required=True)
     ct.add_argument("--partitions", type=int, default=1)
     ct.add_argument("--rows-per-partition", type=int, default=1 << 30)
-    ct.add_argument("--partition-base", type=int, default=0,
+    ct.add_argument("--partition-base", type=int, default=None,
                     help="first partition id (default: after the highest "
                          "in use, so tables never collide)")
     lt = meta.add_parser("tables")
@@ -218,7 +218,7 @@ def run_command(client: DingoClient, args) -> int:
             ),
         )
         base = args.partition_base
-        if not base:
+        if base is None:
             taken = [
                 p.partition_id
                 for schema in client.get_schemas()

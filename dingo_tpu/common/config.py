@@ -67,6 +67,10 @@ FLAGS.define("vector_max_request_size", 32 * 1024 * 1024)
 FLAGS.define("vector_index_bruteforce_batch_count", 2048, mutable=True)
 FLAGS.define("vector_max_range_search_result_count", 1024, mutable=True)
 FLAGS.define("enable_async_vector_search", True, mutable=True)
+FLAGS.define("search_coalescing_window_ms", 0.0, mutable=True,
+             help_="merge concurrent same-shaped VectorSearch RPCs into one "
+                   "device batch within this window (0 disables); fills the "
+                   "MXU batch dimension instead of spending threads")
 FLAGS.define("server_heartbeat_interval_s", 10, mutable=True)
 FLAGS.define("raft_snapshot_threshold", 10000, mutable=True)
 FLAGS.define("region_max_size_bytes", 256 * 1024 * 1024, mutable=True)

@@ -242,6 +242,21 @@ class CoordinatorControl:
         """CreateRegionFinal (coordinator_control.h:263): allocate id, place
         peers on the least-loaded alive stores, queue CREATE commands."""
         with self._lock:
+            # Overlapping key ranges of the SAME region type would route
+            # two tables'/callers' data into one region (client routing
+            # matches the first covering range of the right type). Checked
+            # here, under the lock, so concurrent creates cannot both pass.
+            # Different types (STORE raw keys vs INDEX/DOCUMENT id windows)
+            # share the lexicographic keyspace but route independently.
+            end_eff = end_key or b"\xff" * 16
+            for other in self.regions.values():
+                if other.region_type is not region_type:
+                    continue
+                o_end = other.end_key or b"\xff" * 16
+                if start_key < o_end and other.start_key < end_eff:
+                    raise RuntimeError(
+                        f"range overlaps region {other.region_id}"
+                    )
             peers = self._place_peers(replication or self.replication)
             if not peers:
                 raise RuntimeError("no alive stores to place region")

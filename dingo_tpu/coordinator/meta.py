@@ -214,17 +214,8 @@ class MetaControl:
                     end = vcodec.encode_vector_key(p.partition_id, p.id_hi)
                 else:
                     start, end = p.start_key, p.end_key
-                # overlapping ranges would route two tables' data into one
-                # region (client routing matches the first covering range)
-                for other in list(self.control.regions.values()):
-                    o_start, o_end = other.start_key, other.end_key
-                    if start < (o_end or b"\xff" * 16) and o_start < (
-                        end or b"\xff" * 16
-                    ):
-                        raise MetaError(
-                            f"partition {p.partition_id} range overlaps "
-                            f"region {other.region_id}"
-                        )
+                # overlap rejection happens inside create_region (under
+                # the control lock, so concurrent creates cannot race it)
                 d = self.control.create_region(
                     start, end,
                     partition_id=p.partition_id,

@@ -198,7 +198,8 @@ class DingoServer:
             _register(self._server, "RaftService",
                       RaftService(node.engine.transport))
         _register(self._server, "PushService", PushService(node))
-        _register(self._server, "IndexService", IndexService(node))
+        self._index_service = IndexService(node)
+        _register(self._server, "IndexService", self._index_service)
         _register(self._server, "StoreService", StoreService(node))
         _register(self._server, "DocumentService", DocumentService(node))
         _register(self._server, "FileService", FileService(node))
@@ -242,6 +243,9 @@ class DingoServer:
         return self.port
 
     def stop(self, grace: float = 0.5) -> None:
+        svc = getattr(self, "_index_service", None)
+        if svc is not None:
+            svc.close()
         self._server.stop(grace)
 
 

@@ -12,6 +12,7 @@ codegen plugin in this image); registration uses generic method handlers.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from typing import Dict, Optional
 
@@ -60,6 +61,39 @@ class IndexService:
 
     def __init__(self, node: StoreNode):
         self.node = node
+        self._coalescer = None
+        self._coalescer_lock = threading.Lock()
+
+    def _get_coalescer(self):
+        from dingo_tpu.common.coalescer import SearchCoalescer
+        from dingo_tpu.common.config import FLAGS
+
+        window = float(FLAGS.get("search_coalescing_window_ms"))
+        with self._coalescer_lock:
+            # rebuild when the (hot-changeable) window flag moves, so
+            # operators tuning it actually change behavior
+            if self._coalescer is not None and \
+                    self._coalescer.window_s != window / 1000.0:
+                self._coalescer.stop()
+                self._coalescer = None
+            if self._coalescer is None:
+                def run(key, stacked):
+                    region_id, topn, kw_items = key
+                    region = self.node.get_region(region_id)
+                    if region is None:
+                        raise VectorIndexError(f"region {region_id} gone")
+                    return self.node.storage.vector_batch_search(
+                        region, stacked, topn, **dict(kw_items)
+                    )
+
+                self._coalescer = SearchCoalescer(run, window_ms=window)
+            return self._coalescer
+
+    def close(self) -> None:
+        with self._coalescer_lock:
+            if self._coalescer is not None:
+                self._coalescer.stop()
+                self._coalescer = None
 
     def _do_search(self, req, resp, stage_us=None):
         """Shared VectorSearch/VectorSearchDebug body: build kwargs (incl.
@@ -87,9 +121,37 @@ class IndexService:
                 from dingo_tpu.index.vector_reader import RANGE_SEARCH_CAP
 
                 topn = min(max(topn, 128), RANGE_SEARCH_CAP)
-            results = self.node.storage.vector_batch_search(
-                region, queries, topn, stage_us=stage_us, **kw
+            from dingo_tpu.common.config import FLAGS
+
+            window = FLAGS.get("search_coalescing_window_ms")
+            # coalesce only parameter-identical, filter-free searches
+            from dingo_tpu.index.vector_reader import VectorFilterMode
+
+            plain = (
+                window > 0
+                and stage_us is None
+                and req.parameter.radius <= 0
+                and not kw.get("with_vector_data")
+                and not kw.get("with_scalar_data")
+                and kw.get("filter_mode") in (None, VectorFilterMode.NONE)
+                and not kw.get("vector_ids")
+                and kw.get("scalar_filter") is None
             )
+            if plain:
+                key = (
+                    region.id, topn,
+                    tuple(sorted(
+                        (k, v) for k, v in kw.items()
+                        if isinstance(v, (int, float, str, bool, type(None)))
+                    )),
+                )
+                results = self._get_coalescer().submit(
+                    key, queries
+                ).result(timeout=30)
+            else:
+                results = self.node.storage.vector_batch_search(
+                    region, queries, topn, stage_us=stage_us, **kw
+                )
         except (VectorIndexError, ValueError) as e:
             return _err(resp, 30001, str(e)), None
         for row in results:

@@ -1,0 +1,155 @@
+"""Search request coalescing: concurrent same-shaped searches share one
+device batch (SURVEY §2.6 'batching window to fill the device')."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from dingo_tpu.common.coalescer import SearchCoalescer
+
+
+def test_coalesces_within_window():
+    calls = []
+
+    def run(key, stacked):
+        calls.append(len(stacked))
+        return [("row", key, float(q.sum())) for q in stacked]
+
+    co = SearchCoalescer(run, window_ms=20.0)
+    try:
+        with ThreadPoolExecutor(8) as pool:
+            futs = [
+                pool.submit(
+                    lambda i=i: co.submit(
+                        "k", np.full((2, 4), i, np.float32)
+                    ).result(timeout=5)
+                )
+                for i in range(8)
+            ]
+            results = [f.result() for f in futs]
+        # all 16 queries ran in very few underlying batches
+        assert sum(calls) == 16
+        assert len(calls) <= 3, calls
+        # each caller got exactly its own rows back
+        for i, rows in enumerate(results):
+            assert len(rows) == 2
+            assert all(r[2] == float(i * 4) for r in rows)
+    finally:
+        co.stop()
+
+
+def test_distinct_keys_do_not_mix():
+    seen = {}
+
+    def run(key, stacked):
+        seen.setdefault(key, 0)
+        seen[key] += len(stacked)
+        return [key] * len(stacked)
+
+    co = SearchCoalescer(run, window_ms=10.0)
+    try:
+        f1 = co.submit("a", np.zeros((3, 2), np.float32))
+        f2 = co.submit("b", np.zeros((2, 2), np.float32))
+        assert f1.result(timeout=5) == ["a"] * 3
+        assert f2.result(timeout=5) == ["b"] * 2
+        assert seen == {"a": 3, "b": 2}
+    finally:
+        co.stop()
+
+
+def test_max_batch_flushes_immediately():
+    calls = []
+
+    def run(key, stacked):
+        calls.append(len(stacked))
+        return list(range(len(stacked)))
+
+    co = SearchCoalescer(run, window_ms=10_000.0, max_batch=4)
+    try:
+        t0 = time.monotonic()
+        f = co.submit("k", np.zeros((4, 2), np.float32))
+        f.result(timeout=5)
+        assert time.monotonic() - t0 < 1.0  # no window wait at max_batch
+        assert calls == [4]
+    finally:
+        co.stop()
+
+
+def test_run_errors_propagate_to_all_waiters():
+    def run(key, stacked):
+        raise ValueError("boom")
+
+    co = SearchCoalescer(run, window_ms=5.0)
+    try:
+        f1 = co.submit("k", np.zeros((1, 2), np.float32))
+        f2 = co.submit("k", np.zeros((1, 2), np.float32))
+        for f in (f1, f2):
+            with pytest.raises(ValueError, match="boom"):
+                f.result(timeout=5)
+    finally:
+        co.stop()
+
+
+def test_service_layer_coalescing():
+    """Concurrent identical VectorSearch RPCs share one storage search."""
+    from dingo_tpu.client import DingoClient
+    from dingo_tpu.common.config import FLAGS
+    from dingo_tpu.coordinator.control import CoordinatorControl
+    from dingo_tpu.coordinator.kv_control import KvControl
+    from dingo_tpu.coordinator.tso import TsoControl
+    from dingo_tpu.engine.raw_engine import MemEngine
+    from dingo_tpu.raft import LocalTransport
+    from dingo_tpu.server import pb
+    from dingo_tpu.server.rpc import DingoServer
+    from dingo_tpu.store.node import StoreNode
+
+    me = MemEngine()
+    control = CoordinatorControl(me, replication=1)
+    cs = DingoServer()
+    cs.host_coordinator_role(control, TsoControl(me), KvControl(me))
+    cport = cs.start()
+    node = StoreNode("s0", LocalTransport(), control, raft_kw={"seed": 0})
+    srv = DingoServer()
+    srv.host_store_role(node)
+    port = srv.start()
+    node.start_heartbeat(0.1)
+    client = DingoClient(f"127.0.0.1:{cport}", {"s0": f"127.0.0.1:{port}"})
+    calls = []
+    orig = node.storage.vector_batch_search
+
+    def counting(region, queries, topn, **kw):
+        calls.append(len(queries))
+        return orig(region, queries, topn, **kw)
+
+    node.storage.vector_batch_search = counting
+    FLAGS.set("search_coalescing_window_ms", 25.0)
+    try:
+        param = pb.VectorIndexParameter(
+            index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=8,
+            metric_type=pb.METRIC_TYPE_L2,
+        )
+        client.create_index_region(0, 0, 1 << 30, param)
+        time.sleep(1.0)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((100, 8)).astype(np.float32)
+        client.vector_add(0, list(range(100)), x)
+        calls.clear()
+
+        def one_search(i):
+            res = client.vector_search(0, x[[i]], topk=3)
+            return res[0][0][0]
+
+        with ThreadPoolExecutor(8) as pool:
+            got = list(pool.map(one_search, range(8)))
+        assert got == list(range(8))          # each caller got ITS result
+        assert sum(calls) == 8
+        assert len(calls) < 8, calls          # at least some coalescing
+    finally:
+        FLAGS.set("search_coalescing_window_ms", 0.0)
+        client.close()
+        srv.stop()
+        cs.stop()
+        node.stop()
