@@ -16,17 +16,20 @@ from __future__ import annotations
 
 import json
 import os
-import pickle
 import time
 from typing import Dict, List, Optional
 
 from dingo_tpu.engine.raft_engine import region_install, region_snapshot
+from dingo_tpu.raft import wire
 from dingo_tpu.store.region import RegionDefinition
 
 
-def backup_cluster(coordinator, nodes: Dict[str, object], path: str) -> dict:
-    """Export meta + per-region data. `nodes`: store_id -> StoreNode.
-    Returns the backup manifest."""
+def backup_cluster(coordinator, nodes: Dict[str, object], path: str,
+                   meta=None, tso=None, auto_increment=None) -> dict:
+    """Export meta + per-region data. `nodes`: store_id -> StoreNode;
+    `meta`/`tso`/`auto_increment` are the optional coordinator controls
+    (schema+table definitions, timestamp watermark, id counters — the
+    reference's sdk/sql meta groups). Returns the backup manifest."""
     os.makedirs(path, exist_ok=True)
     manifest = {
         "created_ms": int(time.time() * 1000),
@@ -51,7 +54,7 @@ def backup_cluster(coordinator, nodes: Dict[str, object], path: str) -> dict:
         if node is None or region is None:
             skipped.append(region_id)
             continue
-        blob = pickle.dumps(region_snapshot(node.raw, region), protocol=4)
+        blob = wire.encode(region_snapshot(node.raw, region))
         fname = f"region_{region_id}.data"
         with open(os.path.join(path, fname), "wb") as f:
             f.write(blob)
@@ -62,31 +65,66 @@ def backup_cluster(coordinator, nodes: Dict[str, object], path: str) -> dict:
             "bytes": len(blob),
         })
     manifest["skipped_regions"] = skipped
+    # schema/table meta (the reference's sql-meta group)
+    if meta is not None:
+        from dingo_tpu.coordinator.meta import _table_to_plain
+
+        manifest["schemas"] = meta.get_schemas()
+        manifest["tables"] = [
+            _table_to_plain(t)
+            for schema in meta.get_schemas()
+            for t in meta.get_tables(schema)
+        ]
     with open(os.path.join(path, "backupmeta.json"), "w") as f:
-        json.dump(manifest, f, indent=1)
-    # coordinator meta KV (id counters etc.) travels as a pickle
+        json.dump(manifest, f, indent=1, default=_json_bytes)
+    coord_state = {"next_region_id": coordinator._next_region_id}
+    if tso is not None:
+        coord_state["tso"] = tso.current()
+    if auto_increment is not None:
+        with auto_increment._lock:
+            coord_state["auto_increment"] = {
+                str(k): v for k, v in auto_increment._counters.items()
+            }
     with open(os.path.join(path, "coordinator.meta"), "wb") as f:
-        f.write(pickle.dumps({
-            "next_region_id": coordinator._next_region_id,
-        }))
+        f.write(wire.encode(coord_state))
     return manifest
 
 
+def _json_bytes(obj):
+    if isinstance(obj, bytes):
+        return {"__hex__": obj.hex()}
+    raise TypeError(f"not serializable: {type(obj)}")
+
+
+def _unjson(obj):
+    if isinstance(obj, dict):
+        if set(obj) == {"__hex__"}:
+            return bytes.fromhex(obj["__hex__"])
+        return {k: _unjson(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unjson(v) for v in obj]
+    return obj
+
+
 def restore_cluster(coordinator, nodes: Dict[str, object], path: str,
-                    wait_s: float = 5.0) -> int:
+                    wait_s: float = 5.0, meta=None, tso=None,
+                    auto_increment=None) -> int:
     """Recreate regions through the coordinator and ingest their data on
-    every hosting store. Returns the number of regions restored."""
+    every hosting store; re-register schema/table meta with region ids
+    remapped to the recreated regions. Returns regions restored."""
     with open(os.path.join(path, "backupmeta.json")) as f:
         manifest = json.load(f)
     meta_path = os.path.join(path, "coordinator.meta")
+    saved = {}
     if os.path.exists(meta_path):
         with open(meta_path, "rb") as f:
-            saved = pickle.loads(f.read())
+            saved = wire.decode(f.read())
         # never reuse ids the backed-up cluster already handed out
         coordinator._next_region_id = max(
             coordinator._next_region_id, saved.get("next_region_id", 0)
         )
         coordinator._persist_ids()
+    region_id_map: Dict[int, int] = {}
     restored = 0
     for entry in manifest["regions"]:
         definition = _def_from_json(entry["definition"])
@@ -108,8 +146,9 @@ def restore_cluster(coordinator, nodes: Dict[str, object], path: str,
             ):
                 break
             time.sleep(0.05)
+        region_id_map[entry["region_id"]] = created.region_id
         with open(os.path.join(path, entry["data_file"]), "rb") as f:
-            state = pickle.loads(f.read())
+            state = wire.decode(f.read())
         installed = 0
         for sid in created.peers:
             node = nodes.get(sid)
@@ -127,6 +166,39 @@ def restore_cluster(coordinator, nodes: Dict[str, object], path: str,
             installed += 1
         if installed:
             restored += 1
+    # re-register schema/table meta with remapped region AND table ids
+    table_id_map: Dict[int, int] = {}
+    if meta is not None and manifest.get("tables") is not None:
+        from dingo_tpu.coordinator.meta import MetaError, _table_from_plain
+
+        for name in manifest.get("schemas", []):
+            try:
+                meta.create_schema(name)
+            except MetaError:
+                pass  # built-in or already present
+        for plain in manifest["tables"]:
+            t = _table_from_plain(_unjson(plain))
+            old_table_id = t.table_id
+            for p in t.partitions:
+                p.region_id = region_id_map.get(p.region_id, p.region_id)
+            try:
+                registered = meta.import_table(t)
+            except MetaError:
+                continue  # name already present in the target cluster
+            table_id_map[old_table_id] = registered.table_id
+    if tso is not None and saved.get("tso"):
+        tso.advance_to(saved["tso"])
+    if auto_increment is not None:
+        for table_id, value in (saved.get("auto_increment") or {}).items():
+            # counters follow their table into its NEW id; counters for
+            # tables that were not restored stay out of the target cluster
+            new_id = table_id_map.get(int(table_id))
+            if new_id is None and meta is not None:
+                continue
+            auto_increment.update(
+                new_id if new_id is not None else int(table_id),
+                int(value), force=True,
+            )
     return restored
 
 

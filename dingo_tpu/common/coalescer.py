@@ -58,20 +58,35 @@ class SearchCoalescer:
         self._thread.start()
 
     # -- submission ----------------------------------------------------------
-    def submit(self, key: Any, queries: np.ndarray) -> Future:
-        """Queue queries [n, d] under key; resolves to n result rows."""
+    def submit(self, key: Any, queries: np.ndarray,
+               max_batch: int = 0) -> Future:
+        """Queue queries [n, d] under key; resolves to n result rows.
+        max_batch (0 = the coalescer default) caps the STACKED row count
+        for this key — merging must never build a batch that would trip a
+        limit each request individually respects."""
+        cap = min(self.max_batch, max_batch or self.max_batch)
         fut: Future = Future()
         flush_now = None
+        flush_first = None
         with self._lock:
             if self._stop:
                 raise RuntimeError("coalescer stopped")
             batch = self._pending.get(key)
+            if batch is not None and (
+                sum(len(q) for q in batch.queries) + len(queries) > cap
+            ):
+                # adding would exceed the cap: flush what's queued, start
+                # a fresh batch for this request
+                flush_first = self._pending.pop(key)
+                batch = None
             if batch is None:
                 batch = self._pending[key] = _PendingBatch()
             batch.queries.append(np.asarray(queries))
             batch.futures.append((fut, len(queries)))
-            if sum(len(q) for q in batch.queries) >= self.max_batch:
+            if sum(len(q) for q in batch.queries) >= cap:
                 flush_now = self._pending.pop(key)
+        if flush_first is not None:
+            self._run(key, flush_first)
         if flush_now is not None:
             self._run(key, flush_now)
         else:

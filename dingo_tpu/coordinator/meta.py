@@ -248,6 +248,26 @@ class MetaControl:
             self._put_table(t)
         return t
 
+    def import_table(self, t: TableDefinition) -> TableDefinition:
+        """Register an externally built definition (restore path): assigns
+        a fresh table id, persists the id counter and the definition under
+        the same invariants create_table maintains. Partition region ids
+        must already point at live regions."""
+        key = f"{t.schema_name}.{t.name}"
+        with self._lock:
+            if t.schema_name not in self.schemas:
+                self._put_schema(t.schema_name)
+            if key in self.tables or key in self._creating:
+                raise MetaError(f"table {key} exists")
+            t.table_id = self._next_table_id
+            self._next_table_id += 1
+            self.engine.put(CF_META, _KEY_TABLE_ID,
+                            wire.encode(self._next_table_id))
+            self.tables[key] = t
+            self.schemas[t.schema_name].append(t.name)
+            self._put_table(t)
+        return t
+
     def drop_table(self, schema_name: str, name: str) -> None:
         key = f"{schema_name}.{name}"
         with self._lock:

@@ -59,3 +59,14 @@ class TsoControl:
     def current(self) -> int:
         with self._lock:
             return compose_ts(self._physical, self._logical)
+
+    def advance_to(self, ts: int) -> None:
+        """Never hand out timestamps at or below `ts` again (restore path:
+        a restored cluster must stay ahead of every ts the backed-up
+        cluster issued)."""
+        with self._lock:
+            physical = ts >> TSO_LOGICAL_BITS
+            if physical >= self._physical:
+                self._physical = physical + 1
+                self._logical = 0
+                self._save_ahead()

@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Dict, Optional
 
 import grpc
@@ -138,6 +139,11 @@ class IndexService:
                 and kw.get("scalar_filter") is None
             )
             if plain:
+                from dingo_tpu.engine.storage import (
+                    MAX_TOPN_BATCH_PRODUCT,
+                    VECTOR_MAX_BATCH_COUNT,
+                )
+
                 key = (
                     region.id, topn,
                     tuple(sorted(
@@ -145,9 +151,22 @@ class IndexService:
                         if isinstance(v, (int, float, str, bool, type(None)))
                     )),
                 )
-                results = self._get_coalescer().submit(
-                    key, queries
-                ).result(timeout=30)
+                # a merged batch must respect the same guards each request
+                # passes alone (4096 rows; topn*rows product)
+                cap = min(
+                    VECTOR_MAX_BATCH_COUNT,
+                    MAX_TOPN_BATCH_PRODUCT // max(1, topn),
+                )
+                try:
+                    results = self._get_coalescer().submit(
+                        key, queries, max_batch=cap
+                    ).result(timeout=30)
+                except (RuntimeError, FuturesTimeoutError):
+                    # coalescer stopped mid-flight (flag hot-change) or the
+                    # batch stalled: serve this request directly
+                    results = self.node.storage.vector_batch_search(
+                        region, queries, topn, **kw
+                    )
             else:
                 results = self.node.storage.vector_batch_search(
                     region, queries, topn, stage_us=stage_us, **kw

@@ -153,3 +153,23 @@ def test_service_layer_coalescing():
         srv.stop()
         cs.stop()
         node.stop()
+
+
+def test_per_submit_cap_splits_batches():
+    """Merged batches must not exceed the per-key cap each request
+    respects alone (storage topn*batch guard)."""
+    calls = []
+
+    def run(key, stacked):
+        calls.append(len(stacked))
+        return list(range(len(stacked)))
+
+    co = SearchCoalescer(run, window_ms=50.0, max_batch=1024)
+    try:
+        f1 = co.submit("k", np.zeros((6, 2), np.float32), max_batch=8)
+        f2 = co.submit("k", np.zeros((6, 2), np.float32), max_batch=8)
+        assert len(f1.result(timeout=5)) == 6
+        assert len(f2.result(timeout=5)) == 6
+        assert all(c <= 8 for c in calls), calls
+    finally:
+        co.stop()

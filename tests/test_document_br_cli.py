@@ -242,3 +242,63 @@ def test_cli_meta_cluster_groups(grpc_cluster, capsys):
     assert dbg["stage_us"]["total"] > 0
     assert main(base + ["meta", "drop-table", "--schema", "cliapp",
                         "clitab"]) == 0
+
+
+def test_backup_restore_with_table_meta(tmp_path):
+    """Backup carries schema/table meta + TSO/auto-increment state; restore
+    remaps table partitions onto the recreated region ids (reference br
+    sdk/sql meta groups)."""
+    import numpy as np
+
+    from dingo_tpu.coordinator.auto_increment import AutoIncrementControl
+    from dingo_tpu.coordinator.meta import MetaControl, PartitionDefinition
+    from dingo_tpu.coordinator.tso import TsoControl
+    from dingo_tpu.index.base import IndexParameter, IndexType
+
+    transport = LocalTransport()
+    me = MemEngine()
+    coord = CoordinatorControl(me, replication=1)
+    meta = MetaControl(me, coord)
+    tso = TsoControl(me)
+    auto = AutoIncrementControl(me)
+    node = StoreNode("s0", transport, coord, raft_kw={"seed": 0})
+    node.start_heartbeat(0.1)
+    t = meta.create_table(
+        "dingo", "bk",
+        [PartitionDefinition(partition_id=61, id_lo=0, id_hi=1000)],
+        index_parameter=IndexParameter(index_type=IndexType.FLAT,
+                                       dimension=8),
+    )
+    time.sleep(1.0)
+    region = node.get_region(t.partitions[0].region_id)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((50, 8)).astype(np.float32)
+    node.storage.vector_add(region, np.arange(50, dtype=np.int64), x)
+    ts_before = tso.gen_ts()[0]
+    auto.update(t.table_id, 500, force=True)
+    manifest = backup_cluster(coord, {"s0": node}, str(tmp_path / "bk"),
+                              meta=meta, tso=tso, auto_increment=auto)
+    assert manifest["tables"] and manifest["schemas"]
+    node.stop()
+
+    # fresh cluster
+    me2 = MemEngine()
+    coord2 = CoordinatorControl(me2, replication=1)
+    meta2 = MetaControl(me2, coord2)
+    tso2 = TsoControl(me2)
+    auto2 = AutoIncrementControl(me2)
+    node2 = StoreNode("s0", LocalTransport(), coord2, raft_kw={"seed": 0})
+    node2.start_heartbeat(0.1)
+    n = restore_cluster(coord2, {"s0": node2}, str(tmp_path / "bk"),
+                        meta=meta2, tso=tso2, auto_increment=auto2)
+    assert n == 1
+    t2 = meta2.get_table("dingo", "bk")
+    assert t2 is not None
+    rid = t2.partitions[0].region_id
+    assert rid in coord2.regions           # remapped to the NEW region
+    region2 = node2.get_region(rid)
+    res = node2.storage.vector_batch_search(region2, x[:2], 3)
+    assert res[0][0].id == 0 and res[1][0].id == 1
+    assert tso2.gen_ts()[0] > ts_before    # watermark advanced
+    assert auto2.get(t2.table_id) == 500
+    node2.stop()
