@@ -1,0 +1,192 @@
+"""LsmRawEngine: the native C++ LSM raw-KV engine behind the RawEngine API.
+
+Plays RocksRawEngine's role (reference src/engine/rocks_raw_engine.{h,cc})
+with the original engine in native/lsm/lsm.cc: per-CF LSM trees (memtable +
+torn-tail-safe WAL + numbered immutable SSTs, tombstones, size-triggered
+flush, threshold compaction). Atomicity matches WriteBatch semantics: one
+WAL record carries the whole batch, split per CF (a batch rarely spans CFs
+on the apply path; cross-CF batches commit CF-by-CF like the Python
+WalEngine's single-lock apply).
+
+Checkpoints flush each CF then copy the immutable SST files; restore clears
+the data dirs and copies them back (RocksDB checkpoint-hardlink analog).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import struct
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from dingo_tpu.engine.raw_engine import ALL_CFS, RawEngine, WriteBatch
+from dingo_tpu.native import load_lsm
+
+_OP_PUT = 1
+_OP_DEL = 2
+
+
+def _frame(ops: List[Tuple[int, bytes, bytes]]) -> bytes:
+    out = []
+    for op, k, v in ops:
+        out.append(struct.pack("<BII", op, len(k), len(v)))
+        out.append(k)
+        if op == _OP_PUT:
+            out.append(v)
+    return b"".join(out)
+
+
+class LsmRawEngine(RawEngine):
+    def __init__(self, path: str, memtable_bytes: int = 8 << 20):
+        self.path = path
+        self._lib = load_lsm()
+        self._lock = threading.Lock()
+        self._dbs: Dict[str, int] = {}
+        os.makedirs(path, exist_ok=True)
+        for cf in ALL_CFS:
+            cf_dir = os.path.join(path, f"cf_{cf}")
+            h = self._lib.lsm_open(cf_dir.encode(), memtable_bytes)
+            if not h:
+                raise OSError(f"lsm_open failed for {cf_dir}")
+            self._dbs[cf] = h
+
+    # -- reads ---------------------------------------------------------------
+    def get(self, cf: str, key: bytes) -> Optional[bytes]:
+        out = ctypes.POINTER(ctypes.c_char)()
+        outl = ctypes.c_uint64()
+        rc = self._lib.lsm_get(
+            self._dbs[cf], key, len(key), ctypes.byref(out),
+            ctypes.byref(outl),
+        )
+        if rc != 0:
+            return None
+        try:
+            return ctypes.string_at(out, outl.value)
+        finally:
+            self._lib.lsm_free_buf(out)
+
+    def _scan(self, cf, start, end, reverse) -> List[Tuple[bytes, bytes]]:
+        has_end = end is not None
+        it = self._lib.lsm_scan(
+            self._dbs[cf], start, len(start), end or b"",
+            len(end or b""), 1 if has_end else 0, 1 if reverse else 0,
+        )
+        rows = []
+        k = ctypes.POINTER(ctypes.c_char)()
+        v = ctypes.POINTER(ctypes.c_char)()
+        kl = ctypes.c_uint64()
+        vl = ctypes.c_uint64()
+        try:
+            while self._lib.lsm_iter_next(
+                it, ctypes.byref(k), ctypes.byref(kl), ctypes.byref(v),
+                ctypes.byref(vl),
+            ) == 0:
+                rows.append((
+                    ctypes.string_at(k, kl.value),
+                    ctypes.string_at(v, vl.value),
+                ))
+        finally:
+            self._lib.lsm_iter_close(it)
+        return rows
+
+    def scan(self, cf, start=b"", end=None):
+        return self._scan(cf, start, end, reverse=False)
+
+    def scan_reverse(self, cf, start=b"", end=None):
+        return self._scan(cf, start, end, reverse=True)
+
+    def count(self, cf, start=b"", end=None) -> int:
+        has_end = end is not None
+        return int(self._lib.lsm_count(
+            self._dbs[cf], start, len(start), end or b"",
+            len(end or b""), 1 if has_end else 0,
+        ))
+
+    # -- writes --------------------------------------------------------------
+    def write(self, batch: WriteBatch) -> None:
+        per_cf: Dict[str, List[Tuple[int, bytes, bytes]]] = {}
+        for op in batch.ops:
+            kind, cf = op[0], op[1]
+            if kind == "put":
+                per_cf.setdefault(cf, []).append((_OP_PUT, op[2], op[3]))
+            elif kind == "del":
+                per_cf.setdefault(cf, []).append((_OP_DEL, op[2], b""))
+            elif kind == "delr":
+                # range delete = tombstone every covered key (per-key
+                # tombstones; the WAL record keeps the batch atomic per CF)
+                for k, _ in self._scan(cf, op[2], op[3], reverse=False):
+                    per_cf.setdefault(cf, []).append((_OP_DEL, k, b""))
+            else:
+                raise ValueError(f"unknown batch op {kind!r}")
+        with self._lock:
+            for cf, ops in per_cf.items():
+                buf = _frame(ops)
+                rc = self._lib.lsm_write(self._dbs[cf], buf, len(buf))
+                if rc != 0:
+                    raise OSError(f"lsm_write rc={rc} (cf={cf})")
+
+    def put(self, cf: str, key: bytes, value: bytes) -> None:
+        self.write(WriteBatch().put(cf, key, value))
+
+    def delete(self, cf: str, key: bytes) -> None:
+        self.write(WriteBatch().delete(cf, key))
+
+    def delete_range(self, cf: str, start: bytes, end: bytes) -> int:
+        n = self.count(cf, start, end)
+        self.write(WriteBatch().delete_range(cf, start, end))
+        return n
+
+    # -- maintenance ---------------------------------------------------------
+    def flush(self) -> None:
+        with self._lock:
+            for h in self._dbs.values():
+                self._lib.lsm_flush(h)
+
+    def compact(self) -> None:
+        with self._lock:
+            for h in self._dbs.values():
+                self._lib.lsm_compact(h)
+
+    def sst_counts(self) -> Dict[str, int]:
+        return {
+            cf: int(self._lib.lsm_sst_count(h))
+            for cf, h in self._dbs.items()
+        }
+
+    def checkpoint(self, path: str) -> None:
+        """Flush, then copy the immutable SST files (RocksDB checkpoint
+        analog used by the raft snapshot path)."""
+        self.flush()
+        os.makedirs(path, exist_ok=True)
+        for cf in ALL_CFS:
+            src = os.path.join(self.path, f"cf_{cf}")
+            dst = os.path.join(path, f"cf_{cf}")
+            os.makedirs(dst, exist_ok=True)
+            for name in os.listdir(src):
+                if name.endswith(".sst"):
+                    shutil.copy2(os.path.join(src, name),
+                                 os.path.join(dst, name))
+
+    def restore_checkpoint(self, path: str) -> None:
+        self.close()
+        for cf in ALL_CFS:
+            dst = os.path.join(self.path, f"cf_{cf}")
+            shutil.rmtree(dst, ignore_errors=True)
+            os.makedirs(dst, exist_ok=True)
+            src = os.path.join(path, f"cf_{cf}")
+            if os.path.isdir(src):
+                for name in os.listdir(src):
+                    if name.endswith(".sst"):
+                        shutil.copy2(os.path.join(src, name),
+                                     os.path.join(dst, name))
+        for cf in ALL_CFS:
+            cf_dir = os.path.join(self.path, f"cf_{cf}")
+            self._dbs[cf] = self._lib.lsm_open(cf_dir.encode(), 8 << 20)
+
+    def close(self) -> None:
+        with self._lock:
+            for h in self._dbs.values():
+                self._lib.lsm_close(h)
+            self._dbs = {}

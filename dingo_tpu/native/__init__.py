@@ -61,3 +61,41 @@ def load_hnsw() -> ctypes.CDLL:
     lib.hnsw_load.restype = c.c_void_p
     lib.hnsw_load.argtypes = [c.POINTER(c.c_uint8), c.c_int64]
     return lib
+
+
+def load_lsm() -> ctypes.CDLL:
+    lib = ctypes.CDLL(_build("libdingolsm.so", "lsm/lsm.cc"))
+    c = ctypes
+    lib.lsm_open.restype = c.c_void_p
+    lib.lsm_open.argtypes = [c.c_char_p, c.c_uint64]
+    lib.lsm_close.argtypes = [c.c_void_p]
+    lib.lsm_write.restype = c.c_int
+    lib.lsm_write.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+    lib.lsm_get.restype = c.c_int
+    lib.lsm_get.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_uint64,
+        c.POINTER(c.POINTER(c.c_char)), c.POINTER(c.c_uint64),
+    ]
+    lib.lsm_free_buf.argtypes = [c.POINTER(c.c_char)]
+    lib.lsm_scan.restype = c.c_void_p
+    lib.lsm_scan.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_uint64, c.c_char_p, c.c_uint64,
+        c.c_int, c.c_int,
+    ]
+    lib.lsm_iter_next.restype = c.c_int
+    lib.lsm_iter_next.argtypes = [
+        c.c_void_p, c.POINTER(c.POINTER(c.c_char)), c.POINTER(c.c_uint64),
+        c.POINTER(c.POINTER(c.c_char)), c.POINTER(c.c_uint64),
+    ]
+    lib.lsm_iter_close.argtypes = [c.c_void_p]
+    lib.lsm_count.restype = c.c_uint64
+    lib.lsm_count.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_uint64, c.c_char_p, c.c_uint64, c.c_int,
+    ]
+    lib.lsm_flush.restype = c.c_int
+    lib.lsm_flush.argtypes = [c.c_void_p]
+    lib.lsm_compact.restype = c.c_int
+    lib.lsm_compact.argtypes = [c.c_void_p]
+    lib.lsm_sst_count.restype = c.c_uint64
+    lib.lsm_sst_count.argtypes = [c.c_void_p]
+    return lib

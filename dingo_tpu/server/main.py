@@ -42,8 +42,23 @@ from dingo_tpu.store.node import StoreNode
 _TRANSPORT = LocalTransport()   # in-process multi-role transport
 
 
+def _make_engine(args):
+    """Raw engine per --engine/--data-dir (an explicit durable engine
+    without --data-dir is rejected in main() before reaching here)."""
+    engine = getattr(args, "engine", "wal")
+    if not args.data_dir:
+        return MemEngine()
+    if engine == "lsm":
+        from dingo_tpu.engine.lsm_engine import LsmRawEngine
+
+        return LsmRawEngine(args.data_dir)
+    if engine == "mem":
+        return MemEngine()
+    return WalEngine(args.data_dir)
+
+
 def serve_coordinator(args) -> None:
-    engine = WalEngine(args.data_dir) if args.data_dir else MemEngine()
+    engine = _make_engine(args)
     control = CoordinatorControl(engine, replication=args.replication)
     tso = TsoControl(engine)
     kv_control = KvControl(engine)
@@ -67,7 +82,7 @@ def serve_coordinator(args) -> None:
 
 
 def serve_store(args) -> None:
-    engine = WalEngine(args.data_dir) if args.data_dir else MemEngine()
+    engine = _make_engine(args)
     if args.raft_peers:
         # multi-process replication: raft RPCs ride grpc between stores
         from dingo_tpu.raft.grpc_transport import GrpcRaftTransport
@@ -187,6 +202,9 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--coordinator", default="")
     p.add_argument("--data-dir", default="")
+    p.add_argument("--engine", choices=["mem", "wal", "lsm"], default="wal",
+                   help="raw KV engine when --data-dir is set (lsm = native "
+                        "C++ LSM, the RocksRawEngine analog)")
     p.add_argument("--replication", type=int, default=3)
     p.add_argument("--config", default="")
     p.add_argument("--cluster-token", default="",
@@ -194,6 +212,11 @@ def main(argv=None) -> int:
     p.add_argument("--raft-peers", default="",
                    help="store raft endpoints: s0=host:port,s1=host:port,...")
     args = p.parse_args(argv)
+    if args.engine in ("lsm", "wal") and not args.data_dir \
+            and args.role != "diskann":
+        # a requested durable engine must not silently downgrade to memory
+        if any(a.startswith("--engine") for a in (argv or sys.argv[1:])):
+            p.error(f"--engine {args.engine} requires --data-dir")
     if args.config:
         Config.load(args.config).apply_flag_overrides(FLAGS)
     if args.role == "coordinator":

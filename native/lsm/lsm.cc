@@ -1,0 +1,416 @@
+// Native LSM raw-KV engine: memtable + WAL + sorted immutable SSTs with
+// tombstones and compaction.
+//
+// Plays RocksRawEngine's role (reference src/engine/rocks_raw_engine.{h,cc}:
+// the store's persistent KV under raft apply and MVCC) as an ORIGINAL
+// implementation — this is not a RocksDB wrapper and shares no code with it.
+// Scope matches what the dingo_tpu stack needs: atomic batch writes through
+// a torn-tail-safe WAL, sorted range scans (both directions), tombstoned
+// deletes, size-triggered flush to numbered SST files, threshold-triggered
+// full compaction, and checkpoint-by-flush (the Python side copies the
+// immutable files). SST payloads are kept resident after load (the
+// working-set assumption the rest of the stack already makes); recovery cost
+// is bounded by the WAL tail, not history.
+//
+// C ABI for ctypes (dingo_tpu/native/__init__.py builds it with g++).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kWalMagic = 0xD146157A;
+constexpr uint32_t kTombstone = 0xFFFFFFFFu;
+constexpr uint8_t kOpPut = 1;
+constexpr uint8_t kOpDelete = 2;
+
+struct Entry {
+  std::string key;
+  std::string value;
+  bool tombstone;
+};
+
+struct Sst {
+  uint64_t id = 0;
+  std::vector<Entry> entries;  // sorted by key, unique
+
+  const Entry* find(const std::string& key) const {
+    auto it = std::lower_bound(
+        entries.begin(), entries.end(), key,
+        [](const Entry& e, const std::string& k) { return e.key < k; });
+    if (it != entries.end() && it->key == key) return &*it;
+    return nullptr;
+  }
+};
+
+struct Db {
+  std::string dir;
+  uint64_t memtable_limit = 8ull << 20;
+  uint64_t memtable_bytes = 0;
+  std::map<std::string, std::optional<std::string>> memtable;
+  std::vector<std::unique_ptr<Sst>> ssts;  // oldest..newest
+  uint64_t next_sst_id = 1;
+  FILE* wal = nullptr;
+  std::recursive_mutex mu;
+  int compact_trigger = 8;
+
+  std::string wal_path() const { return dir + "/wal.log"; }
+  std::string sst_path(uint64_t id) const {
+    char buf[32];
+    snprintf(buf, sizeof(buf), "/%012llu.sst", (unsigned long long)id);
+    return dir + buf;
+  }
+};
+
+bool write_all(FILE* f, const void* p, size_t n) {
+  return fwrite(p, 1, n, f) == n;
+}
+
+// ---- framed op buffers (shared by WAL payloads and the batch ABI) --------
+// op buffer: repeated [u8 op][u32 klen][u32 vlen][key][value]
+bool apply_ops(Db* db, const char* buf, size_t len) {
+  size_t off = 0;
+  while (off < len) {
+    if (off + 9 > len) return false;
+    uint8_t op = (uint8_t)buf[off];
+    uint32_t kl, vl;
+    memcpy(&kl, buf + off + 1, 4);
+    memcpy(&vl, buf + off + 5, 4);
+    off += 9;
+    if (off + kl > len) return false;
+    std::string key(buf + off, kl);
+    off += kl;
+    std::string value;
+    if (op == kOpPut) {
+      if (off + vl > len) return false;
+      value.assign(buf + off, vl);
+      off += vl;
+    }
+    uint64_t delta = key.size() + value.size() + 48;
+    auto it = db->memtable.find(key);
+    if (it != db->memtable.end()) {
+      db->memtable_bytes -=
+          it->first.size() + (it->second ? it->second->size() : 0) + 48;
+    }
+    if (op == kOpPut) {
+      db->memtable[key] = std::move(value);
+    } else {
+      db->memtable[key] = std::nullopt;  // tombstone (may mask SST rows)
+    }
+    db->memtable_bytes += delta;
+  }
+  return true;
+}
+
+bool load_sst(Db* db, uint64_t id) {
+  std::string path = db->sst_path(id);
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return false;
+  auto sst = std::make_unique<Sst>();
+  sst->id = id;
+  for (;;) {
+    uint32_t kl, vl;
+    if (fread(&kl, 1, 4, f) != 4) break;
+    if (fread(&vl, 1, 4, f) != 4) break;
+    Entry e;
+    e.key.resize(kl);
+    if (kl && fread(&e.key[0], 1, kl, f) != kl) break;
+    e.tombstone = (vl == kTombstone);
+    if (!e.tombstone) {
+      e.value.resize(vl);
+      if (vl && fread(&e.value[0], 1, vl, f) != vl) break;
+    }
+    sst->entries.push_back(std::move(e));
+  }
+  fclose(f);
+  db->ssts.push_back(std::move(sst));
+  return true;
+}
+
+bool write_sst_file(const std::string& path,
+                    const std::vector<Entry>& entries) {
+  std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) return false;
+  for (const auto& e : entries) {
+    uint32_t kl = (uint32_t)e.key.size();
+    uint32_t vl = e.tombstone ? kTombstone : (uint32_t)e.value.size();
+    if (!write_all(f, &kl, 4) || !write_all(f, &vl, 4) ||
+        !write_all(f, e.key.data(), kl) ||
+        (!e.tombstone && !write_all(f, e.value.data(), e.value.size()))) {
+      fclose(f);
+      return false;
+    }
+  }
+  fflush(f);
+  fsync(fileno(f));
+  fclose(f);
+  return rename(tmp.c_str(), path.c_str()) == 0;
+}
+
+int flush_locked(Db* db);
+
+// full-merge compaction: newest-wins, tombstones dropped
+int compact_locked(Db* db) {
+  if (flush_locked(db) != 0) return -1;
+  std::map<std::string, Entry> merged;  // oldest applied first, newest wins
+  for (const auto& sst : db->ssts) {
+    for (const auto& e : sst->entries) merged[e.key] = e;
+  }
+  std::vector<Entry> out;
+  out.reserve(merged.size());
+  for (auto& [k, e] : merged) {
+    if (!e.tombstone) out.push_back(std::move(e));
+  }
+  uint64_t id = db->next_sst_id++;
+  if (!write_sst_file(db->sst_path(id), out)) return -1;
+  for (const auto& sst : db->ssts) unlink(db->sst_path(sst->id).c_str());
+  db->ssts.clear();
+  auto sst = std::make_unique<Sst>();
+  sst->id = id;
+  sst->entries = std::move(out);
+  db->ssts.push_back(std::move(sst));
+  return 0;
+}
+
+int flush_locked(Db* db) {
+  if (db->memtable.empty()) return 0;
+  std::vector<Entry> entries;
+  entries.reserve(db->memtable.size());
+  for (const auto& [k, v] : db->memtable) {
+    Entry e;
+    e.key = k;
+    e.tombstone = !v.has_value();
+    if (v) e.value = *v;
+    entries.push_back(std::move(e));
+  }
+  uint64_t id = db->next_sst_id++;
+  if (!write_sst_file(db->sst_path(id), entries)) return -1;
+  auto sst = std::make_unique<Sst>();
+  sst->id = id;
+  sst->entries = std::move(entries);
+  db->ssts.push_back(std::move(sst));
+  db->memtable.clear();
+  db->memtable_bytes = 0;
+  // truncate the WAL: its contents are now durable in the SST
+  if (db->wal) fclose(db->wal);
+  db->wal = fopen(db->wal_path().c_str(), "wb");
+  if ((int)db->ssts.size() >= db->compact_trigger) return compact_locked(db);
+  return db->wal ? 0 : -1;
+}
+
+int append_wal(Db* db, const char* ops, size_t len) {
+  uint32_t magic = kWalMagic, l = (uint32_t)len;
+  if (!db->wal) return -1;
+  if (!write_all(db->wal, &magic, 4) || !write_all(db->wal, &l, 4) ||
+      !write_all(db->wal, ops, len)) {
+    return -1;
+  }
+  fflush(db->wal);
+  return 0;
+}
+
+void replay_wal(Db* db) {
+  FILE* f = fopen(db->wal_path().c_str(), "rb");
+  if (!f) return;
+  long good = 0;
+  std::vector<char> buf;
+  for (;;) {
+    uint32_t magic, len;
+    if (fread(&magic, 1, 4, f) != 4) break;
+    if (magic != kWalMagic) break;
+    if (fread(&len, 1, 4, f) != 4) break;
+    buf.resize(len);
+    if (len && fread(buf.data(), 1, len, f) != len) break;
+    if (!apply_ops(db, buf.data(), len)) break;
+    good = ftell(f);
+  }
+  fclose(f);
+  // torn-tail truncation: appends after garbage would be unreachable on
+  // the next replay (same contract as the Python WalEngine)
+  struct stat st;
+  if (stat(db->wal_path().c_str(), &st) == 0 && st.st_size > good) {
+    truncate(db->wal_path().c_str(), good);
+  }
+}
+
+// merged view of a range: newest-wins across memtable + SSTs
+std::vector<std::pair<std::string, std::string>> scan_locked(
+    Db* db, const std::string& start, const std::string& end, bool has_end) {
+  std::map<std::string, std::pair<int, const Entry*>> best;  // key -> (age, e)
+  std::map<std::string, Entry> mem_entries;
+  int age = 0;
+  for (const auto& sst : db->ssts) {
+    auto it = std::lower_bound(
+        sst->entries.begin(), sst->entries.end(), start,
+        [](const Entry& e, const std::string& k) { return e.key < k; });
+    for (; it != sst->entries.end(); ++it) {
+      if (has_end && it->key >= end) break;
+      auto f = best.find(it->key);
+      if (f == best.end() || f->second.first <= age) {
+        best[it->key] = {age, &*it};
+      }
+    }
+    ++age;
+  }
+  for (auto it = db->memtable.lower_bound(start); it != db->memtable.end();
+       ++it) {
+    if (has_end && it->first >= end) break;
+    Entry e;
+    e.key = it->first;
+    e.tombstone = !it->second.has_value();
+    if (it->second) e.value = *it->second;
+    mem_entries[it->first] = std::move(e);
+    best[it->first] = {age, &mem_entries[it->first]};
+  }
+  std::vector<std::pair<std::string, std::string>> out;
+  for (auto& [k, v] : best) {
+    if (!v.second->tombstone) out.emplace_back(k, v.second->value);
+  }
+  return out;
+}
+
+struct Iter {
+  std::vector<std::pair<std::string, std::string>> rows;
+  size_t pos = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* lsm_open(const char* dir, uint64_t memtable_bytes) {
+  auto* db = new Db();
+  db->dir = dir;
+  if (memtable_bytes) db->memtable_limit = memtable_bytes;
+  mkdir(dir, 0755);
+  // load SSTs in id order
+  std::vector<uint64_t> ids;
+  if (DIR* d = opendir(dir)) {
+    while (dirent* e = readdir(d)) {
+      std::string name = e->d_name;
+      if (name.size() == 16 && name.substr(12) == ".sst") {
+        ids.push_back(strtoull(name.c_str(), nullptr, 10));
+      }
+    }
+    closedir(d);
+  }
+  std::sort(ids.begin(), ids.end());
+  for (uint64_t id : ids) {
+    load_sst(db, id);
+    db->next_sst_id = std::max(db->next_sst_id, id + 1);
+  }
+  replay_wal(db);
+  db->wal = fopen(db->wal_path().c_str(), "ab");
+  if (!db->wal) {
+    delete db;
+    return nullptr;
+  }
+  return db;
+}
+
+void lsm_close(void* h) {
+  auto* db = (Db*)h;
+  if (!db) return;
+  if (db->wal) fclose(db->wal);
+  delete db;
+}
+
+int lsm_write(void* h, const char* ops, uint64_t len) {
+  auto* db = (Db*)h;
+  std::lock_guard<std::recursive_mutex> g(db->mu);
+  if (append_wal(db, ops, len) != 0) return -1;
+  if (!apply_ops(db, ops, len)) return -2;
+  if (db->memtable_bytes >= db->memtable_limit) return flush_locked(db);
+  return 0;
+}
+
+int lsm_get(void* h, const char* k, uint64_t kl, char** out, uint64_t* outl) {
+  auto* db = (Db*)h;
+  std::lock_guard<std::recursive_mutex> g(db->mu);
+  std::string key(k, kl);
+  auto it = db->memtable.find(key);
+  if (it != db->memtable.end()) {
+    if (!it->second) return 1;  // tombstone
+    *outl = it->second->size();
+    *out = (char*)malloc(*outl);
+    memcpy(*out, it->second->data(), *outl);
+    return 0;
+  }
+  for (auto r = db->ssts.rbegin(); r != db->ssts.rend(); ++r) {
+    if (const Entry* e = (*r)->find(key)) {
+      if (e->tombstone) return 1;
+      *outl = e->value.size();
+      *out = (char*)malloc(*outl);
+      memcpy(*out, e->value.data(), *outl);
+      return 0;
+    }
+  }
+  return 1;
+}
+
+void lsm_free_buf(char* p) { free(p); }
+
+void* lsm_scan(void* h, const char* s, uint64_t sl, const char* e,
+               uint64_t el, int has_end, int reverse) {
+  auto* db = (Db*)h;
+  std::lock_guard<std::recursive_mutex> g(db->mu);
+  auto* it = new Iter();
+  it->rows = scan_locked(db, std::string(s, sl), std::string(e, el),
+                         has_end != 0);
+  if (reverse) std::reverse(it->rows.begin(), it->rows.end());
+  return it;
+}
+
+int lsm_iter_next(void* h, const char** k, uint64_t* kl, const char** v,
+                  uint64_t* vl) {
+  auto* it = (Iter*)h;
+  if (it->pos >= it->rows.size()) return 1;
+  const auto& row = it->rows[it->pos++];
+  *k = row.first.data();
+  *kl = row.first.size();
+  *v = row.second.data();
+  *vl = row.second.size();
+  return 0;
+}
+
+void lsm_iter_close(void* h) { delete (Iter*)h; }
+
+uint64_t lsm_count(void* h, const char* s, uint64_t sl, const char* e,
+                   uint64_t el, int has_end) {
+  auto* db = (Db*)h;
+  std::lock_guard<std::recursive_mutex> g(db->mu);
+  return scan_locked(db, std::string(s, sl), std::string(e, el), has_end != 0)
+      .size();
+}
+
+int lsm_flush(void* h) {
+  auto* db = (Db*)h;
+  std::lock_guard<std::recursive_mutex> g(db->mu);
+  return flush_locked(db);
+}
+
+int lsm_compact(void* h) {
+  auto* db = (Db*)h;
+  std::lock_guard<std::recursive_mutex> g(db->mu);
+  return compact_locked(db);
+}
+
+uint64_t lsm_sst_count(void* h) {
+  auto* db = (Db*)h;
+  std::lock_guard<std::recursive_mutex> g(db->mu);
+  return db->ssts.size();
+}
+
+}  // extern "C"

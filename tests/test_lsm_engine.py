@@ -1,0 +1,166 @@
+"""Native C++ LSM raw engine (native/lsm/lsm.cc via LsmRawEngine) —
+RocksRawEngine's role: durability, compaction, checkpoints (reference
+test/unit_test/engine/ suites)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dingo_tpu.engine.lsm_engine import LsmRawEngine
+from dingo_tpu.engine.raw_engine import CF_DEFAULT, WriteBatch
+
+
+@pytest.fixture()
+def eng(tmp_path):
+    e = LsmRawEngine(str(tmp_path / "db"), memtable_bytes=1 << 20)
+    yield e
+    e.close()
+
+
+def test_crud_and_scan(eng):
+    for i in range(100):
+        eng.put(CF_DEFAULT, f"k{i:03d}".encode(), f"v{i}".encode())
+    assert eng.get(CF_DEFAULT, b"k050") == b"v50"
+    assert eng.get(CF_DEFAULT, b"missing") is None
+    rows = eng.scan(CF_DEFAULT, b"k010", b"k020")
+    assert [k for k, _ in rows] == [f"k{i:03d}".encode() for i in range(10, 20)]
+    rrows = eng.scan_reverse(CF_DEFAULT, b"k010", b"k020")
+    assert rrows == rows[::-1]
+    assert eng.count(CF_DEFAULT, b"k010", b"k020") == 10
+    eng.delete(CF_DEFAULT, b"k050")
+    assert eng.get(CF_DEFAULT, b"k050") is None
+    assert eng.count(CF_DEFAULT, b"", None) == 99
+
+
+def test_batch_atomic_and_delete_range(eng):
+    b = WriteBatch()
+    for i in range(10):
+        b.put(CF_DEFAULT, f"x{i}".encode(), b"v")
+    eng.write(b)
+    assert eng.count(CF_DEFAULT, b"x", b"y") == 10
+    eng.delete_range(CF_DEFAULT, b"x2", b"x6")
+    assert [k for k, _ in eng.scan(CF_DEFAULT, b"x", b"y")] == [
+        b"x0", b"x1", b"x6", b"x7", b"x8", b"x9"
+    ]
+
+
+def test_restart_recovery(tmp_path):
+    path = str(tmp_path / "db")
+    e = LsmRawEngine(path, memtable_bytes=1 << 20)
+    for i in range(50):
+        e.put(CF_DEFAULT, f"k{i:02d}".encode(), b"v" * 10)
+    e.delete(CF_DEFAULT, b"k10")
+    e.close()
+    e2 = LsmRawEngine(path, memtable_bytes=1 << 20)
+    assert e2.get(CF_DEFAULT, b"k42") == b"v" * 10
+    assert e2.get(CF_DEFAULT, b"k10") is None
+    assert e2.count(CF_DEFAULT, b"", None) == 49
+    e2.close()
+
+
+def test_flush_tombstones_and_compaction(tmp_path):
+    path = str(tmp_path / "db")
+    e = LsmRawEngine(path, memtable_bytes=1 << 20)
+    for i in range(20):
+        e.put(CF_DEFAULT, f"k{i:02d}".encode(), b"v")
+    e.flush()
+    e.delete(CF_DEFAULT, b"k05")
+    e.flush()                      # tombstone persisted in its own SST
+    assert e.sst_counts()[CF_DEFAULT] >= 2
+    assert e.get(CF_DEFAULT, b"k05") is None
+    e.compact()                    # merge drops the dead row
+    assert e.sst_counts()[CF_DEFAULT] == 1
+    assert e.get(CF_DEFAULT, b"k05") is None
+    assert e.count(CF_DEFAULT, b"", None) == 19
+    e.close()
+    e2 = LsmRawEngine(path)
+    assert e2.get(CF_DEFAULT, b"k05") is None
+    assert e2.get(CF_DEFAULT, b"k06") == b"v"
+    e2.close()
+
+
+def test_memtable_flush_trigger(tmp_path):
+    e = LsmRawEngine(str(tmp_path / "db"), memtable_bytes=4096)
+    payload = b"x" * 256
+    for i in range(64):
+        e.put(CF_DEFAULT, f"k{i:03d}".encode(), payload)
+    assert e.sst_counts()[CF_DEFAULT] >= 1  # size trigger fired
+    for i in range(64):
+        assert e.get(CF_DEFAULT, f"k{i:03d}".encode()) == payload
+    e.close()
+
+
+def test_torn_wal_tail(tmp_path):
+    path = str(tmp_path / "db")
+    e = LsmRawEngine(path, memtable_bytes=1 << 20)
+    for i in range(10):
+        e.put(CF_DEFAULT, f"k{i}".encode(), b"v")
+    e.close()
+    wal = os.path.join(path, f"cf_{CF_DEFAULT}", "wal.log")
+    data = open(wal, "rb").read()
+    open(wal, "wb").write(data[:-5])
+    e2 = LsmRawEngine(path)
+    assert e2.get(CF_DEFAULT, b"k8") == b"v"
+    assert e2.get(CF_DEFAULT, b"k9") is None       # torn record dropped
+    e2.put(CF_DEFAULT, b"k9", b"v2")               # writable after recovery
+    e2.close()
+    e3 = LsmRawEngine(path)
+    assert e3.get(CF_DEFAULT, b"k9") == b"v2"      # survives restart #2
+    e3.close()
+
+
+def test_checkpoint_restore(tmp_path):
+    e = LsmRawEngine(str(tmp_path / "db"))
+    for i in range(30):
+        e.put(CF_DEFAULT, f"k{i:02d}".encode(), f"v{i}".encode())
+    e.checkpoint(str(tmp_path / "ckpt"))
+    e.put(CF_DEFAULT, b"k99", b"after")            # not in the checkpoint
+    e.restore_checkpoint(str(tmp_path / "ckpt"))
+    assert e.get(CF_DEFAULT, b"k15") == b"v15"
+    assert e.get(CF_DEFAULT, b"k99") is None
+    e.close()
+
+
+def test_store_node_on_lsm(tmp_path):
+    """Full store-node restart recovery on the native engine (same drive as
+    the WalEngine durability test)."""
+    import time
+
+    from dingo_tpu.coordinator.control import CoordinatorControl
+    from dingo_tpu.engine.raw_engine import MemEngine
+    from dingo_tpu.index import codec as vcodec
+    from dingo_tpu.index.base import IndexParameter, IndexType
+    from dingo_tpu.raft.transport import LocalTransport
+    from dingo_tpu.store.node import StoreNode
+    from dingo_tpu.store.region import RegionType
+
+    control = CoordinatorControl(MemEngine(), replication=1)
+    raw = LsmRawEngine(str(tmp_path / "store"), memtable_bytes=32768)
+    node = StoreNode("s0", LocalTransport(), control, raw_engine=raw,
+                     raft_kw={"seed": 0})
+    node.start_heartbeat(0.1)
+    d = control.create_region(
+        vcodec.encode_vector_key(1, 0), vcodec.encode_vector_key(1, 1 << 30),
+        partition_id=1, region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(index_type=IndexType.FLAT,
+                                       dimension=16),
+    )
+    time.sleep(1.0)
+    region = node.get_region(d.region_id)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((300, 16)).astype(np.float32)
+    node.storage.vector_add(region, np.arange(300, dtype=np.int64), x)
+    node.stop()
+    raw.close()
+
+    raw2 = LsmRawEngine(str(tmp_path / "store"), memtable_bytes=32768)
+    node2 = StoreNode("s0", LocalTransport(), None, raw_engine=raw2,
+                      raft_kw={"seed": 0})
+    assert node2.recover() == 1
+    time.sleep(0.6)
+    region2 = node2.get_region(d.region_id)
+    res = node2.storage.vector_batch_search(region2, x[:2], 3)
+    assert res[0][0].id == 0 and res[1][0].id == 1
+    node2.stop()
+    raw2.close()
