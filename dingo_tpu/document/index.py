@@ -3,8 +3,9 @@
 Reference: src/document/document_index.h wraps tantivy (tokenized text
 fields + i64/f64/bytes columns; queries are boolean text matches with
 optional column filters). This is an original implementation covering that
-surface: tokenization, postings with term frequencies, BM25 ranking,
-AND/OR boolean modes, column (scalar) filters, delete/upsert, save/load.
+surface: tokenization, positional postings with term frequencies, BM25
+ranking, AND/OR boolean modes, PHRASE queries (consecutive positions),
+column (scalar) filters, delete/upsert, save/load.
 """
 
 from __future__ import annotations
@@ -33,8 +34,8 @@ class DocumentIndex:
         self.id = index_id
         self.text_fields = list(text_fields)
         self._lock = threading.RLock()
-        #: term -> {doc_id: tf}
-        self._postings: Dict[str, Dict[int, int]] = defaultdict(dict)
+        #: term -> {doc_id: [positions]} (tf == len(positions))
+        self._postings: Dict[str, Dict[int, List[int]]] = defaultdict(dict)
         #: doc_id -> (doc dict, token_count)
         self._docs: Dict[int, Tuple[Dict[str, Any], int]] = {}
         self._total_tokens = 0
@@ -50,8 +51,8 @@ class DocumentIndex:
                 value = doc.get(field)
                 if isinstance(value, str):
                     tokens.extend(tokenize(value))
-            for tok in tokens:
-                self._postings[tok][doc_id] = self._postings[tok].get(doc_id, 0) + 1
+            for pos, tok in enumerate(tokens):
+                self._postings[tok].setdefault(doc_id, []).append(pos)
             self._docs[doc_id] = (dict(doc), len(tokens))
             self._total_tokens += len(tokens)
 
@@ -87,7 +88,8 @@ class DocumentIndex:
         mode: str = "or",
         column_filter: Optional[Dict[str, Any]] = None,
     ) -> List[Tuple[int, float]]:
-        """BM25-ranked (doc_id, score), best first. mode: 'or'|'and'."""
+        """BM25-ranked (doc_id, score), best first.
+        mode: 'or' | 'and' | 'phrase' (terms at consecutive positions)."""
         terms = tokenize(query)
         if not terms:
             return []
@@ -103,14 +105,20 @@ class DocumentIndex:
                     continue
                 idf = math.log(1 + (n_docs - len(postings) + 0.5)
                                / (len(postings) + 0.5))
-                for did, tf in postings.items():
+                for did, positions in postings.items():
+                    tf = len(positions)
                     dlen = self._docs[did][1] or 1
                     denom = tf + BM25_K1 * (
                         1 - BM25_B + BM25_B * dlen / max(avg_len, 1e-9)
                     )
                     scores[did] += idf * tf * (BM25_K1 + 1) / denom
             hits = scores.items()
-            if mode == "and":
+            if mode == "phrase":
+                hits = [
+                    (did, sc) for did, sc in scores.items()
+                    if self._phrase_match_unlocked(did, terms)
+                ]
+            elif mode == "and":
                 need = len(set(terms))
                 uniq_matched: Dict[int, set] = defaultdict(set)
                 for term in set(terms):
@@ -127,6 +135,20 @@ class DocumentIndex:
                            for k, v in column_filter.items())
                 ]
             return sorted(hits, key=lambda t: -t[1])[:topk]
+
+    def _phrase_match_unlocked(self, doc_id: int,
+                               terms: List[str]) -> bool:
+        """True when the terms occur at consecutive positions in order."""
+        lists = []
+        for term in terms:
+            positions = self._postings.get(term, {}).get(doc_id)
+            if not positions:
+                return False
+            lists.append(set(positions))
+        return any(
+            all(start + i in lists[i] for i in range(1, len(lists)))
+            for start in lists[0]
+        )
 
     def get(self, doc_id: int) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -162,6 +184,14 @@ class DocumentIndex:
         with self._lock:
             self.text_fields = meta["text_fields"]
             self.apply_log_id = meta["apply_log_id"]
-            self._postings = defaultdict(dict, state["postings"])
+            postings = state["postings"]
+            # migrate pre-positional snapshots ({doc: tf} ints): synthesize
+            # positions so BM25 keeps working; phrase matches degrade to
+            # position-0 runs until the doc is re-upserted
+            for term, docs in postings.items():
+                for did, val in list(docs.items()):
+                    if isinstance(val, int):
+                        docs[did] = list(range(val))
+            self._postings = defaultdict(dict, postings)
             self._docs = state["docs"]
             self._total_tokens = state["total_tokens"]

@@ -41,8 +41,9 @@ def _frame(ops: List[Tuple[int, bytes, bytes]]) -> bytes:
 class LsmRawEngine(RawEngine):
     def __init__(self, path: str, memtable_bytes: int = 8 << 20):
         self.path = path
+        self.memtable_bytes = memtable_bytes
         self._lib = load_lsm()
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self._dbs: Dict[str, int] = {}
         os.makedirs(path, exist_ok=True)
         for cf in ALL_CFS:
@@ -106,21 +107,25 @@ class LsmRawEngine(RawEngine):
 
     # -- writes --------------------------------------------------------------
     def write(self, batch: WriteBatch) -> None:
-        per_cf: Dict[str, List[Tuple[int, bytes, bytes]]] = {}
-        for op in batch.ops:
-            kind, cf = op[0], op[1]
-            if kind == "put":
-                per_cf.setdefault(cf, []).append((_OP_PUT, op[2], op[3]))
-            elif kind == "del":
-                per_cf.setdefault(cf, []).append((_OP_DEL, op[2], b""))
-            elif kind == "delr":
-                # range delete = tombstone every covered key (per-key
-                # tombstones; the WAL record keeps the batch atomic per CF)
-                for k, _ in self._scan(cf, op[2], op[3], reverse=False):
-                    per_cf.setdefault(cf, []).append((_OP_DEL, k, b""))
-            else:
-                raise ValueError(f"unknown batch op {kind!r}")
+        # the whole batch — including range-delete expansion scans — runs
+        # under the engine lock so a concurrent put cannot slip between
+        # the expansion scan and the tombstone write
         with self._lock:
+            per_cf: Dict[str, List[Tuple[int, bytes, bytes]]] = {}
+            for op in batch.ops:
+                kind, cf = op[0], op[1]
+                if kind == "put":
+                    per_cf.setdefault(cf, []).append((_OP_PUT, op[2], op[3]))
+                elif kind == "del":
+                    per_cf.setdefault(cf, []).append((_OP_DEL, op[2], b""))
+                elif kind == "delr":
+                    # range delete = tombstone every covered key (per-key
+                    # tombstones; one WAL record keeps the batch atomic
+                    # per CF)
+                    for k, _ in self._scan(cf, op[2], op[3], reverse=False):
+                        per_cf.setdefault(cf, []).append((_OP_DEL, k, b""))
+                else:
+                    raise ValueError(f"unknown batch op {kind!r}")
             for cf, ops in per_cf.items():
                 buf = _frame(ops)
                 rc = self._lib.lsm_write(self._dbs[cf], buf, len(buf))
@@ -134,9 +139,14 @@ class LsmRawEngine(RawEngine):
         self.write(WriteBatch().delete(cf, key))
 
     def delete_range(self, cf: str, start: bytes, end: bytes) -> int:
-        n = self.count(cf, start, end)
-        self.write(WriteBatch().delete_range(cf, start, end))
-        return n
+        with self._lock:
+            keys = [k for k, _ in self._scan(cf, start, end, reverse=False)]
+            if keys:
+                buf = _frame([(_OP_DEL, k, b"") for k in keys])
+                rc = self._lib.lsm_write(self._dbs[cf], buf, len(buf))
+                if rc != 0:
+                    raise OSError(f"lsm_write rc={rc} (cf={cf})")
+            return len(keys)
 
     # -- maintenance ---------------------------------------------------------
     def flush(self) -> None:
@@ -158,16 +168,20 @@ class LsmRawEngine(RawEngine):
     def checkpoint(self, path: str) -> None:
         """Flush, then copy the immutable SST files (RocksDB checkpoint
         analog used by the raft snapshot path)."""
-        self.flush()
         os.makedirs(path, exist_ok=True)
-        for cf in ALL_CFS:
-            src = os.path.join(self.path, f"cf_{cf}")
-            dst = os.path.join(path, f"cf_{cf}")
-            os.makedirs(dst, exist_ok=True)
-            for name in os.listdir(src):
-                if name.endswith(".sst"):
-                    shutil.copy2(os.path.join(src, name),
-                                 os.path.join(dst, name))
+        with self._lock:
+            # flush + copy under the lock: a concurrent flush/compaction
+            # would unlink the SST files mid-copy
+            for h in self._dbs.values():
+                self._lib.lsm_flush(h)
+            for cf in ALL_CFS:
+                src = os.path.join(self.path, f"cf_{cf}")
+                dst = os.path.join(path, f"cf_{cf}")
+                os.makedirs(dst, exist_ok=True)
+                for name in os.listdir(src):
+                    if name.endswith(".sst"):
+                        shutil.copy2(os.path.join(src, name),
+                                     os.path.join(dst, name))
 
     def restore_checkpoint(self, path: str) -> None:
         self.close()
@@ -183,7 +197,10 @@ class LsmRawEngine(RawEngine):
                                      os.path.join(dst, name))
         for cf in ALL_CFS:
             cf_dir = os.path.join(self.path, f"cf_{cf}")
-            self._dbs[cf] = self._lib.lsm_open(cf_dir.encode(), 8 << 20)
+            h = self._lib.lsm_open(cf_dir.encode(), self.memtable_bytes)
+            if not h:
+                raise OSError(f"lsm_open failed for {cf_dir}")
+            self._dbs[cf] = h
 
     def close(self) -> None:
         with self._lock:

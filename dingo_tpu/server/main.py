@@ -202,9 +202,9 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--coordinator", default="")
     p.add_argument("--data-dir", default="")
-    p.add_argument("--engine", choices=["mem", "wal", "lsm"], default="wal",
-                   help="raw KV engine when --data-dir is set (lsm = native "
-                        "C++ LSM, the RocksRawEngine analog)")
+    p.add_argument("--engine", choices=["mem", "wal", "lsm"], default=None,
+                   help="raw KV engine when --data-dir is set (default wal; "
+                        "lsm = native C++ LSM, the RocksRawEngine analog)")
     p.add_argument("--replication", type=int, default=3)
     p.add_argument("--config", default="")
     p.add_argument("--cluster-token", default="",
@@ -214,9 +214,11 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.engine in ("lsm", "wal") and not args.data_dir \
             and args.role != "diskann":
-        # a requested durable engine must not silently downgrade to memory
-        if any(a.startswith("--engine") for a in (argv or sys.argv[1:])):
-            p.error(f"--engine {args.engine} requires --data-dir")
+        # an explicitly requested durable engine must not silently
+        # downgrade to memory (None = flag not passed, default applies)
+        p.error(f"--engine {args.engine} requires --data-dir")
+    if args.engine is None:
+        args.engine = "wal"
     if args.config:
         Config.load(args.config).apply_flag_overrides(FLAGS)
     if args.role == "coordinator":

@@ -302,3 +302,31 @@ def test_backup_restore_with_table_meta(tmp_path):
     assert tso2.gen_ts()[0] > ts_before    # watermark advanced
     assert auto2.get(t2.table_id) == 500
     node2.stop()
+
+
+def test_document_phrase_queries():
+    """Phrase mode: terms must appear consecutively (tantivy phrase-query
+    parity over the positional postings)."""
+    from dingo_tpu.document.index import DocumentIndex
+
+    idx = DocumentIndex(1)
+    idx.add(1, {"text": "distributed vector search on tpu"})
+    idx.add(2, {"text": "search for distributed systems with vector math"})
+    idx.add(3, {"text": "vector search is fast"})
+    # both docs contain the words; only 1 and 3 contain the phrase
+    hits = idx.search("vector search", mode="phrase")
+    assert sorted(d for d, _ in hits) == [1, 3]
+    assert idx.search("search vector", mode="phrase") == []
+    # OR mode still matches all three
+    assert len(idx.search("vector search", mode="or")) == 3
+    # delete updates positional postings
+    idx.delete([3])
+    assert sorted(d for d, _ in idx.search("vector search", mode="phrase")) == [1]
+    # save/load keeps positions
+    import tempfile
+
+    d = tempfile.mkdtemp()
+    idx.save(d)
+    idx2 = DocumentIndex(1)
+    idx2.load(d)
+    assert sorted(x for x, _ in idx2.search("vector search", mode="phrase")) == [1]
