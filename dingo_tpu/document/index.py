@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 _TOKEN_RE = re.compile(r"[a-z0-9]+")
 
+FIELD_POSITION_GAP = 1_000_000
 BM25_K1 = 1.2
 BM25_B = 0.75
 
@@ -46,15 +47,21 @@ class DocumentIndex:
         with self._lock:
             if doc_id in self._docs:
                 self._remove_unlocked(doc_id)
-            tokens: List[str] = []
+            ntok = 0
+            pos = 0
             for field in self.text_fields:
                 value = doc.get(field)
-                if isinstance(value, str):
-                    tokens.extend(tokenize(value))
-            for pos, tok in enumerate(tokens):
-                self._postings[tok].setdefault(doc_id, []).append(pos)
-            self._docs[doc_id] = (dict(doc), len(tokens))
-            self._total_tokens += len(tokens)
+                if not isinstance(value, str):
+                    continue
+                for tok in tokenize(value):
+                    self._postings[tok].setdefault(doc_id, []).append(pos)
+                    pos += 1
+                    ntok += 1
+                # position gap between fields so a phrase cannot match
+                # across a field boundary (tantivy parity)
+                pos += FIELD_POSITION_GAP
+            self._docs[doc_id] = (dict(doc), ntok)
+            self._total_tokens += ntok
 
     upsert = add
 

@@ -184,7 +184,13 @@ class LsmRawEngine(RawEngine):
                                      os.path.join(dst, name))
 
     def restore_checkpoint(self, path: str) -> None:
-        self.close()
+        with self._lock:
+            self._restore_checkpoint_locked(path)
+
+    def _restore_checkpoint_locked(self, path: str) -> None:
+        for h in self._dbs.values():
+            self._lib.lsm_close(h)
+        self._dbs = {}
         for cf in ALL_CFS:
             dst = os.path.join(self.path, f"cf_{cf}")
             shutil.rmtree(dst, ignore_errors=True)

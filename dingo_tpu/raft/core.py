@@ -78,6 +78,11 @@ class RaftNode:
         self.match_index: Dict[str, int] = {}
         self._rng = random.Random(seed if seed is not None else hash(node_id))
         self._deadline = time.monotonic() + self._rand_timeout()
+        #: last time we heard from a live leader — drives pre-vote
+        #: stickiness; must NOT be conflated with _deadline, which the
+        #: node's own candidacy resets (that conflation livelocked
+        #: failover: survivors mutually refused pre-votes)
+        self._last_leader_contact = 0.0
         self._stop = threading.Event()
         self._appliers_busy = False
 
@@ -183,7 +188,67 @@ class RaftNode:
                     self._stop.wait(0.01)
 
     # ------------- election -------------
-    def _start_election(self) -> None:
+    def _pre_vote(self) -> bool:
+        """Pre-vote phase (braft parity): probe a majority's willingness to
+        vote for term+1 WITHOUT bumping our term. A partitioned node that
+        keeps timing out cannot inflate its term and depose a healthy
+        leader on rejoin; peers with a live leader refuse."""
+        with self._lock:
+            proposed = self.current_term + 1
+            last_idx = self.log.last_index()
+            last_term = self.log.last_term()
+            # reset the deadline so we do not spin pre-votes back to back
+            self._deadline = time.monotonic() + self._rand_timeout()
+        granted = 1
+        for peer in self.peers:
+            resp = self.transport.send(peer, "pre_vote", {
+                "from": self.id, "term": proposed,
+                "last_log_index": last_idx, "last_log_term": last_term,
+            })
+            if resp is None:
+                continue
+            if resp["term"] > proposed - 1:
+                # a peer is ahead: adopt its term so we can participate in
+                # the real election instead of probing a stale term forever
+                self._step_down(resp["term"])
+                return False
+            if resp.get("granted"):
+                granted += 1
+        quorum = (len(self.peers) + 1) // 2 + 1
+        ok = granted >= quorum
+        if not ok:
+            # retry sooner than a full election timeout: pre-vote probes
+            # disturb nobody, and a refused round usually means peers'
+            # deadlines have not expired yet
+            with self._lock:
+                self._deadline = time.monotonic() + 0.5 * self._rand_timeout()
+        return ok
+
+    def _on_pre_vote(self, msg: dict) -> dict:
+        with self._lock:
+            # refuse while we believe a leader is alive: if WE are the
+            # leader that is trivially true (a leader's own deadline is not
+            # refreshed, so the time check below would wrongly lapse), and
+            # for followers the deadline tracks recent leader contact —
+            # leader stickiness is the whole point of pre-vote
+            leader_alive = self.role == LEADER or (
+                self.leader_id is not None
+                and time.monotonic() - self._last_leader_contact
+                < self.election_timeout[1]
+            )
+            up_to_date = (
+                msg["last_log_term"], msg["last_log_index"]
+            ) >= (self.log.last_term(), self.log.last_index())
+            granted = (
+                not leader_alive
+                and msg["term"] > self.current_term
+                and up_to_date
+            )
+            return {"term": self.current_term, "granted": granted}
+
+    def _start_election(self, skip_pre_vote: bool = False) -> None:
+        if not skip_pre_vote and self.peers and not self._pre_vote():
+            return
         with self._lock:
             self.role = CANDIDATE
             self.current_term += 1
@@ -342,10 +407,16 @@ class RaftNode:
     def _handle_rpc(self, method: str, msg: dict) -> dict:
         if method == "request_vote":
             return self._on_request_vote(msg)
+        if method == "pre_vote":
+            return self._on_pre_vote(msg)
         if method == "timeout_now":
-            # leadership transfer: start an election immediately (braft
-            # TransferLeadership analog)
-            threading.Thread(target=self._start_election, daemon=True).start()
+            # leadership transfer: start an election immediately, skipping
+            # pre-vote (the current leader explicitly asked us to take
+            # over; braft TransferLeadership analog)
+            threading.Thread(
+                target=self._start_election, kwargs={"skip_pre_vote": True},
+                daemon=True,
+            ).start()
             return {"term": self.current_term, "ok": True}
         if method == "append_entries":
             return self._on_append_entries(msg)
@@ -389,6 +460,7 @@ class RaftNode:
                 self.leader_id = msg["from"]
                 cb = self.on_start_following
             self._deadline = time.monotonic() + self._rand_timeout()
+            self._last_leader_contact = time.monotonic()
             prev_index, prev_term = msg["prev_index"], msg["prev_term"]
             my_prev_term = self.log.term_at(prev_index)
             if my_prev_term is None or my_prev_term != prev_term:
@@ -428,6 +500,7 @@ class RaftNode:
             self.role = FOLLOWER
             self.leader_id = msg["from"]
             self._deadline = time.monotonic() + self._rand_timeout()
+            self._last_leader_contact = time.monotonic()
             if msg["snap_index"] <= self.log.snapshot_index:
                 return {"term": self.current_term, "ok": True}
         with self._apply_mutex:  # no concurrent apply during state install

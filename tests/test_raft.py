@@ -192,3 +192,44 @@ def test_get_data_entries_respects_bounds(tmp_path):
     got = log.get_data_entries(1, 5)
     assert [i for i, _, _ in got] == [3, 4, 5]
     assert log.get_data_entries(1, 1) == []
+
+
+def test_pre_vote_prevents_term_inflation():
+    """A partitioned node that keeps timing out must NOT inflate its term
+    (pre-vote, braft parity): on rejoin the stable leader keeps leading at
+    its original term instead of being deposed by a big term number."""
+    transport, nodes, _ = make_cluster()
+    try:
+        leader = wait_leader(nodes)
+        term_before = leader.current_term
+        victim = next(n for n in nodes.values() if n is not leader)
+        for other in nodes:
+            if other != victim.id:
+                transport.partition(victim.id, other)
+        time.sleep(1.5)   # many election timeouts pass
+        assert victim.current_term <= term_before + 1  # no runaway terms
+        # heal; the old leader must still lead at (about) its old term
+        transport.heal()
+        time.sleep(1.0)
+        assert leader.is_leader()
+        assert leader.current_term <= term_before + 1
+    finally:
+        stop_all(nodes)
+
+
+def test_pre_vote_failover_latency():
+    """Review repro: survivors must not mutually refuse pre-votes after a
+    leader failure (the leader-contact timestamp, not the self-reset
+    deadline, drives stickiness) — failover completes promptly."""
+    transport, nodes, _ = make_cluster()
+    try:
+        leader = wait_leader(nodes)
+        for other in nodes:
+            if other != leader.id:
+                transport.partition(leader.id, other)
+        survivors = {k: v for k, v in nodes.items() if k != leader.id}
+        t0 = time.monotonic()
+        wait_leader(survivors, timeout=3.0)
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        stop_all(nodes)
