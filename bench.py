@@ -61,6 +61,9 @@ def ensure_backend(timeout_s: int = 0) -> str:
 
 def main():
     platform = ensure_backend()
+    from dingo_tpu.common.config import enable_compile_cache
+
+    enable_compile_cache(log)
     # BASELINE.md row 2 (1M x 768, nlist=1024, batch=64) on the chip; the
     # CPU fallback keeps the round-1 200K budget so the line still lands.
     big = platform == "tpu"

@@ -173,3 +173,21 @@ def _flatten(obj: Dict, prefix: str = "") -> Dict[str, Any]:
         else:
             out[key] = v
     return out
+
+
+def enable_compile_cache(log_fn=None) -> None:
+    """Persistent XLA compilation cache (DINGO_COMPILE_CACHE overrides the
+    default ~/.dingo-xla-cache): first compile on the chip is 20-40s per
+    program, and bench/smoke re-run every round."""
+    import jax
+
+    cache_dir = os.environ.get(
+        "DINGO_COMPILE_CACHE", os.path.expanduser("~/.dingo-xla-cache")
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # noqa: BLE001
+        if log_fn:
+            log_fn(f"compile cache unavailable: {e}")

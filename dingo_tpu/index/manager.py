@@ -177,11 +177,13 @@ class VectorIndexManager:
         return path
 
     def load_index(self, region: Region,
-                   raft_log: Optional[RaftLog] = None) -> bool:
-        """LoadOrBuild: try snapshot + WAL replay; False -> caller rebuilds."""
+                   raft_log: Optional[RaftLog] = None,
+                   path: Optional[str] = None) -> bool:
+        """LoadOrBuild: try snapshot + WAL replay; False -> caller rebuilds.
+        `path` overrides the default snapshot location (VectorLoad RPC)."""
         wrapper = region.vector_index_wrapper
         assert wrapper is not None
-        path = self.snapshot_path(region.id)
+        path = path or self.snapshot_path(region.id)
         if not os.path.isdir(path):
             return False
         index = new_index(region.id, region.definition.index_parameter)

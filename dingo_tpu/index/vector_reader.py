@@ -253,10 +253,16 @@ class VectorReader:
 
     def vector_get_border_id(self, get_min: bool) -> Optional[int]:
         """Min/max visible vector id in the region (VectorGetBorderId)."""
+        mn, mx = self.vector_border_ids()
+        return mn if get_min else mx
+
+    def vector_border_ids(self):
+        """(min_id, max_id) in ONE visibility scan ((None, None) when
+        empty) — metrics endpoints poll this, so don't scan twice."""
         ids = self._visible_ids()
         if not ids:
-            return None
-        return min(ids) if get_min else max(ids)
+            return None, None
+        return min(ids), max(ids)
 
     def vector_scan_query(
         self,

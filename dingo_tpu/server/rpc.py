@@ -38,6 +38,18 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "VectorGetBorderId": (pb.VectorGetBorderIdRequest, pb.VectorGetBorderIdResponse),
         "VectorScanQuery": (pb.VectorScanQueryRequest, pb.VectorScanQueryResponse),
         "VectorCount": (pb.VectorCountRequest, pb.VectorCountResponse),
+        "VectorBuild": (pb.VectorBuildRequest, pb.VectorBuildResponse),
+        "VectorLoad": (pb.VectorLoadRequest, pb.VectorLoadResponse),
+        "VectorStatus": (pb.VectorStatusRequest, pb.VectorStatusResponse),
+        "VectorReset": (pb.VectorResetRequest, pb.VectorResetResponse),
+        "VectorDump": (pb.VectorDumpRequest, pb.VectorDumpResponse),
+        "VectorCountMemory": (
+            pb.VectorCountMemoryRequest, pb.VectorCountMemoryResponse,
+        ),
+        "VectorGetRegionMetrics": (
+            pb.VectorGetRegionMetricsRequest,
+            pb.VectorGetRegionMetricsResponse,
+        ),
     },
     "StoreService": {
         "KvGet": (pb.KvGetRequest, pb.KvGetResponse),

@@ -298,6 +298,137 @@ class IndexService:
                 convert.scalar_to_pb(out.scalar_data, row.scalar)
         return resp
 
+    def VectorBuild(self, req: pb.VectorBuildRequest):
+        """Trigger a full rebuild (LaunchRebuildVectorIndex analog)."""
+        resp = pb.VectorBuildResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        if region.vector_index_wrapper is None:
+            return _err(resp, 70001, "region has no vector index")
+        try:
+            self.node.index_manager.rebuild(region)
+        except Exception as e:  # noqa: BLE001
+            return _err(resp, 70002, f"rebuild failed: {e}")
+        return resp
+
+    def VectorLoad(self, req: pb.VectorLoadRequest):
+        """Load the index from its snapshot (+ WAL catch-up)."""
+        resp = pb.VectorLoadResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        if region.vector_index_wrapper is None:
+            return _err(resp, 70001, "region has no vector index")
+        try:
+            raft = self.node.engine.get_node(region.id)
+            ok = self.node.index_manager.load_index(
+                region, raft_log=raft.log if raft else None,
+                path=req.path or None,
+            )
+        except (OSError, ValueError, VectorIndexError) as e:
+            return _err(resp, 70003, f"load failed: {e}")
+        if not ok:
+            return _err(resp, 70003,
+                        "snapshot missing or unreadable (nothing loaded)")
+        return resp
+
+    def VectorStatus(self, req: pb.VectorStatusRequest):
+        resp = pb.VectorStatusResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        w = region.vector_index_wrapper
+        if w is None:
+            return _err(resp, 70001, "region has no vector index")
+        resp.ready = w.ready
+        resp.build_error = w.build_error
+        resp.is_switching = w.is_switching
+        resp.apply_log_id = w.apply_log_id
+        resp.snapshot_log_id = w.snapshot_log_id
+        idx = w.own_index
+        if idx is not None:
+            resp.count = idx.get_count()
+            resp.trained = idx.is_trained()
+            resp.index_type = idx.index_type.value
+        return resp
+
+    def VectorReset(self, req: pb.VectorResetRequest):
+        """Drop the in-memory index and rebuild from the engine (the
+        engine is the source of truth; the index is a view)."""
+        resp = pb.VectorResetResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        w = region.vector_index_wrapper
+        if w is None:
+            return _err(resp, 70001, "region has no vector index")
+        try:
+            # rebuild() swaps atomically under the wrapper lock — the old
+            # index keeps serving (and absorbing raft applies) until the
+            # fresh one is ready; never pre-mark not-ready here
+            self.node.index_manager.rebuild(region)
+        except Exception as e:  # noqa: BLE001
+            return _err(resp, 70002, f"reset rebuild failed: {e}")
+        return resp
+
+    def VectorDump(self, req: pb.VectorDumpRequest):
+        resp = pb.VectorDumpResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        w = region.vector_index_wrapper
+        if w is None:
+            return _err(resp, 70001, "region has no vector index")
+        idx = w.own_index
+        dump = {
+            "region_id": region.id,
+            "ready": w.ready,
+            "apply_log_id": w.apply_log_id,
+            "snapshot_log_id": w.snapshot_log_id,
+            "write_count_since_save": getattr(
+                idx, "write_count_since_save", 0
+            ) if idx else 0,
+        }
+        if idx is not None:
+            dump.update(
+                index_type=idx.index_type.value,
+                count=idx.get_count(),
+                memory_bytes=idx.get_memory_size(),
+                trained=idx.is_trained(),
+            )
+        resp.json = json.dumps(dump)
+        return resp
+
+    def VectorCountMemory(self, req: pb.VectorCountMemoryRequest):
+        resp = pb.VectorCountMemoryResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        w = region.vector_index_wrapper
+        idx = w.own_index if w else None
+        if idx is None:
+            return _err(resp, 70001, "region has no vector index")
+        resp.bytes = idx.get_memory_size()
+        return resp
+
+    def VectorGetRegionMetrics(self, req: pb.VectorGetRegionMetricsRequest):
+        resp = pb.VectorGetRegionMetricsResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        w = region.vector_index_wrapper
+        idx = w.own_index if w else None
+        if idx is not None:
+            resp.vector_count = idx.get_count()
+            resp.memory_bytes = idx.get_memory_size()
+        reader = self.node.engine.new_vector_reader(region)
+        mn, mx = reader.vector_border_ids()   # one region scan, both ends
+        resp.min_id = mn if mn is not None else -1
+        resp.max_id = mx if mx is not None else -1
+        resp.region_state = region.state.value
+        return resp
+
     def VectorCount(self, req: pb.VectorCountRequest):
         resp = pb.VectorCountResponse()
         region = _region_or_err(self.node, req.context, resp)

@@ -2,6 +2,7 @@
 the full reference topology (client -> brpc -> services -> storage) in one
 process over real sockets."""
 
+import json
 import time
 
 import numpy as np
@@ -297,3 +298,47 @@ def test_vector_search_debug_stage_timings(cluster):
         resp.prefilter_us + resp.search_us + resp.postfilter_us
         + resp.backfill_us
     )
+
+
+def test_index_lifecycle_rpcs(cluster):
+    """VectorBuild/Status/Reset/Dump/CountMemory/GetRegionMetrics
+    (index_service.h lifecycle set)."""
+    client, control, nodes = cluster
+    param = pb.VectorIndexParameter(
+        index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=16,
+        metric_type=pb.METRIC_TYPE_L2,
+    )
+    d = client.create_index_region(13, 0, 1 << 30, param)
+    time.sleep(1.0)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((40, 16)).astype(np.float32)
+    client.vector_add(13, list(range(40)), x)
+
+    def leader_call(method, req):
+        req.context.region_id = d.region_id
+        return client._call_leader(d, "IndexService", method, req)
+
+    st = leader_call("VectorStatus", pb.VectorStatusRequest())
+    assert st.error.errcode == 0 and st.ready and st.count == 40
+    assert st.index_type == "flat" and st.apply_log_id > 0
+
+    cm = leader_call("VectorCountMemory", pb.VectorCountMemoryRequest())
+    assert cm.bytes > 0
+
+    rm = leader_call("VectorGetRegionMetrics",
+                     pb.VectorGetRegionMetricsRequest())
+    assert rm.vector_count == 40
+    assert rm.min_id == 0 and rm.max_id == 39
+    assert rm.region_state == "normal"
+
+    dump = leader_call("VectorDump", pb.VectorDumpRequest())
+    parsed = json.loads(dump.json)
+    assert parsed["count"] == 40 and parsed["ready"] is True
+
+    # reset drops the view and rebuilds it from the engine
+    assert leader_call("VectorReset", pb.VectorResetRequest()).error.errcode == 0
+    st = leader_call("VectorStatus", pb.VectorStatusRequest())
+    assert st.ready and st.count == 40
+    assert leader_call("VectorBuild", pb.VectorBuildRequest()).error.errcode == 0
+    res = client.vector_search(13, x[:2], topk=3)
+    assert [r[0][0] for r in res] == [0, 1]

@@ -50,6 +50,9 @@ def probe_tpu(timeout_s: int = 0) -> bool:
 def main() -> int:
     if not probe_tpu():
         return 2
+    from dingo_tpu.common.config import enable_compile_cache
+
+    enable_compile_cache(lambda m: print(m, file=sys.stderr))
     import numpy as np
 
     from dingo_tpu.common.config import FLAGS
