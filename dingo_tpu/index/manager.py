@@ -191,16 +191,42 @@ class VectorIndexManager:
             index.load(path)
         except Exception:
             return False
-        if raft_log is not None and wrapper.apply_log_id > index.apply_log_id:
-            self.replay_wal(index, region, raft_log,
-                            index.apply_log_id + 1, wrapper.apply_log_id)
-        wrapper.set_own(index)
+        # open catch-up rounds without blocking writes, then a FINAL round
+        # + swap under the wrapper lock — a live region keeps applying raft
+        # entries to the old index during the load, and installing without
+        # the locked final round would silently drop them (same protocol
+        # as rebuild())
+        if raft_log is not None:
+            for _ in range(MAX_CATCHUP_ROUNDS):
+                target = wrapper.apply_log_id
+                if index.apply_log_id >= target:
+                    break
+                self.replay_wal(index, region, raft_log,
+                                index.apply_log_id + 1, target)
+        with wrapper._lock:
+            if raft_log is not None:
+                wrapper.is_switching = True
+                try:
+                    self.replay_wal(index, region, raft_log,
+                                    index.apply_log_id + 1,
+                                    wrapper.apply_log_id)
+                finally:
+                    wrapper.is_switching = False
+            if index.apply_log_id < wrapper.apply_log_id:
+                # snapshot too old and the raft log cannot bridge the gap
+                # (compacted): refuse rather than install a stale index
+                return False
+            wrapper.set_own(index)
         return True
 
     # ---------------- scrub ----------------
-    def scrub(self, region: Region) -> dict:
+    def scrub(self, region: Region, act: bool = False,
+              raft_log: Optional[RaftLog] = None) -> dict:
         """ScrubVectorIndex (manager.h:175): periodic health check deciding
-        rebuild/save needs (driven by the crontab layer)."""
+        rebuild/save needs. act=True performs them (the reference's scrub
+        crontab LAUNCHES the rebuild/save tasks, it does not just report):
+        a rebuild uses the atomic-swap path; a save writes the snapshot
+        when a snapshot_root is configured."""
         wrapper = region.vector_index_wrapper
         if wrapper is None:
             return {}
@@ -208,6 +234,23 @@ class VectorIndexManager:
             "need_rebuild": wrapper.need_to_rebuild(),
             "need_save": wrapper.need_to_save(),
         }
+        if act:
+            try:
+                if actions["need_rebuild"]:
+                    with self._lock:
+                        busy = self.rebuild_running > 0
+                    if busy:
+                        actions["skipped_busy"] = True
+                        return actions
+                    self.rebuild(region, raft_log=raft_log)
+                    actions["rebuilt"] = True
+                elif actions["need_save"] and self.snapshot_root:
+                    self.save_index(region)
+                    actions["saved"] = True
+            except Exception as e:  # noqa: BLE001
+                # scrub is best-effort background maintenance; the next
+                # tick retries (wrapper.build_error carries the state)
+                actions["error"] = str(e)
         return actions
 
     # ---------------- helpers ----------------

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import signal
+import threading
 import sys
 import time
 
@@ -151,9 +152,32 @@ def serve_store(args) -> None:
     crontab.add("mvcc_gc", 60.0, run_gc)
     crontab.add("split_check", 60.0,
                 lambda: PreSplitChecker(node).run() if node.coordinator else None)
-    crontab.add("scrub_vector_index", 60.0, lambda: [
-        node.index_manager.scrub(r) for r in node.meta.get_all_regions()
-    ])
+    scrub_worker = {"thread": None}
+
+    def scrub_all():
+        # rebuilds/saves can take minutes; run them OFF the shared crontab
+        # thread so mvcc_gc/split_check keep ticking, one worker at a time
+        t = scrub_worker["thread"]
+        if t is not None and t.is_alive():
+            return
+
+        def work():
+            for r in node.meta.get_all_regions():
+                raft = node.engine.get_node(r.id)
+                actions = node.index_manager.scrub(
+                    r, act=True, raft_log=raft.log if raft else None
+                )
+                if actions.get("error"):
+                    print(
+                        f"scrub region {r.id}: {actions['error']}",
+                        file=sys.stderr, flush=True,
+                    )
+
+        t = threading.Thread(target=work, name="scrub", daemon=True)
+        scrub_worker["thread"] = t
+        t.start()
+
+    crontab.add("scrub_vector_index", 60.0, scrub_all)
     crontab.start()
     print(f"store {args.id} listening on 127.0.0.1:{port}", flush=True)
     _wait(server, crontab, node)

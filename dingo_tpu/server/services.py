@@ -40,6 +40,14 @@ def _err(resp, code: int, msg: str):
     return resp
 
 
+def _rebuild_region(node: StoreNode, region: Region) -> None:
+    """Forced rebuild through the atomic-swap path, WITH the raft log so
+    catch-up happens in open rounds and the old index serves throughout
+    (blocking-scan rebuild is reserved for regions with no raft node)."""
+    raft = node.engine.get_node(region.id)
+    node.index_manager.rebuild(region, raft_log=raft.log if raft else None)
+
+
 def _region_or_err(node: StoreNode, context_pb, resp) -> Optional[Region]:
     region = node.get_region(context_pb.region_id)
     if region is None:
@@ -307,7 +315,7 @@ class IndexService:
         if region.vector_index_wrapper is None:
             return _err(resp, 70001, "region has no vector index")
         try:
-            self.node.index_manager.rebuild(region)
+            _rebuild_region(self.node, region)
         except Exception as e:  # noqa: BLE001
             return _err(resp, 70002, f"rebuild failed: {e}")
         return resp
@@ -367,7 +375,7 @@ class IndexService:
             # rebuild() swaps atomically under the wrapper lock — the old
             # index keeps serving (and absorbing raft applies) until the
             # fresh one is ready; never pre-mark not-ready here
-            self.node.index_manager.rebuild(region)
+            _rebuild_region(self.node, region)
         except Exception as e:  # noqa: BLE001
             return _err(resp, 70002, f"reset rebuild failed: {e}")
         return resp
@@ -1256,7 +1264,7 @@ class RegionControlService:
         if region is None:
             return _err(resp, 10001, f"region {req.region_id} not found")
         if region.vector_index_wrapper is not None:
-            self.node.index_manager.rebuild(region)
+            _rebuild_region(self.node, region)
         elif region.document_index is not None:
             self.node.rebuild_document_index(region)
         else:

@@ -148,3 +148,31 @@ def test_scrub_reports_needs(tmp_path):
     assert actions == {"need_rebuild": False, "need_save": False}
     w.write_count = 1_000_000
     assert mgr.scrub(region)["need_save"]
+
+
+def test_scrub_acts_on_save_and_rebuild(tmp_path):
+    """scrub(act=True) performs the work it detects: snapshot save when the
+    write-count threshold trips, rebuild when the index asks for it
+    (reference scrub crontab launches SaveVectorIndexTask /
+    RebuildVectorIndexTask, not just reports)."""
+    import numpy as np
+
+    from dingo_tpu.index.manager import VectorIndexManager
+
+    raw, engine, storage, region = make_stack()
+    mgr = VectorIndexManager(raw, snapshot_root=str(tmp_path))
+    wrapper = region.vector_index_wrapper
+    wrapper.ready = True
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((50, DIM)).astype(np.float32)
+    storage.vector_add(region, np.arange(50, dtype=np.int64), x)
+    wrapper.save_write_threshold = 10       # force need_save
+    actions = mgr.scrub(region, act=True)
+    assert actions.get("saved") is True
+    import os
+
+    assert os.path.isdir(mgr.snapshot_path(region.id))
+    assert wrapper.write_count == 0         # counter reset by the save
+    # second scrub: nothing to do
+    actions = mgr.scrub(region, act=True)
+    assert "saved" not in actions and "rebuilt" not in actions
