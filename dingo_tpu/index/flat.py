@@ -173,10 +173,10 @@ class _SlotStoreIndex(VectorIndex):
             FLAGS.get("use_pallas_fused_search")
             and self._kernel_metric in (Metric.L2, Metric.INNER_PRODUCT)
             and self.store.capacity >= 2048
-            # float stores only: TpuBinaryFlat reaches here with an int8
-            # ±1 store (kernel metric IP) and mixed-dtype dot under Mosaic
-            # is unvalidated on TPU; keep it on the XLA path.
-            and self.store.vecs.dtype == jnp.float32
+            # float stores only (f32/bf16 — the kernel promotes in VMEM):
+            # TpuBinaryFlat reaches here with an int8 ±1 store and mixed
+            # int dot under Mosaic is unvalidated; keep it on XLA.
+            and self.store.vecs.dtype in (jnp.float32, jnp.bfloat16)
         )
         if use_fused:
             from dingo_tpu.ops.pallas_topk import fused_search

@@ -321,7 +321,7 @@ class TpuIvfFlat(_SlotStoreIndex):
                 and self.metric in (
                     Metric.L2, Metric.INNER_PRODUCT, Metric.COSINE
                 )
-                and self.store.vecs.dtype == jnp.float32
+                and self.store.vecs.dtype in (jnp.float32, jnp.bfloat16)
                 # kernel keeps top-k in a 128-lane output block; larger k
                 # (and its unrolled select rounds) stays on the XLA path
                 and int(topk) <= 64

@@ -38,6 +38,11 @@ BUILD_BATCH = 4096
 MAX_CATCHUP_ROUNDS = 8
 
 
+class StaleSnapshot(RuntimeError):
+    """A snapshot too old for the remaining raft log to bridge (the log was
+    compacted past snapshot_log_id + 1); installing it would lose writes."""
+
+
 class VectorIndexManager:
     def __init__(self, engine: RawEngine, snapshot_root: Optional[str] = None):
         self.engine = engine
@@ -46,6 +51,7 @@ class VectorIndexManager:
         self.rebuild_running = 0     # bvar task counters (manager.h:177-208)
         self.rebuild_total = 0
         self.save_total = 0
+        self._rebuilding: set = set()   # region ids with a rebuild in flight
 
     # ---------------- build ----------------
     def build_index(self, region: Region,
@@ -87,12 +93,43 @@ class VectorIndexManager:
         return index
 
     # ---------------- catch-up + switch ----------------
-    def rebuild(self, region: Region, raft_log: Optional[RaftLog] = None) -> None:
-        """LaunchRebuildVectorIndex -> RebuildVectorIndex (:1062):
-        build + multi-round WAL catch-up + atomic switch (:1149)."""
+    def _catch_up_and_install(self, wrapper, index, region: Region,
+                              raft_log: RaftLog) -> None:
+        """Shared catch-up protocol (rebuild + load): open replay rounds
+        without blocking writes, then ONE final round and the install under
+        the wrapper lock with the switching flag set."""
+        for _ in range(MAX_CATCHUP_ROUNDS):
+            target = wrapper.apply_log_id
+            if index.apply_log_id >= target:
+                break
+            self.replay_wal(index, region, raft_log,
+                            index.apply_log_id + 1, target)
+        with wrapper._lock:
+            wrapper.is_switching = True
+            try:
+                self.replay_wal(index, region, raft_log,
+                                index.apply_log_id + 1,
+                                wrapper.apply_log_id)
+                wrapper.own_index = index
+                wrapper.ready = True
+                wrapper.build_error = False
+                wrapper.share_index = None
+            finally:
+                wrapper.is_switching = False
+
+    def rebuild(self, region: Region,
+                raft_log: Optional[RaftLog] = None) -> bool:
+        """LaunchRebuildVectorIndex -> RebuildVectorIndex (:1062): build +
+        multi-round WAL catch-up + atomic switch (:1149). Returns False
+        when a rebuild of THIS region is already in flight (atomic
+        test-and-set; two concurrent full scans would only waste minutes
+        building the same index twice)."""
         wrapper = region.vector_index_wrapper
         assert wrapper is not None
         with self._lock:
+            if region.id in self._rebuilding:
+                return False
+            self._rebuilding.add(region.id)
             self.rebuild_running += 1
             self.rebuild_total += 1
         try:
@@ -101,44 +138,24 @@ class VectorIndexManager:
                 # no write lands between the scan and the switch (otherwise
                 # the fresh index would silently miss it forever).
                 with wrapper._lock:
-                    start_log_id = wrapper.apply_log_id
                     index = self.build_index(region, raft_log)
                     index.apply_log_id = wrapper.apply_log_id
                     wrapper.own_index = index
                     wrapper.ready = True
                     wrapper.build_error = False
                     wrapper.share_index = None
-                return
+                return True
             start_log_id = wrapper.apply_log_id
             index = self.build_index(region, raft_log)
             index.apply_log_id = start_log_id
-            if raft_log is not None:
-                # non-final rounds: replay without blocking writes
-                for _ in range(MAX_CATCHUP_ROUNDS):
-                    target = wrapper.apply_log_id
-                    if index.apply_log_id >= target:
-                        break
-                    self.replay_wal(index, region, raft_log,
-                                    index.apply_log_id + 1, target)
-                # final round under the switching flag (writes serialized by
-                # the wrapper lock during swap)
-                with wrapper._lock:
-                    wrapper.is_switching = True
-                    try:
-                        self.replay_wal(index, region, raft_log,
-                                        index.apply_log_id + 1,
-                                        wrapper.apply_log_id)
-                        wrapper.own_index = index
-                        wrapper.ready = True
-                        wrapper.build_error = False
-                        wrapper.share_index = None
-                    finally:
-                        wrapper.is_switching = False
+            self._catch_up_and_install(wrapper, index, region, raft_log)
+            return True
         except Exception:
             wrapper.build_error = True
             raise
         finally:
             with self._lock:
+                self._rebuilding.discard(region.id)
                 self.rebuild_running -= 1
 
     def replay_wal(self, index: VectorIndex, region: Region,
@@ -191,32 +208,31 @@ class VectorIndexManager:
             index.load(path)
         except Exception:
             return False
-        # open catch-up rounds without blocking writes, then a FINAL round
-        # + swap under the wrapper lock — a live region keeps applying raft
-        # entries to the old index during the load, and installing without
-        # the locked final round would silently drop them (same protocol
-        # as rebuild())
-        if raft_log is not None:
-            for _ in range(MAX_CATCHUP_ROUNDS):
-                target = wrapper.apply_log_id
-                if index.apply_log_id >= target:
-                    break
-                self.replay_wal(index, region, raft_log,
-                                index.apply_log_id + 1, target)
-        with wrapper._lock:
-            if raft_log is not None:
-                wrapper.is_switching = True
-                try:
-                    self.replay_wal(index, region, raft_log,
-                                    index.apply_log_id + 1,
-                                    wrapper.apply_log_id)
-                finally:
-                    wrapper.is_switching = False
-            if index.apply_log_id < wrapper.apply_log_id:
-                # snapshot too old and the raft log cannot bridge the gap
-                # (compacted): refuse rather than install a stale index
-                return False
-            wrapper.set_own(index)
+        if raft_log is None:
+            if wrapper.apply_log_id > index.apply_log_id:
+                raise StaleSnapshot(
+                    f"snapshot at {index.apply_log_id}, region at "
+                    f"{wrapper.apply_log_id}, no raft log to replay"
+                )
+            with wrapper._lock:
+                wrapper.set_own(index)
+            return True
+        # the gap check must run BEFORE replaying: get_data_entries clamps
+        # to the log's first_index, so a compacted log would silently skip
+        # the missing entries and the post-replay log id would look fine
+        if (
+            wrapper.apply_log_id > index.apply_log_id
+            and raft_log.first_index > index.apply_log_id + 1
+        ):
+            raise StaleSnapshot(
+                f"snapshot at {index.apply_log_id} but the raft log starts "
+                f"at {raft_log.first_index} (compacted); entries "
+                f"{index.apply_log_id + 1}..{raft_log.first_index - 1} "
+                "are unrecoverable from this snapshot"
+            )
+        # same catch-up-then-locked-install protocol as rebuild(); a live
+        # region keeps applying raft entries to the OLD index meanwhile
+        self._catch_up_and_install(wrapper, index, region, raft_log)
         return True
 
     # ---------------- scrub ----------------
@@ -237,13 +253,10 @@ class VectorIndexManager:
         if act:
             try:
                 if actions["need_rebuild"]:
-                    with self._lock:
-                        busy = self.rebuild_running > 0
-                    if busy:
+                    if self.rebuild(region, raft_log=raft_log):
+                        actions["rebuilt"] = True
+                    else:
                         actions["skipped_busy"] = True
-                        return actions
-                    self.rebuild(region, raft_log=raft_log)
-                    actions["rebuilt"] = True
                 elif actions["need_save"] and self.snapshot_root:
                     self.save_index(region)
                     actions["saved"] = True

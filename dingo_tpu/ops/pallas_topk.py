@@ -53,7 +53,7 @@ def _fused_kernel(q_ref, qsq_ref, x_ref, xsq_ref, valid_ref,
         best_i[:] = jnp.full_like(best_i, -1)
 
     q = q_ref[:]                                          # [b, d]
-    x = x_ref[:]                                          # [C, d]
+    x = x_ref[:].astype(jnp.float32)   # bf16 stores promote in VMEM
     # HIGHEST precision: the default bf16-pass matmul measurably costs
     # recall (distance.py pins the same; flat recall@10 0.9875 -> 1.0).
     dots = jax.lax.dot_general(

@@ -328,12 +328,16 @@ class IndexService:
             return resp
         if region.vector_index_wrapper is None:
             return _err(resp, 70001, "region has no vector index")
+        from dingo_tpu.index.manager import StaleSnapshot
+
         try:
             raft = self.node.engine.get_node(region.id)
             ok = self.node.index_manager.load_index(
                 region, raft_log=raft.log if raft else None,
                 path=req.path or None,
             )
+        except StaleSnapshot as e:
+            return _err(resp, 70004, f"stale snapshot refused: {e}")
         except (OSError, ValueError, VectorIndexError) as e:
             return _err(resp, 70003, f"load failed: {e}")
         if not ok:

@@ -316,7 +316,12 @@ class StoreNode:
                 return False
             node = self.engine.get_node(region_id)
             raft_log = node.log if node is not None else None
-            ok = self.index_manager.load_index(region, raft_log=raft_log)
+            from dingo_tpu.index.manager import StaleSnapshot
+
+            try:
+                ok = self.index_manager.load_index(region, raft_log=raft_log)
+            except StaleSnapshot:
+                ok = False   # startup path: fall through to a full rebuild
             if ok and region.vector_index_wrapper is not None:
                 region.vector_index_wrapper.snapshot_log_id =                     meta.snapshot_log_id
             return ok

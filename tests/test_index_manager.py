@@ -176,3 +176,69 @@ def test_scrub_acts_on_save_and_rebuild(tmp_path):
     # second scrub: nothing to do
     actions = mgr.scrub(region, act=True)
     assert "saved" not in actions and "rebuilt" not in actions
+
+
+def test_scrub_rebuild_branch_and_busy_gate():
+    """scrub(act=True) rebuilds when the index asks for it; a concurrent
+    rebuild of the same region makes it report skipped_busy instead of
+    running a duplicate full scan."""
+    import threading
+
+    import numpy as np
+
+    from dingo_tpu.index.manager import VectorIndexManager
+
+    raw, engine, storage, region = make_stack(IndexType.HNSW)
+    mgr = VectorIndexManager(raw)
+    wrapper = region.vector_index_wrapper
+    wrapper.ready = True
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((100, DIM)).astype(np.float32)
+    storage.vector_add(region, np.arange(100, dtype=np.int64), x)
+    storage.vector_delete(region, list(range(60)))
+    assert wrapper.need_to_rebuild()    # deleted > live/2 (hnsw trigger)
+    actions = mgr.scrub(region, act=True)
+    assert actions.get("rebuilt") is True
+    assert not wrapper.need_to_rebuild()
+
+    # busy gate: a rebuild marked in flight makes scrub skip
+    with mgr._lock:
+        mgr._rebuilding.add(region.id)
+    try:
+        storage.vector_add(region, np.arange(200, 300, dtype=np.int64), x)
+        storage.vector_delete(region, list(range(200, 280)))
+        assert wrapper.need_to_rebuild()
+        actions = mgr.scrub(region, act=True)
+        assert actions.get("skipped_busy") is True
+    finally:
+        with mgr._lock:
+            mgr._rebuilding.discard(region.id)
+
+
+def test_load_index_refuses_compacted_gap(tmp_path):
+    """A snapshot older than the raft log's first index must raise
+    StaleSnapshot BEFORE replaying (get_data_entries clamps silently)."""
+    import numpy as np
+    import pytest as _pytest
+
+    from dingo_tpu.index.manager import StaleSnapshot, VectorIndexManager
+    from dingo_tpu.raft.log import RaftLog
+
+    raw, engine, storage, region = make_stack()
+    mgr = VectorIndexManager(raw, snapshot_root=str(tmp_path))
+    wrapper = region.vector_index_wrapper
+    wrapper.ready = True
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((20, DIM)).astype(np.float32)
+    storage.vector_add(region, np.arange(20, dtype=np.int64), x)
+    wrapper.apply_log_id = 5
+    wrapper.own_index.apply_log_id = 5
+    mgr.save_index(region)              # snapshot_log_id = 5
+
+    log = RaftLog()
+    for i in range(400):
+        log.append(1, b"x")
+    log.compact(300)                    # first_index becomes 301
+    wrapper.apply_log_id = 400
+    with _pytest.raises(StaleSnapshot, match="compacted"):
+        mgr.load_index(region, raft_log=log)

@@ -64,3 +64,40 @@ def test_pallas_ivf_filter_and_full_probe(trained_index):
     _assert_parity(base, fused)
     for ids, _ in fused:
         assert all(100 <= i < 3000 for i in ids)
+
+
+def test_pallas_paths_accept_bf16_stores():
+    """bench stores vectors in bf16; the Pallas kernels promote in VMEM so
+    the flag-gated paths must route (and agree with XLA) for bf16 too."""
+    import jax.numpy as jnp
+
+    from dingo_tpu.index.flat import TpuFlat
+
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((3000, 32)).astype(np.float32)
+    ids = np.arange(3000, dtype=np.int64)
+    flat = TpuFlat(5, IndexParameter(index_type=IndexType.FLAT, dimension=32,
+                                     dtype="bfloat16"))
+    flat.upsert(ids, x)
+    assert flat.store.vecs.dtype == jnp.bfloat16
+    want = [list(r.ids) for r in flat.search(x[:4], 5)]
+    FLAGS.set("use_pallas_fused_search", True)
+    try:
+        got = [list(r.ids) for r in flat.search(x[:4], 5)]
+    finally:
+        FLAGS.set("use_pallas_fused_search", False)
+    assert want == got
+
+    ivf = TpuIvfFlat(6, IndexParameter(
+        index_type=IndexType.IVF_FLAT, dimension=32, ncentroids=8,
+        dtype="bfloat16",
+    ))
+    ivf.upsert(ids, x)
+    ivf.train()
+    base = [list(r.ids) for r in ivf.search(x[:4], 5, nprobe=8)]
+    FLAGS.set("use_pallas_ivf_search", True)
+    try:
+        fused = [list(r.ids) for r in ivf.search(x[:4], 5, nprobe=8)]
+    finally:
+        FLAGS.set("use_pallas_ivf_search", False)
+    assert base == fused
