@@ -27,20 +27,25 @@ from dingo_tpu.engine.raw_engine import (
 from dingo_tpu.engine import write_data as wd
 from dingo_tpu.index import codec as vcodec
 from dingo_tpu.index.vector_reader import serialize_scalar, serialize_vector
-from dingo_tpu.mvcc.codec import Codec, ValueFlag
+from dingo_tpu.mvcc.codec import MAX_TS, Codec, ValueFlag
 from dingo_tpu.store.region import Region
 from dingo_tpu.raft import wire
 
 
 def apply_write(
     engine: RawEngine, region: Region, data: wd.WriteData, log_id: int = 0,
-    context=None,
-) -> None:
+    context=None, want_result: bool = True,
+) -> Optional[dict]:
     """Dispatch one committed payload (RaftApplyHandlerFactory equivalent).
 
     `context` (optional) is the hosting StoreNode for handlers that touch
     region topology (SplitHandler needs to create the child region and its
-    raft member on EVERY replica applying the entry)."""
+    raft member on EVERY replica applying the entry).
+
+    Returns an optional handler result (e.g. {"deleted": n} for range
+    deletes) that the replication engines surface to the proposer — the
+    applied state, not a pre-propose scan, is what response counts must
+    reflect (they can diverge under concurrent writes)."""
     from dingo_tpu.common.failpoint import failpoint
 
     failpoint("before_apply")
@@ -51,18 +56,18 @@ def apply_write(
                 "not host split topology)"
             )
         context.handle_split(region, data, log_id)
-        return
+        return None
     if isinstance(data, wd.MergeRegionData):
         if context is None:
             raise NotImplementedError("region merge needs a StoreNode context")
         context.handle_merge(region, data, log_id)
-        return
+        return None
     if isinstance(data, wd.KvPutData):
         _apply_kv_put(engine, data)
     elif isinstance(data, wd.KvDeleteData):
         _apply_kv_delete(engine, data)
     elif isinstance(data, wd.KvDeleteRangeData):
-        _apply_kv_delete_range(engine, data)
+        return _apply_kv_delete_range(engine, data, want_result)
     elif isinstance(data, wd.VectorAddData):
         _apply_vector_add(engine, region, data, log_id)
     elif isinstance(data, wd.VectorDeleteData):
@@ -75,6 +80,7 @@ def apply_write(
         _apply_txn(engine, data)
     else:
         raise TypeError(f"unknown write payload {type(data)}")
+    return None
 
 
 def _apply_kv_put(engine: RawEngine, data: wd.KvPutData) -> None:
@@ -100,15 +106,30 @@ def _apply_kv_delete(engine: RawEngine, data: wd.KvDeleteData) -> None:
     engine.write(batch)
 
 
-def _apply_kv_delete_range(engine: RawEngine, data: wd.KvDeleteRangeData) -> None:
+def _apply_kv_delete_range(
+    engine: RawEngine, data: wd.KvDeleteRangeData, want_result: bool
+) -> Optional[dict]:
     """Range deletes drop whole encoded ranges (the reference issues RocksDB
-    DeleteRange on the raw engine rather than writing per-key tombstones)."""
+    DeleteRange on the raw engine rather than writing per-key tombstones).
+
+    The live-key count at apply time is what delete_count responses must
+    report (a pre-propose scan races concurrent writes) — but it is NOT
+    consensus state, so only a node with a waiting proposer pays for the
+    scan (want_result); followers and log replay skip it."""
+    deleted = 0
+    if want_result:
+        from dingo_tpu.mvcc.reader import Reader as MvccReader
+
+        reader = MvccReader(engine, data.cf)
+        for start, end in data.ranges:
+            deleted += reader.kv_count(start, end, MAX_TS)
     batch = WriteBatch()
     for start, end in data.ranges:
         batch.delete_range(
             data.cf, Codec.encode_bytes(start), Codec.encode_bytes(end)
         )
     engine.write(batch)
+    return {"deleted": deleted} if want_result else None
 
 
 def _apply_vector_add(

@@ -13,6 +13,7 @@ import threading
 from typing import Dict, Optional
 
 from dingo_tpu.engine.apply import apply_write
+from dingo_tpu.engine.apply_results import ApplyResultBuffer
 from dingo_tpu.engine.raw_engine import RawEngine
 from dingo_tpu.engine.write_data import WriteData
 from dingo_tpu.index.vector_reader import ReaderContext, VectorReader
@@ -25,6 +26,7 @@ class MonoStoreEngine:
         self.raw = raw_engine
         self._lock = threading.Lock()
         self._log_ids: Dict[int, int] = {}  # per-region apply log counter
+        self._apply_results = ApplyResultBuffer()
 
     def next_log_id(self, region_id: int) -> int:
         with self._lock:
@@ -38,10 +40,16 @@ class MonoStoreEngine:
         log with a per-region counter so the wrapper's apply-log contract
         stays identical)."""
         log_id = self.next_log_id(region.id)
-        apply_write(self.raw, region, data, log_id)
+        # mono IS the proposer, so results are always wanted
+        result = apply_write(self.raw, region, data, log_id)
+        if result is not None:
+            self._apply_results.record(region.id, log_id, result)
         return log_id
 
     async_write = write  # mono apply is already synchronous
+
+    def take_apply_result(self, region_id: int, log_id: int):
+        return self._apply_results.take(region_id, log_id)
 
     # -- Engine::VectorReader --------------------------------------------------
     def new_vector_reader(self, region: Region, read_ts: int = MAX_TS) -> VectorReader:

@@ -14,6 +14,7 @@ import threading
 from typing import Dict, Optional
 
 from dingo_tpu.engine.apply import apply_write
+from dingo_tpu.engine.apply_results import ApplyResultBuffer
 from dingo_tpu.engine.raw_engine import ALL_CFS, CF_META, RawEngine, WriteBatch
 from dingo_tpu.engine.write_data import WriteData, decode_write, encode_write
 from dingo_tpu.raft import wire
@@ -73,6 +74,11 @@ class RaftStoreEngine:
         self._lock = threading.Lock()
         self._nodes: Dict[int, RaftNode] = {}   # RaftNodeManager
         self._regions: Dict[int, Region] = {}
+        # propose() blocks until the local apply ran, so a proposer can
+        # collect its applied outcome (e.g. delete_range counts) right
+        # after write() returns; see ApplyResultBuffer for the waiter
+        # gating that spares followers/replay the computation
+        self._apply_results = ApplyResultBuffer()
 
     # -- node management (RaftNodeManager / AddNode) -------------------------
     def node_address(self, region_id: int) -> str:
@@ -86,7 +92,12 @@ class RaftStoreEngine:
 
         def apply_fn(index: int, payload: bytes) -> None:
             data = decode_write(payload)
-            apply_write(self.raw, region, data, index, context=self.context)
+            result = apply_write(
+                self.raw, region, data, index, context=self.context,
+                want_result=self._apply_results.wanted(region_id, data),
+            )
+            if result is not None:
+                self._apply_results.record(region_id, index, result)
 
         def snapshot_save() -> bytes:
             # REGION-scoped checkpoint (the reference streams per-region
@@ -142,7 +153,16 @@ class RaftStoreEngine:
         if node is None:
             raise RuntimeError(f"no raft node for region {region.id}")
         payload = encode_write(data)
-        return node.propose(payload, timeout=timeout)
+        waiter = self._apply_results.register_waiter(region.id, data)
+        try:
+            return node.propose(payload, timeout=timeout)
+        finally:
+            self._apply_results.unregister_waiter(waiter)
+
+    def take_apply_result(self, region_id: int, log_id: int):
+        """Result recorded by this region's apply handler for log_id (None
+        if the handler produced none)."""
+        return self._apply_results.take(region_id, log_id)
 
     # -- Engine::VectorReader -------------------------------------------------
     def new_vector_reader(self, region: Region, read_ts: int = MAX_TS) -> VectorReader:

@@ -120,12 +120,16 @@ class Storage:
     def kv_delete_range(
         self, region: Region, ranges: Sequence[Tuple[bytes, bytes]]
     ) -> int:
+        """Returns the number of live keys the APPLIED write removed (the
+        apply handler counts them; a pre-propose scan would race concurrent
+        writes)."""
         ts = self.ts_provider.get_ts()
-        self.engine.write(
+        log_id = self.engine.write(
             region,
             wd.KvDeleteRangeData(cf=CF_DEFAULT, ts=ts, ranges=list(ranges)),
         )
-        return ts
+        result = self.engine.take_apply_result(region.id, log_id)
+        return int(result["deleted"]) if result else 0
 
     def kv_scan(
         self,

@@ -8,6 +8,7 @@ compile lazily (cached .so next to this file).
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 
@@ -16,12 +17,32 @@ _NATIVE_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native")
 
 
 def _build(lib: str, src: str) -> str:
+    """Compile (or reuse) a native helper library.
+
+    Staleness is decided by a content hash of the source recorded next to
+    the artifact — NOT mtimes (git checkouts don't preserve them) — so a
+    fresh clone never loads a stale or foreign-arch binary built with
+    -march=native on another machine (.so files are gitignored too).
+    """
     path = os.path.join(_HERE, lib)
     srcpath = os.path.join(_NATIVE_SRC, src)
-    if not os.path.exists(path) or (
-        os.path.exists(srcpath)
-        and os.path.getmtime(srcpath) > os.path.getmtime(path)
-    ):
+    stamp = path + ".srchash"
+    if not os.path.exists(srcpath):
+        # installed without the native sources: a locally-built artifact is
+        # the only option (it was built on THIS machine, so arch is fine)
+        if os.path.exists(path):
+            return path
+        raise FileNotFoundError(
+            f"native source {srcpath} missing and no prebuilt {lib}; "
+            "install with the repo's native/ tree or prebuild the library"
+        )
+    with open(srcpath, "rb") as f:
+        want = hashlib.sha256(f.read()).hexdigest()
+    have = None
+    if os.path.exists(stamp):
+        with open(stamp) as f:
+            have = f.read().strip()
+    if not os.path.exists(path) or have != want:
         subprocess.run(
             [
                 "g++", "-O3", "-std=c++17", "-fPIC", "-shared",
@@ -30,6 +51,8 @@ def _build(lib: str, src: str) -> str:
             check=True,
             capture_output=True,
         )
+        with open(stamp, "w") as f:
+            f.write(want)
     return path
 
 

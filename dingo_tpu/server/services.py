@@ -545,6 +545,8 @@ class StoreService:
         region = _region_or_err(self.node, req.context, resp)
         if region is None:
             return resp
+        if not _keys_in_region_or_err(region, list(req.keys), resp):
+            return resp
         values = self.node.storage.kv_batch_get(region, list(req.keys))
         for key, value in zip(req.keys, values):
             kv = resp.kvs.add()
@@ -564,10 +566,12 @@ class StoreService:
         if clamped is None:
             return resp
         try:
-            resp.delete_count = len(self.node.storage.kv_scan(
-                region, clamped[0], clamped[1], keys_only=True
-            ))
-            self.node.storage.kv_delete_range(region, [clamped])
+            # count comes from the applied write itself (exact under
+            # concurrent writes; also no follower-side scan before the
+            # NotLeader rejection)
+            resp.delete_count = self.node.storage.kv_delete_range(
+                region, [clamped]
+            )
         except NotLeader as e:
             return _err(resp, 20001, f"not leader: {e.leader_hint}")
         return resp
@@ -577,6 +581,10 @@ class StoreService:
         resp = pb.KvPutIfAbsentResponse()
         region = _region_or_err(self.node, req.context, resp)
         if region is None:
+            return resp
+        if not _keys_in_region_or_err(
+            region, [kv.key for kv in req.kvs], resp
+        ):
             return resp
         try:
             states = self.node.storage.kv_put_if_absent(
@@ -594,6 +602,8 @@ class StoreService:
         resp = pb.KvCompareAndSetResponse()
         region = _region_or_err(self.node, req.context, resp)
         if region is None:
+            return resp
+        if not _keys_in_region_or_err(region, [req.kv.key], resp):
             return resp
         expect = req.expect_value if req.expect_value else None
         try:

@@ -363,7 +363,7 @@ def test_kv_batch_get_and_delete_range(cluster):
     d = client._region_for_key(b"dr0")
     req = pb.KvBatchGetRequest()
     req.context.region_id = d.region_id
-    req.keys.extend([b"dr1", b"missing", b"dr3"])
+    req.keys.extend([b"dr1", b"drMISSING", b"dr3"])  # absent key in-range
     resp = client._call_leader(d, "StoreService", "KvBatchGet", req)
     assert list(resp.found) == [True, False, True]
     assert resp.kvs[0].value == b"v1" and resp.kvs[2].value == b"v3"
@@ -372,10 +372,10 @@ def test_kv_batch_get_and_delete_range(cluster):
     dreq.context.region_id = d.region_id
     dreq.range.start_key = b"dr1"
     dreq.range.end_key = b"dr4"
-    assert client._call_leader(
-        d, "StoreService", "KvDeleteRange", dreq
-    ).error.errcode == 0
-    assert resp.error.errcode == 0 or True
+    first = client._call_leader(d, "StoreService", "KvDeleteRange", dreq)
+    assert first.error.errcode == 0
+    # count reflects the APPLIED write (dr1, dr2, dr3 were live)
+    assert first.delete_count == 3
     assert client.kv_get(b"dr0") == b"v0"
     assert client.kv_get(b"dr2") is None
     assert client.kv_get(b"dr4") == b"v4"
@@ -401,3 +401,27 @@ def test_kv_batch_get_and_delete_range(cluster):
     kv.value = b"x"
     with pytest.raises(ClientError, match="outside region"):
         client._call_leader(d, "StoreService", "KvBatchPut", preq)
+
+    # every KV entry point validates bounds the same way: a stale-routed
+    # client must not read or write through the wrong region's raft group
+    # (reference ValidateKv*Request, store_service.cc:154,471)
+    greq = pb.KvBatchGetRequest()
+    greq.context.region_id = d.region_id
+    greq.keys.append(b"zz-outside")
+    with pytest.raises(ClientError, match="outside region"):
+        client._call_leader(d, "StoreService", "KvBatchGet", greq)
+
+    pareq = pb.KvPutIfAbsentRequest()
+    pareq.context.region_id = d.region_id
+    pkv = pareq.kvs.add()
+    pkv.key = b"zz-outside"
+    pkv.value = b"x"
+    with pytest.raises(ClientError, match="outside region"):
+        client._call_leader(d, "StoreService", "KvPutIfAbsent", pareq)
+
+    creq = pb.KvCompareAndSetRequest()
+    creq.context.region_id = d.region_id
+    creq.kv.key = b"zz-outside"
+    creq.kv.value = b"x"
+    with pytest.raises(ClientError, match="outside region"):
+        client._call_leader(d, "StoreService", "KvCompareAndSet", creq)
