@@ -94,6 +94,11 @@ FLAGS.define("use_mesh_sharded_flat", False, mutable=True,
                    "(TpuShardedFlat): rows over the 'data' axis, feature "
                    "dim over 'dim', search fan-out/merge via XLA "
                    "collectives over ICI")
+FLAGS.define("use_mesh_sharded_ivf", False, mutable=True,
+             help_="serve IVF_FLAT regions from a mesh-sharded index "
+                   "(TpuShardedIvfFlat): rows shard over 'data', "
+                   "distributed k-means train, per-shard bucket scan + "
+                   "all_gather top-k merge over ICI")
 FLAGS.define("mesh_dim_axis", 1, mutable=True,
              help_="size of the mesh 'dim' (tensor-parallel) axis used by "
                    "mesh-sharded indexes; 'data' axis = n_devices // dim")

@@ -115,7 +115,13 @@ def _apply_kv_delete_range(
     The live-key count at apply time is what delete_count responses must
     report (a pre-propose scan races concurrent writes) — but it is NOT
     consensus state, so only a node with a waiting proposer pays for the
-    scan (want_result); followers and log replay skip it."""
+    scan (want_result); followers and log replay skip it. The scan runs
+    inside the (per-region) apply loop, so it delays only this region's
+    later applies — same serialization the reference's raft apply has.
+
+    An empty end key means "to the end" (region with unbounded end_key):
+    it must become an unbounded engine range, NOT an encoded b"" (which
+    sorts below every real key and would delete nothing)."""
     deleted = 0
     if want_result:
         from dingo_tpu.mvcc.reader import Reader as MvccReader
@@ -126,7 +132,8 @@ def _apply_kv_delete_range(
     batch = WriteBatch()
     for start, end in data.ranges:
         batch.delete_range(
-            data.cf, Codec.encode_bytes(start), Codec.encode_bytes(end)
+            data.cf, Codec.encode_bytes(start),
+            Codec.encode_bytes(end) if end else None,
         )
     engine.write(batch)
     return {"deleted": deleted} if want_result else None

@@ -26,6 +26,7 @@ class MonoStoreEngine:
         self.raw = raw_engine
         self._lock = threading.Lock()
         self._log_ids: Dict[int, int] = {}  # per-region apply log counter
+        self._write_locks: Dict[int, "threading.Lock"] = {}
         self._apply_results = ApplyResultBuffer()
 
     def next_log_id(self, region_id: int) -> int:
@@ -35,16 +36,26 @@ class MonoStoreEngine:
             return n
 
     # -- Engine::Writer ------------------------------------------------------
+    def _region_write_lock(self, region_id: int):
+        with self._lock:
+            lock = self._write_locks.get(region_id)
+            if lock is None:
+                lock = self._write_locks[region_id] = threading.Lock()
+            return lock
+
     def write(self, region: Region, data: WriteData) -> int:
         """Synchronous apply; returns the log id (mono engine fakes the raft
         log with a per-region counter so the wrapper's apply-log contract
-        stays identical)."""
-        log_id = self.next_log_id(region.id)
-        # mono IS the proposer, so results are always wanted
-        result = apply_write(self.raw, region, data, log_id)
-        if result is not None:
-            self._apply_results.record(region.id, log_id, result)
-        return log_id
+        stays identical). Applies serialize per region — the raft engine's
+        apply loop gives the same guarantee, and result-bearing handlers
+        (delete_range count-then-delete) rely on it for atomicity."""
+        with self._region_write_lock(region.id):
+            log_id = self.next_log_id(region.id)
+            # mono IS the proposer, so results are always wanted
+            result = apply_write(self.raw, region, data, log_id)
+            if result is not None:
+                self._apply_results.record(region.id, log_id, result)
+            return log_id
 
     async_write = write  # mono apply is already synchronous
 

@@ -28,9 +28,12 @@ from dingo_tpu.store.region import Region
 
 
 def _region_bounds(region: Region):
-    """Encoded key range of a region in the mvcc-encoded CFs."""
+    """Encoded key range of a region in the mvcc-encoded CFs. An empty
+    end_key (unbounded region) maps to None — encoding b"" would produce
+    the MINIMUM key and make the range empty."""
     start = Codec.encode_bytes(region.definition.start_key)
-    end = Codec.encode_bytes(region.definition.end_key)
+    end_key = region.definition.end_key
+    end = Codec.encode_bytes(end_key) if end_key else None
     return start, end
 
 

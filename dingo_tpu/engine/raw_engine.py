@@ -95,9 +95,11 @@ class SortedKv:
             k = self._keys[i]
             yield k, self._map[k]
 
-    def delete_range(self, start: bytes, end: bytes) -> int:
+    def delete_range(self, start: bytes, end: Optional[bytes]) -> int:
+        """[start, end); end None = to the end of the CF."""
         lo = bisect.bisect_left(self._keys, start)
-        hi = bisect.bisect_left(self._keys, end)
+        hi = (bisect.bisect_left(self._keys, end) if end is not None
+              else len(self._keys))
         doomed = self._keys[lo:hi]
         for k in doomed:
             del self._map[k]
@@ -127,7 +129,12 @@ class WriteBatch:
         self.ops.append(("del", cf, key, b""))
         return self
 
-    def delete_range(self, cf: str, start: bytes, end: bytes) -> "WriteBatch":
+    def delete_range(
+        self, cf: str, start: bytes, end: Optional[bytes]
+    ) -> "WriteBatch":
+        """end None = unbounded (to the end of the CF) — an encoded empty
+        key sorts BELOW every real key, so it must never be used as an
+        upper bound."""
         self.ops.append(("delr", cf, start, end))
         return self
 

@@ -7,18 +7,33 @@ from __future__ import annotations
 from dingo_tpu.index.base import IndexParameter, IndexType, InvalidParameter, VectorIndex
 
 
+def _sharded_if_enabled(flag: str, index_id: int, parameter: IndexParameter):
+    """Mesh-sharded arm shared by the FLAT/IVF_FLAT branches: only when the
+    flag is on AND more than one device exists (a 1-device mesh would just
+    add collective overhead)."""
+    from dingo_tpu.common.config import FLAGS
+
+    if not FLAGS.get(flag):
+        return None
+    import jax
+
+    if len(jax.devices()) <= 1:
+        return None
+    if flag == "use_mesh_sharded_flat":
+        from dingo_tpu.parallel.sharded_flat import TpuShardedFlat as cls
+    else:
+        from dingo_tpu.parallel.sharded_ivf import TpuShardedIvfFlat as cls
+    return cls(index_id, parameter)
+
+
 def new_index(index_id: int, parameter: IndexParameter) -> VectorIndex:
     t = parameter.index_type
     if t is IndexType.FLAT:
-        from dingo_tpu.common.config import FLAGS
-
-        if FLAGS.get("use_mesh_sharded_flat"):
-            import jax
-
-            if len(jax.devices()) > 1:
-                from dingo_tpu.parallel.sharded_flat import TpuShardedFlat
-
-                return TpuShardedFlat(index_id, parameter)
+        sharded = _sharded_if_enabled(
+            "use_mesh_sharded_flat", index_id, parameter
+        )
+        if sharded is not None:
+            return sharded
         from dingo_tpu.index.flat import TpuFlat
 
         return TpuFlat(index_id, parameter)
@@ -31,6 +46,11 @@ def new_index(index_id: int, parameter: IndexParameter) -> VectorIndex:
 
         return TpuBinaryFlat(index_id, parameter)
     if t is IndexType.IVF_FLAT:
+        sharded = _sharded_if_enabled(
+            "use_mesh_sharded_ivf", index_id, parameter
+        )
+        if sharded is not None:
+            return sharded
         from dingo_tpu.index.ivf_flat import TpuIvfFlat
 
         return TpuIvfFlat(index_id, parameter)

@@ -70,9 +70,9 @@ from dingo_tpu.ops.kmeans import (
 from dingo_tpu.ops.topk import merge_topk, topk_scores
 
 
-@functools.partial(jax.jit, static_argnames=("nprobe",))
-def _probe_lists(queries, centroids, c_sqnorm, nprobe):
-    """Top-nprobe coarse lists per query: [b, nprobe] int32."""
+def coarse_probes(queries, centroids, c_sqnorm, nprobe):
+    """Top-nprobe coarse lists per query: [b, nprobe] int32. Plain function
+    (shard_map-safe); `_probe_lists` is the jitted wrapper."""
     # Coarse quantizer is always L2 (faiss uses the metric's quantizer, but
     # L2 on normalized data == cosine ordering; IP uses L2 quantizer too in
     # the reference's faiss config).
@@ -91,8 +91,10 @@ def _probe_lists(queries, centroids, c_sqnorm, nprobe):
     return idx.astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric"))
-def _ivf_scan_kernel(
+_probe_lists = jax.jit(coarse_probes, static_argnames=("nprobe",))
+
+
+def ivf_scan_scores(
     buckets, bucket_sqnorm, bucket_valid, bucket_slot, probes, queries, k, metric
 ):
     """Scan nprobe bucket ranks per query with a running top-k.
@@ -101,7 +103,10 @@ def _ivf_scan_kernel(
     bucket_*:    [nlist, cap_list] (sqnorm f32 / valid bool / slot int32)
     probes:      [b, nprobe] int32
     queries:     [b, d]
-    Returns (distances [b, k], slots [b, k] int32, -1 for missing).
+    Returns raw SCORES (descending-better) + slots — shard_map-safe (no
+    jit, no distance conversion) so the mesh-sharded IVF can merge scores
+    across shards before converting; `_ivf_scan_kernel` is the single-
+    device jitted wrapper.
     """
     b = queries.shape[0]
     nprobe = probes.shape[1]
@@ -149,6 +154,17 @@ def _ivf_scan_kernel(
         jnp.full((b, k), -1, jnp.int32),
     )
     (vals, slots), _ = jax.lax.scan(body, init, jnp.arange(nprobe))
+    return vals, slots
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _ivf_scan_kernel(
+    buckets, bucket_sqnorm, bucket_valid, bucket_slot, probes, queries, k, metric
+):
+    vals, slots = ivf_scan_scores(
+        buckets, bucket_sqnorm, bucket_valid, bucket_slot, probes, queries,
+        k, metric,
+    )
     return scores_to_distances(vals, metric), slots
 
 

@@ -43,16 +43,23 @@ def _build(lib: str, src: str) -> str:
         with open(stamp) as f:
             have = f.read().strip()
     if not os.path.exists(path) or have != want:
+        # build to a private temp then os.replace: concurrent importers
+        # (pytest -n, two servers on one checkout) must never dlopen a
+        # half-written .so
+        tmp = f"{path}.build.{os.getpid()}"
         subprocess.run(
             [
                 "g++", "-O3", "-std=c++17", "-fPIC", "-shared",
-                "-march=native", srcpath, "-o", path,
+                "-march=native", srcpath, "-o", tmp,
             ],
             check=True,
             capture_output=True,
         )
-        with open(stamp, "w") as f:
+        tmp_stamp = f"{stamp}.{os.getpid()}"
+        with open(tmp_stamp, "w") as f:
             f.write(want)
+        os.replace(tmp, path)
+        os.replace(tmp_stamp, stamp)
     return path
 
 

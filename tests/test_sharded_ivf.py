@@ -1,0 +1,251 @@
+"""TpuShardedIvfFlat: mesh-sharded IVF_FLAT on the 8-device virtual CPU
+mesh — recall/contract parity with the single-device TpuIvfFlat, plus
+serving a region through the grpc service layer with
+FLAGS.use_mesh_sharded_ivf on (round-2 VERDICT item 3: BASELINE config-5
+shape over the mesh)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from dingo_tpu.common.config import FLAGS
+from dingo_tpu.index.base import (
+    FilterSpec,
+    IndexParameter,
+    IndexType,
+    Metric,
+    NotTrained,
+)
+from dingo_tpu.index.ivf_flat import TpuIvfFlat
+from dingo_tpu.parallel.sharded_ivf import TpuShardedIvfFlat
+
+DIM = 48
+NLIST = 24
+
+
+def make(metric=Metric.L2, nlist=NLIST):
+    return TpuShardedIvfFlat(1, IndexParameter(
+        index_type=IndexType.IVF_FLAT, dimension=DIM, metric=metric,
+        ncentroids=nlist, default_nprobe=8,
+    ))
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(7)
+    # clustered corpus: IVF recall is meaningless on i.i.d. gaussian
+    centers = rng.standard_normal((40, DIM), dtype=np.float32)
+    x = centers[rng.integers(0, 40, 5000)] + 0.25 * rng.standard_normal(
+        (5000, DIM)
+    ).astype(np.float32)
+    return np.arange(5000, dtype=np.int64), x
+
+
+def _recall(res, gt, ids):
+    return np.mean(
+        [len(set(r.ids) & set(ids[g])) / len(g) for r, g in zip(res, gt)]
+    )
+
+
+def _gt(x, q, k):
+    d = (q ** 2).sum(1)[:, None] - 2.0 * q @ x.T + (x ** 2).sum(1)[None, :]
+    return np.argsort(d, axis=1)[:, :k]
+
+
+def test_untrained_raises(corpus):
+    ids, x = corpus
+    idx = make()
+    idx.upsert(ids[:100], x[:100])
+    with pytest.raises(NotTrained):
+        idx.search(x[:2], 5)
+
+
+def test_recall_parity_with_single_device(corpus):
+    ids, x = corpus
+    sharded = make()
+    single = TpuIvfFlat(2, IndexParameter(
+        index_type=IndexType.IVF_FLAT, dimension=DIM, ncentroids=NLIST,
+        default_nprobe=8,
+    ))
+    sharded.upsert(ids, x)
+    single.upsert(ids, x)
+    sharded.train()
+    single.train()
+    q = x[:16] + 0.01
+    gt = _gt(x, q, 10)
+    # full-probe search must be exact on both
+    r_sh = _recall(sharded.search(q, 10, nprobe=NLIST), gt, ids)
+    r_si = _recall(single.search(q, 10, nprobe=NLIST), gt, ids)
+    assert r_sh == 1.0 and r_si == 1.0
+    # partial-probe recall in the same ballpark (different k-means seeds
+    # on different data layouts -> not identical, both should be high)
+    r_sh8 = _recall(sharded.search(q, 10, nprobe=8), gt, ids)
+    assert r_sh8 >= 0.9
+
+
+def test_mutations_after_train(corpus):
+    ids, x = corpus
+    idx = make()
+    idx.upsert(ids[:3000], x[:3000])
+    idx.train()
+    # post-train inserts get assigned and are findable
+    idx.upsert(ids[3000:3200], x[3000:3200])
+    res = idx.search(x[[3100]], 3, nprobe=NLIST)
+    assert res[0].ids[0] == 3100
+    # overwrite moves the vector to the new content's list
+    idx.upsert(ids[[10]], x[[3000]])
+    res = idx.search(x[[3000]], 2, nprobe=NLIST)
+    assert set(res[0].ids[:2]) == {10, 3000}
+    # delete hides
+    idx.delete(ids[[10]])
+    res = idx.search(x[[3000]], 2, nprobe=NLIST)
+    assert 10 not in res[0].ids
+    assert idx.get_count() == 3199
+
+
+def test_growth_preserves_assignments(corpus):
+    ids, x = corpus
+    idx = make()
+    idx.upsert(ids[:600], x[:600])
+    idx.train()
+    before = idx.search(x[[50]], 3, nprobe=NLIST)[0].ids[0]
+    # force capacity growth (doubling + gslot remap)
+    idx.upsert(ids[600:4000], x[600:4000])
+    assert idx.search(x[[50]], 3, nprobe=NLIST)[0].ids[0] == before == 50
+    assert idx.search(x[[3500]], 3, nprobe=NLIST)[0].ids[0] == 3500
+
+
+def test_filters(corpus):
+    ids, x = corpus
+    idx = make()
+    idx.upsert(ids, x)
+    idx.train()
+    res = idx.search(x[:4], 5, nprobe=NLIST,
+                     filter_spec=FilterSpec(ranges=[(100, 200)]))
+    for r in res:
+        assert all(100 <= i < 200 for i in r.ids)
+    res = idx.search(
+        x[[50]], 3, nprobe=NLIST,
+        filter_spec=FilterSpec(include_ids=np.asarray([48, 50, 51], np.int64)),
+    )
+    assert set(res[0].ids) == {48, 50, 51}
+
+
+def test_save_load_roundtrip(tmp_path, corpus):
+    ids, x = corpus
+    idx = make()
+    idx.upsert(ids[:800], x[:800])
+    idx.train()
+    want = [(list(r.ids), np.asarray(r.distances))
+            for r in idx.search(x[:4], 5, nprobe=NLIST)]
+    idx.save(str(tmp_path / "s"))
+    idx2 = make()
+    idx2.load(str(tmp_path / "s"))
+    assert idx2.is_trained()
+    got = [(list(r.ids), np.asarray(r.distances))
+           for r in idx2.search(x[:4], 5, nprobe=NLIST)]
+    for (ai, ad), (bi, bd) in zip(want, got):
+        assert ai == bi
+        np.testing.assert_allclose(ad, bd, rtol=1e-4, atol=1e-4)
+
+
+def test_cosine_metric(corpus):
+    ids, x = corpus
+    idx = make(metric=Metric.COSINE)
+    idx.upsert(ids[:2000], x[:2000])
+    idx.train()
+    res = idx.search(x[:4], 5, nprobe=NLIST)
+    assert [r.ids[0] for r in res] == [0, 1, 2, 3]
+
+
+def test_served_through_service_layer(corpus):
+    """An IVF_FLAT region served sharded over the mesh via IndexService —
+    hybrid shape: train via VectorBuild, scalar post-filtered search."""
+    from dingo_tpu.client import DingoClient
+    from dingo_tpu.coordinator.control import CoordinatorControl
+    from dingo_tpu.coordinator.kv_control import KvControl
+    from dingo_tpu.coordinator.tso import TsoControl
+    from dingo_tpu.engine.raw_engine import MemEngine
+    from dingo_tpu.raft import LocalTransport
+    from dingo_tpu.server import pb
+    from dingo_tpu.server.rpc import DingoServer
+    from dingo_tpu.store.node import StoreNode
+
+    FLAGS.set("use_mesh_sharded_ivf", True)
+    transport = LocalTransport()
+    me = MemEngine()
+    control = CoordinatorControl(me, replication=1)
+    tso = TsoControl(me)
+    kvc = KvControl(me)
+    cs = DingoServer()
+    cs.host_coordinator_role(control, tso, kvc)
+    cport = cs.start()
+    node = StoreNode("s0", transport, control, raft_kw={"seed": 0})
+    srv = DingoServer()
+    srv.host_store_role(node)
+    port = srv.start()
+    node.start_heartbeat(0.1)
+    client = DingoClient(f"127.0.0.1:{cport}", {"s0": f"127.0.0.1:{port}"})
+    try:
+        param = pb.VectorIndexParameter(
+            index_type=pb.VECTOR_INDEX_TYPE_IVF_FLAT, dimension=DIM,
+            metric_type=pb.METRIC_TYPE_L2, ncentroids=16, default_nprobe=16,
+        )
+        client.create_index_region(5, 0, 1 << 30, param)
+        time.sleep(1.0)
+        ids, x = corpus
+        client.vector_add(5, ids[:1200].tolist(), x[:1200],
+                          [{"tag": int(i % 3)} for i in range(1200)])
+        assert client.vector_count(5) == 1200
+        # untrained -> reader brute-force fallback still answers
+        res = client.vector_search(5, x[:2], topk=3)
+        assert [row[0][0] for row in res] == [0, 1]
+        # train through the lifecycle RPC, then the sharded scan serves
+        region = next(r for r in node.meta.get_all_regions()
+                      if r.vector_index_wrapper is not None)
+        assert isinstance(
+            region.vector_index_wrapper.active(), TpuShardedIvfFlat
+        )
+        d = next(dd for dd in client._regions
+                 if dd.index_parameter is not None)
+        assert client._call_leader(
+            d, "IndexService", "VectorBuild", pb.VectorBuildRequest(
+                context=pb.Context(region_id=d.region_id)
+            )
+        ).error.errcode == 0
+        assert region.vector_index_wrapper.active().is_trained()
+        res = client.vector_search(5, x[:4], topk=5)
+        assert [row[0][0] for row in res] == [0, 1, 2, 3]
+        # hybrid: scalar post-filter over the sharded index (BASELINE
+        # config-5 shape: IVF + scalar predicate, QUERY_POST x10 overfetch)
+        from dingo_tpu.raft import wire
+
+        sreq = pb.VectorSearchRequest()
+        sreq.context.region_id = d.region_id
+        for qv in x[:2]:
+            v = sreq.vectors.add()
+            v.values.extend(qv.tolist())
+        sreq.parameter.top_n = 3
+        sreq.parameter.filter = pb.SCALAR_FILTER
+        sreq.parameter.filter_type = pb.QUERY_POST
+        p = sreq.parameter.predicates.add()
+        p.field = "tag"
+        p.op = "eq"
+        p.value = wire.encode_obj(0)
+        resp = client._call_leader(d, "IndexService", "VectorSearch", sreq)
+        assert resp.error.errcode == 0
+        hits = 0
+        for row in resp.batch_results:
+            for item in row.results:
+                assert item.vector.id % 3 == 0
+                hits += 1
+        assert hits > 0
+    finally:
+        FLAGS.set("use_mesh_sharded_ivf", False)
+        client.close()
+        srv.stop()
+        cs.stop()
+        node.stop()

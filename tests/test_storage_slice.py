@@ -231,8 +231,27 @@ def test_kv_surface(stack):
     assert storage.kv_get(region, b"a") is None
     got = storage.kv_scan(region, b"a", b"z")
     assert [k for k, _ in got] == [b"b", b"c"]
-    storage.kv_delete_range(region, [(b"a", b"z")])
+    assert storage.kv_delete_range(region, [(b"a", b"z")]) == 2  # b, c live
     assert storage.kv_scan(region, b"a", b"z") == []
+
+
+def test_kv_delete_range_unbounded_end():
+    """Empty end key = delete to the end (a region with unbounded end_key):
+    the count and the delete must agree — regression for the encoded-b""-
+    sorts-below-everything bug."""
+    raw = MemEngine()
+    engine = MonoStoreEngine(raw)
+    storage = Storage(engine)
+    definition = RegionDefinition(
+        region_id=88, start_key=b"a", end_key=b"",  # unbounded
+        partition_id=1, region_type=RegionType.STORE,
+    )
+    region = Region(definition)
+    storage.kv_put(region, [(b"a", b"1"), (b"m", b"2"), (b"\xffzz", b"3")])
+    assert storage.kv_delete_range(region, [(b"b", b"")]) == 2
+    assert storage.kv_get(region, b"a") == b"1"
+    assert storage.kv_get(region, b"m") is None
+    assert storage.kv_get(region, b"\xffzz") is None
 
 
 def test_meta_manager_recovery(tmp_path):
