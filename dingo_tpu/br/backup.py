@@ -67,11 +67,11 @@ def backup_cluster(coordinator, nodes: Dict[str, object], path: str,
     manifest["skipped_regions"] = skipped
     # schema/table meta (the reference's sql-meta group)
     if meta is not None:
-        from dingo_tpu.coordinator.meta import _table_to_plain
+        from dingo_tpu.common import persist
 
         manifest["schemas"] = meta.get_schemas()
         manifest["tables"] = [
-            _table_to_plain(t)
+            persist.to_plain(t)
             for schema in meta.get_schemas()
             for t in meta.get_tables(schema)
         ]
@@ -169,7 +169,8 @@ def restore_cluster(coordinator, nodes: Dict[str, object], path: str,
     # re-register schema/table meta with remapped region AND table ids
     table_id_map: Dict[int, int] = {}
     if meta is not None and manifest.get("tables") is not None:
-        from dingo_tpu.coordinator.meta import MetaError, _table_from_plain
+        from dingo_tpu.common import persist
+        from dingo_tpu.coordinator.meta import MetaError
 
         for name in manifest.get("schemas", []):
             try:
@@ -177,7 +178,7 @@ def restore_cluster(coordinator, nodes: Dict[str, object], path: str,
             except MetaError:
                 pass  # built-in or already present
         for plain in manifest["tables"]:
-            t = _table_from_plain(_unjson(plain))
+            t = persist.from_plain(_unjson(plain))
             old_table_id = t.table_id
             for p in t.partitions:
                 p.region_id = region_id_map.get(p.region_id, p.region_id)

@@ -7,10 +7,10 @@ with batch allocation, persisted so ids never repeat across restarts.
 
 from __future__ import annotations
 
-import pickle
 import threading
 from typing import Dict, Tuple
 
+from dingo_tpu.common import persist
 from dingo_tpu.engine.raw_engine import CF_META, RawEngine
 
 _PREFIX = b"AUTO_INCR_"
@@ -22,7 +22,7 @@ class AutoIncrementControl:
         self._lock = threading.Lock()
         self._counters: Dict[int, int] = {}
         for k, v in engine.scan(CF_META, _PREFIX, _PREFIX + b"\xff"):
-            self._counters[int(k[len(_PREFIX):])] = pickle.loads(v)
+            self._counters[int(k[len(_PREFIX):])] = persist.loads(v)
 
     def create(self, table_id: int, start_id: int = 1) -> None:
         with self._lock:
@@ -61,5 +61,5 @@ class AutoIncrementControl:
         self.engine.put(
             CF_META,
             _PREFIX + str(table_id).encode(),
-            pickle.dumps(self._counters[table_id]),
+            persist.dumps(self._counters[table_id]),
         )

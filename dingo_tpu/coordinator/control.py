@@ -19,11 +19,11 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-import pickle
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from dingo_tpu.common import persist
 from dingo_tpu.engine.raw_engine import CF_META, RawEngine
 from dingo_tpu.index.base import IndexParameter
 from dingo_tpu.store.region import (
@@ -38,6 +38,7 @@ _PREFIX_IDS = b"COOR_IDS_"
 _KEY_OPS = b"COOR_OPS__"
 
 
+@persist.register
 class StoreState(enum.Enum):
     """pb::common::StoreState."""
 
@@ -45,6 +46,7 @@ class StoreState(enum.Enum):
     OFFLINE = "offline"
 
 
+@persist.register
 class RegionCmdType(enum.Enum):
     """pb::coordinator::RegionCmdType subset (region_controller.h:40-314)."""
 
@@ -61,6 +63,7 @@ class RegionCmdType(enum.Enum):
     SNAPSHOT_VECTOR_INDEX = "snapshot_vector_index"
 
 
+@persist.register
 @dataclasses.dataclass
 class RegionCmd:
     cmd_id: int
@@ -74,6 +77,7 @@ class RegionCmd:
     retries: int = 0
 
 
+@persist.register
 @dataclasses.dataclass
 class StoreInfo:
     store_id: str
@@ -107,24 +111,24 @@ class CoordinatorControl:
 
     # ---------------- persistence (MetaIncrement analog) -------------------
     def _persist(self, key: bytes, value) -> None:
-        self.engine.put(CF_META, key, pickle.dumps(value, protocol=4))
+        self.engine.put(CF_META, key, persist.dumps(value))
 
     def _recover(self) -> None:
         for k, v in self.engine.scan(CF_META, _PREFIX_STORE,
                                      _PREFIX_STORE + b"\xff"):
-            info: StoreInfo = pickle.loads(v)
+            info: StoreInfo = persist.loads(v)
             self.stores[info.store_id] = info
             self.store_ops.setdefault(info.store_id, [])
         for k, v in self.engine.scan(CF_META, _PREFIX_REGION,
                                      _PREFIX_REGION + b"\xff"):
-            definition: RegionDefinition = pickle.loads(v)
+            definition: RegionDefinition = persist.loads(v)
             self.regions[definition.region_id] = definition
         blob = self.engine.get(CF_META, _PREFIX_IDS)
         if blob:
-            self._next_region_id, self._next_cmd_id = pickle.loads(blob)
+            self._next_region_id, self._next_cmd_id = persist.loads(blob)
         blob = self.engine.get(CF_META, _KEY_OPS)
         if blob:
-            self.store_ops, self.region_leaders = pickle.loads(blob)
+            self.store_ops, self.region_leaders = persist.loads(blob)
             # undelivered-but-marked-sent commands are re-sent after a crash
             for q in self.store_ops.values():
                 for c in q:
@@ -248,12 +252,16 @@ class CoordinatorControl:
             # here, under the lock, so concurrent creates cannot both pass.
             # Different types (STORE raw keys vs INDEX/DOCUMENT id windows)
             # share the lexicographic keyspace but route independently.
-            end_eff = end_key or b"\xff" * 16
+            # empty end = truly unbounded (same semantics as
+            # Region.contains_key): [a, "") overlaps ANY range starting
+            # at or after a — a finite sentinel would let a region whose
+            # keys exceed it slip past the check
             for other in self.regions.values():
                 if other.region_type is not region_type:
                     continue
-                o_end = other.end_key or b"\xff" * 16
-                if start_key < o_end and other.start_key < end_eff:
+                if (not other.end_key or start_key < other.end_key) and (
+                    not end_key or other.start_key < end_key
+                ):
                     raise RuntimeError(
                         f"range overlaps region {other.region_id}"
                     )

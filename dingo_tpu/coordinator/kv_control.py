@@ -10,11 +10,11 @@ KvWatchNode closure queue (:47-113).
 from __future__ import annotations
 
 import dataclasses
-import pickle
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from dingo_tpu.common import persist
 from dingo_tpu.engine.raw_engine import CF_META, RawEngine
 
 _PREFIX_KV = b"VKV_"
@@ -22,6 +22,7 @@ _PREFIX_LEASE = b"VLEASE_"
 _KEY_REVISION = b"VKVREV__"  # NOT under VKV_: user keys cannot collide
 
 
+@persist.register
 @dataclasses.dataclass
 class KvItem:
     key: bytes
@@ -32,6 +33,7 @@ class KvItem:
     lease_id: int = 0
 
 
+@persist.register
 @dataclasses.dataclass
 class Lease:
     lease_id: int
@@ -60,16 +62,16 @@ class KvControl:
     def _recover(self) -> None:
         blob = self.engine.get(CF_META, _KEY_REVISION)
         if blob:
-            self._revision = pickle.loads(blob)
+            self._revision = persist.loads(blob)
         for k, v in self.engine.scan(CF_META, _PREFIX_KV, _PREFIX_KV + b"\xff"):
             if k == _KEY_REVISION:
                 continue
-            item: KvItem = pickle.loads(v)
+            item: KvItem = persist.loads(v)
             self._kv[item.key] = item
             self._revision = max(self._revision, item.mod_revision)
         for k, v in self.engine.scan(CF_META, _PREFIX_LEASE,
                                      _PREFIX_LEASE + b"\xff"):
-            lease: Lease = pickle.loads(v)
+            lease: Lease = persist.loads(v)
             self._leases[lease.lease_id] = lease
             self._next_lease = max(self._next_lease, lease.lease_id + 1)
 
@@ -77,16 +79,16 @@ class KvControl:
         """Monotonic across restarts: deletes advance it too, so issued
         revisions are never reused (etcd contract)."""
         self._revision += 1
-        self.engine.put(CF_META, _KEY_REVISION, pickle.dumps(self._revision))
+        self.engine.put(CF_META, _KEY_REVISION, persist.dumps(self._revision))
         return self._revision
 
     def _persist_kv(self, item: KvItem) -> None:
-        self.engine.put(CF_META, _PREFIX_KV + item.key, pickle.dumps(item))
+        self.engine.put(CF_META, _PREFIX_KV + item.key, persist.dumps(item))
 
     def _persist_lease(self, lease: Lease) -> None:
         self.engine.put(
             CF_META, _PREFIX_LEASE + str(lease.lease_id).encode(),
-            pickle.dumps(lease),
+            persist.dumps(lease),
         )
 
     # ---------------- KV ------------------------------------------------------

@@ -20,6 +20,7 @@ import dataclasses
 import threading
 from typing import Dict, List, Optional
 
+from dingo_tpu.common import persist
 from dingo_tpu.coordinator.control import CoordinatorControl
 from dingo_tpu.engine.raw_engine import CF_META, RawEngine
 from dingo_tpu.index import codec as vcodec
@@ -39,6 +40,7 @@ class MetaError(RuntimeError):
     pass
 
 
+@persist.register
 @dataclasses.dataclass
 class ColumnDefinition:
     name: str
@@ -47,6 +49,7 @@ class ColumnDefinition:
     primary: bool = False
 
 
+@persist.register
 @dataclasses.dataclass
 class PartitionDefinition:
     partition_id: int
@@ -59,6 +62,7 @@ class PartitionDefinition:
     region_id: int = 0
 
 
+@persist.register
 @dataclasses.dataclass
 class TableDefinition:
     table_id: int
@@ -71,40 +75,6 @@ class TableDefinition:
     )
     index_parameter: Optional[IndexParameter] = None
     replication: int = 0
-
-
-def _table_to_plain(t: TableDefinition) -> dict:
-    d = dataclasses.asdict(t)
-    d["table_type"] = t.table_type.value
-    if t.index_parameter is not None:
-        p = dataclasses.asdict(t.index_parameter)
-        p["index_type"] = t.index_parameter.index_type.value
-        p["metric"] = t.index_parameter.metric.value
-        d["index_parameter"] = p
-    return d
-
-
-def _table_from_plain(d: dict) -> TableDefinition:
-    from dingo_tpu.index.base import IndexType
-    from dingo_tpu.ops.distance import Metric
-
-    ip = d.get("index_parameter")
-    param = None
-    if ip is not None:
-        ip = dict(ip)
-        ip["index_type"] = IndexType(ip["index_type"])
-        ip["metric"] = Metric(ip["metric"])
-        param = IndexParameter(**ip)
-    return TableDefinition(
-        table_id=d["table_id"],
-        schema_name=d["schema_name"],
-        name=d["name"],
-        table_type=RegionType(d["table_type"]),
-        columns=[ColumnDefinition(**c) for c in d["columns"]],
-        partitions=[PartitionDefinition(**p) for p in d["partitions"]],
-        index_parameter=param,
-        replication=d.get("replication", 0),
-    )
 
 
 class MetaControl:
@@ -133,7 +103,7 @@ class MetaControl:
             self.schemas[wire.decode(v)] = []
         for k, v in self.engine.scan(CF_META, _PREFIX_TABLE,
                                      _PREFIX_TABLE + b"\xff"):
-            t = _table_from_plain(wire.decode(v))
+            t = persist.loads(v)
             self.tables[f"{t.schema_name}.{t.name}"] = t
             self.schemas.setdefault(t.schema_name, []).append(t.name)
 
@@ -145,7 +115,7 @@ class MetaControl:
     def _put_table(self, t: TableDefinition) -> None:
         self.engine.put(
             CF_META, _PREFIX_TABLE + str(t.table_id).encode(),
-            wire.encode(_table_to_plain(t)),
+            persist.dumps(t),
         )
 
     # -- schemas -------------------------------------------------------------

@@ -10,11 +10,11 @@ semantics).
 
 from __future__ import annotations
 
-import pickle
 import threading
 import time
 from typing import Tuple
 
+from dingo_tpu.common import persist
 from dingo_tpu.engine.raw_engine import CF_META, RawEngine
 from dingo_tpu.mvcc.ts_provider import TSO_LOGICAL_BITS, compose_ts
 
@@ -28,7 +28,7 @@ class TsoControl:
         self.engine = engine
         self._lock = threading.Lock()
         blob = engine.get(CF_META, _KEY)
-        persisted = pickle.loads(blob) if blob else 0
+        persisted = persist.loads(blob) if blob else 0
         # never go below the persisted watermark (failover safety)
         self._physical = max(persisted, int(time.time() * 1000))
         self._logical = 0
@@ -38,7 +38,7 @@ class TsoControl:
     def _save_ahead(self) -> None:
         target = self._physical + SAVE_AHEAD_MS
         if target > self._persisted_until:
-            self.engine.put(CF_META, _KEY, pickle.dumps(target))
+            self.engine.put(CF_META, _KEY, persist.dumps(target))
             self._persisted_until = target
 
     def gen_ts(self, count: int = 1) -> Tuple[int, int]:

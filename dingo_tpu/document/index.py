@@ -13,10 +13,11 @@ from __future__ import annotations
 import json
 import math
 import os
-import pickle
 import re
 import threading
 from collections import defaultdict
+
+from dingo_tpu.common import persist
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 _TOKEN_RE = re.compile(r"[a-z0-9]+")
@@ -170,11 +171,11 @@ class DocumentIndex:
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
         with self._lock:
-            blob = pickle.dumps({
+            blob = persist.dumps({
                 "postings": dict(self._postings),
                 "docs": self._docs,
                 "total_tokens": self._total_tokens,
-            }, protocol=4)
+            })
         with open(os.path.join(path, "document.idx"), "wb") as f:
             f.write(blob)
         with open(os.path.join(path, "meta.json"), "w") as f:
@@ -187,7 +188,7 @@ class DocumentIndex:
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
         with open(os.path.join(path, "document.idx"), "rb") as f:
-            state = pickle.loads(f.read())
+            state = persist.loads(f.read())
         with self._lock:
             self.text_fields = meta["text_fields"]
             self.apply_log_id = meta["apply_log_id"]

@@ -10,17 +10,24 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-import pickle
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from dingo_tpu.common import persist
 from dingo_tpu.engine.raw_engine import CF_META, RawEngine
 from dingo_tpu.index import codec as vcodec
-from dingo_tpu.index.base import IndexParameter
+from dingo_tpu.index.base import IndexParameter, IndexType
 from dingo_tpu.index.wrapper import VectorIndexWrapper
+from dingo_tpu.ops.distance import Metric
+
+# typed persistence: only registered types deserialize (common/persist.py)
+persist.register(IndexParameter)
+persist.register(IndexType)
+persist.register(Metric)
 
 
+@persist.register
 class RegionState(enum.Enum):
     """pb::common::StoreRegionState."""
 
@@ -35,12 +42,14 @@ class RegionState(enum.Enum):
     TOMBSTONE = "tombstone"
 
 
+@persist.register
 class RegionType(enum.Enum):
     STORE = "store"
     INDEX = "index"
     DOCUMENT = "document"
 
 
+@persist.register
 @dataclasses.dataclass
 class RegionEpoch:
     """pb::common::RegionEpoch: conf_version bumps on peer changes,
@@ -53,6 +62,7 @@ class RegionEpoch:
         return (self.conf_version, self.version)
 
 
+@persist.register
 @dataclasses.dataclass
 class RegionDefinition:
     """pb::common::RegionDefinition subset."""
@@ -109,19 +119,19 @@ class Region:
 
     def contains_key(self, key: bytes) -> bool:
         s, e = self.range
-        return s <= key < (e or b"\xff" * 16)
+        return s <= key and (not e or key < e)
 
     def id_window(self) -> Tuple[int, int]:
         return vcodec.range_to_vector_ids(*self.range)
 
     def serialize(self) -> bytes:
-        return pickle.dumps(
-            {"definition": self.definition, "state": self.state}, protocol=4
+        return persist.dumps(
+            {"definition": self.definition, "state": self.state}
         )
 
     @classmethod
     def deserialize(cls, blob: bytes) -> "Region":
-        d = pickle.loads(blob)
+        d = persist.loads(blob)
         region = cls(d["definition"])
         region.state = d["state"]
         return region

@@ -1,0 +1,85 @@
+"""Typed persistence codec (common/persist.py): round-trips registered
+types, refuses unregistered/unknown types, and never unpickles by default
+(round-2 VERDICT weak 5: local disk state was pickle => restoring a
+tampered snapshot was arbitrary code execution)."""
+
+import dataclasses
+import pickle
+
+import pytest
+
+from dingo_tpu.common import persist
+from dingo_tpu.index.base import IndexParameter, IndexType
+from dingo_tpu.raft.wire import WireError
+from dingo_tpu.store.region import (
+    Region,
+    RegionDefinition,
+    RegionEpoch,
+    RegionState,
+    RegionType,
+)
+
+
+def test_roundtrip_region_definition():
+    d = RegionDefinition(
+        region_id=7, start_key=b"a", end_key=b"z", partition_id=3,
+        peers=[1, 2, 3], epoch=RegionEpoch(conf_version=2, version=5),
+        region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(
+            index_type=IndexType.IVF_FLAT, dimension=128, ncentroids=64,
+        ),
+    )
+    got = persist.loads(persist.dumps(d))
+    assert got == d
+    assert isinstance(got.region_type, RegionType)
+    assert isinstance(got.index_parameter.index_type, IndexType)
+
+
+def test_roundtrip_non_str_dict_keys():
+    v = {"postings": {3: [1, 2], 9: [0]}, "n": 2}
+    assert persist.loads(persist.dumps(v)) == v
+
+
+def test_region_serialize_roundtrip():
+    region = Region(RegionDefinition(
+        region_id=9, start_key=b"a", end_key=b"", partition_id=0,
+    ))
+    region.state = RegionState.NORMAL
+    got = Region.deserialize(region.serialize())
+    assert got.definition == region.definition
+    assert got.state is RegionState.NORMAL
+
+
+def test_unregistered_type_refused():
+    @dataclasses.dataclass
+    class Rogue:
+        x: int = 1
+
+    with pytest.raises(TypeError, match="not persist.register"):
+        persist.dumps(Rogue())
+
+
+def test_unknown_tag_refused():
+    from dingo_tpu.raft import wire
+
+    blob = wire.encode({"__dc": "OsSystem", "f": {"cmd": "rm -rf /"}})
+    with pytest.raises(WireError, match="unknown dataclass"):
+        persist.loads(blob)
+
+
+def test_pickle_blob_refused_by_default(monkeypatch):
+    monkeypatch.delenv("DINGO_ALLOW_PICKLE_MIGRATION", raising=False)
+    blob = pickle.dumps({"definition": 1})
+    with pytest.raises(WireError, match="typed persist format"):
+        persist.loads(blob)
+
+
+def test_forward_compat_unknown_field_dropped():
+    blob = persist.dumps(RegionEpoch(conf_version=3, version=4))
+    # simulate a future version adding a field
+    from dingo_tpu.raft import wire
+
+    tree = wire.decode(blob)
+    tree["f"]["future_field"] = 42
+    got = persist.loads(wire.encode(tree))
+    assert got == RegionEpoch(conf_version=3, version=4)
