@@ -2,14 +2,28 @@
 
 Reference: src/coordinator/kv_control.{h,cc} + _fsm/_kv/_lease/_watch.cc
 (~6K LoC) — KvRange/KvPut/KvDeleteRange/KvCompaction (kv_control.h:252-291),
-revision model (main revision per raft term + sub revision), LeaseGrant/
-LeaseRevoke (:221-225) with TTL-attached keys, and one-time watches with a
-KvWatchNode closure queue (:47-113).
+revision model, LeaseGrant/LeaseRevoke (:221-225) with TTL-attached keys,
+and one-time watches with a KvWatchNode closure queue (:47-113).
+
+Round-2 VERDICT item 5: the store now keeps PER-KEY REVISION CHAINS (every
+put appends a version, every delete appends a tombstone), so
+
+  - KvRange can read as-of a past revision,
+  - watches can start from a past revision and replay history,
+  - KvCompaction(revision) is real: it drops versions superseded at or
+    below the compaction floor (keeping each key's live base version,
+    etcd semantics) and reads/watches below the floor fail Compacted.
+
+Persistence: every version is a typed-codec blob under an 8-byte
+big-endian revision key (naturally scan-ordered for recovery); the latest
+live version is additionally indexed by key for O(1) point reads after
+recovery. Compaction deletes the superseded version blobs.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import struct
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -17,9 +31,22 @@ from typing import Callable, Dict, List, Optional, Tuple
 from dingo_tpu.common import persist
 from dingo_tpu.engine.raw_engine import CF_META, RawEngine
 
-_PREFIX_KV = b"VKV_"
+_PREFIX_KV = b"VKV_"          # latest live version per key
+_PREFIX_VER = b"VKVV_"        # every version, keyed by revision (8B BE)
 _PREFIX_LEASE = b"VLEASE_"
-_KEY_REVISION = b"VKVREV__"  # NOT under VKV_: user keys cannot collide
+_KEY_REVISION = b"VKVREV__"   # NOT under VKV_: user keys cannot collide
+_KEY_COMPACT = b"VKVCOMPACT__"
+
+
+class CompactedError(KeyError):
+    """Requested revision is below the compaction floor (etcd
+    ErrCompacted)."""
+
+
+class FutureRevError(KeyError):
+    """Requested revision is ahead of the store (etcd ErrFutureRev) — a
+    pinned read served from the future would return different data once
+    the store catches up."""
 
 
 @persist.register
@@ -29,8 +56,12 @@ class KvItem:
     value: bytes
     create_revision: int
     mod_revision: int
-    version: int
+    version: int            # 0 = tombstone (delete event)
     lease_id: int = 0
+
+    @property
+    def is_tombstone(self) -> bool:
+        return self.version == 0
 
 
 @persist.register
@@ -51,7 +82,9 @@ class KvControl:
         self.engine = engine
         self._lock = threading.RLock()
         self._revision = 1
-        self._kv: Dict[bytes, KvItem] = {}
+        self._compact_revision = 0
+        self._kv: Dict[bytes, KvItem] = {}            # latest live version
+        self._history: Dict[bytes, List[KvItem]] = {}  # revision chains
         self._leases: Dict[int, Lease] = {}
         self._next_lease = 1
         #: one-time watches: key -> [(watch_revision, callback)]
@@ -63,12 +96,34 @@ class KvControl:
         blob = self.engine.get(CF_META, _KEY_REVISION)
         if blob:
             self._revision = persist.loads(blob)
+        blob = self.engine.get(CF_META, _KEY_COMPACT)
+        if blob:
+            self._compact_revision = persist.loads(blob)
+        # version log first (revision-ordered by key layout)
+        for k, v in self.engine.scan(CF_META, _PREFIX_VER,
+                                     _PREFIX_VER + b"\xff"):
+            item: KvItem = persist.loads(v)
+            self._history.setdefault(item.key, []).append(item)
+            self._revision = max(self._revision, item.mod_revision)
+        for chain in self._history.values():
+            chain.sort(key=lambda i: i.mod_revision)
+        # latest-live index; also seeds chains for pre-history state
+        # (a round-2 snapshot has _PREFIX_KV entries but no version log)
         for k, v in self.engine.scan(CF_META, _PREFIX_KV, _PREFIX_KV + b"\xff"):
             if k == _KEY_REVISION:
                 continue
-            item: KvItem = persist.loads(v)
+            item = persist.loads(v)
             self._kv[item.key] = item
             self._revision = max(self._revision, item.mod_revision)
+            chain = self._history.setdefault(item.key, [])
+            if not any(c.mod_revision == item.mod_revision for c in chain):
+                chain.append(item)
+                chain.sort(key=lambda i: i.mod_revision)
+                # write-through so the seeded version survives the NEXT
+                # restart even after _PREFIX_KV is overwritten (and so
+                # compaction's per-blob delete accounting stays exact)
+                self.engine.put(CF_META, self._ver_key(item.mod_revision),
+                                persist.dumps(item))
         for k, v in self.engine.scan(CF_META, _PREFIX_LEASE,
                                      _PREFIX_LEASE + b"\xff"):
             lease: Lease = persist.loads(v)
@@ -81,6 +136,14 @@ class KvControl:
         self._revision += 1
         self.engine.put(CF_META, _KEY_REVISION, persist.dumps(self._revision))
         return self._revision
+
+    def _ver_key(self, revision: int) -> bytes:
+        return _PREFIX_VER + struct.pack(">Q", revision)
+
+    def _append_version(self, item: KvItem) -> None:
+        self._history.setdefault(item.key, []).append(item)
+        self.engine.put(CF_META, self._ver_key(item.mod_revision),
+                        persist.dumps(item))
 
     def _persist_kv(self, item: KvItem) -> None:
         self.engine.put(CF_META, _PREFIX_KV + item.key, persist.dumps(item))
@@ -114,21 +177,55 @@ class KvControl:
             )
             self._kv[key] = item
             self._persist_kv(item)
+            self._append_version(item)
             self._fire_watches(key, "put", item)
             return self._revision
 
+    def _as_of(self, key: bytes, revision: int) -> Optional[KvItem]:
+        """Newest live version of key with mod_revision <= revision."""
+        chain = self._history.get(key)
+        if not chain:
+            return None
+        best = None
+        for item in chain:
+            if item.mod_revision > revision:
+                break
+            best = item
+        if best is None or best.is_tombstone:
+            return None
+        return best
+
     def kv_range(self, start: bytes, end: Optional[bytes] = None,
-                 limit: int = 0) -> Tuple[List[KvItem], int]:
-        """KvRange: [start, end) or exact key when end is None."""
+                 limit: int = 0, revision: int = 0) -> Tuple[List[KvItem], int]:
+        """KvRange: [start, end) or exact key when end is None. With
+        revision > 0, reads as of that PAST revision (etcd range
+        revision); below the compaction floor raises CompactedError."""
         with self._lock:
             self._expire_leases()
-            if end is None:
-                item = self._kv.get(start)
-                return ([item] if item else [], self._revision)
-            out = [
-                item for k, item in sorted(self._kv.items())
-                if start <= k < end
-            ]
+            if revision and revision < self._compact_revision:
+                raise CompactedError(
+                    f"revision {revision} compacted "
+                    f"(floor {self._compact_revision})"
+                )
+            if revision > self._revision:
+                raise FutureRevError(
+                    f"revision {revision} > current {self._revision}"
+                )
+            if revision == 0 or revision == self._revision:
+                if end is None:
+                    item = self._kv.get(start)
+                    return ([item] if item else [], self._revision)
+                out = [
+                    item for k, item in sorted(self._kv.items())
+                    if start <= k < end
+                ]
+            else:
+                keys = (
+                    [start] if end is None
+                    else sorted(k for k in self._history if start <= k < end)
+                )
+                out = [i for i in (self._as_of(k, revision) for k in keys)
+                       if i is not None]
             if limit:
                 out = out[:limit]
             return out, self._revision
@@ -145,17 +242,49 @@ class KvControl:
                 item = self._kv.pop(k, None)
                 if item is None:
                     continue
-                self._bump_revision()
+                rev = self._bump_revision()
                 n += 1
                 self.engine.delete(CF_META, _PREFIX_KV + k)
-                self._fire_watches(k, "delete", item)
+                tomb = KvItem(key=k, value=b"", create_revision=0,
+                              mod_revision=rev, version=0)
+                self._append_version(tomb)
+                self._fire_watches(k, "delete", tomb)
             return n
 
     def kv_compaction(self, revision: int) -> int:
-        """KvCompaction (kv_control.h:291): our store keeps only the latest
-        version per key, so compaction just reports the floor."""
+        """KvCompaction (kv_control.h:287): drop versions superseded at or
+        below `revision`. Each key keeps its newest version <= revision iff
+        live (the base state readers at `revision` still need); tombstones
+        at/below the floor and everything they superseded are dropped.
+        Returns the number of versions removed."""
         with self._lock:
-            return self._revision
+            revision = min(revision, self._revision)
+            if revision <= self._compact_revision:
+                return 0
+            removed = 0
+            for key in list(self._history):
+                chain = self._history[key]
+                below = [i for i in chain if i.mod_revision <= revision]
+                above = [i for i in chain if i.mod_revision > revision]
+                keep_base = (
+                    [below[-1]] if below and not below[-1].is_tombstone
+                    else []
+                )
+                for item in below:
+                    if keep_base and item is keep_base[0]:
+                        continue
+                    self.engine.delete(
+                        CF_META, self._ver_key(item.mod_revision)
+                    )
+                    removed += 1
+                new_chain = keep_base + above
+                if new_chain:
+                    self._history[key] = new_chain
+                else:
+                    del self._history[key]
+            self._compact_revision = revision
+            self.engine.put(CF_META, _KEY_COMPACT, persist.dumps(revision))
+            return removed
 
     # ---------------- leases --------------------------------------------------
     def lease_grant(self, ttl_s: int, lease_id: int = 0) -> Lease:
@@ -202,14 +331,37 @@ class KvControl:
     # ---------------- watches -------------------------------------------------
     def watch(self, key: bytes, start_revision: int,
               callback: Callable[[str, KvItem], None]) -> None:
-        """One-time watch (kv_control.h:47-113): callback fires once on the
-        next event for `key` at/after start_revision, then unregisters."""
+        """One-time watch (kv_control.h:47-113): fires once with the OLDEST
+        event for `key` at/after start_revision — replayed from the
+        revision chain when it already happened — then unregisters.
+        start_revision at/below the compaction floor raises
+        CompactedError when the needed history is gone."""
         with self._lock:
-            item = self._kv.get(key)
-            if item is not None and item.mod_revision >= start_revision:
-                callback("put", item)   # immediate catch-up fire
-                return
+            if start_revision <= self._compact_revision:
+                # etcd-strict (<=, not <): compaction drops tombstone
+                # events at exactly the floor, so a watch from the floor
+                # could silently miss a delete — cancel with Compacted
+                raise CompactedError(
+                    f"watch from {start_revision} compacted "
+                    f"(floor {self._compact_revision})"
+                )
+            chain = self._history.get(key, [])
+            for item in chain:
+                if item.mod_revision >= start_revision:
+                    callback("delete" if item.is_tombstone else "put", item)
+                    return
             self._watches.setdefault(key, []).append((start_revision, callback))
+
+    def cancel_watch(self, key: bytes, callback: Callable) -> bool:
+        with self._lock:
+            entries = self._watches.get(key, [])
+            for pair in entries:
+                if pair[1] is callback:
+                    entries.remove(pair)
+                    if not entries:
+                        self._watches.pop(key, None)
+                    return True
+            return False
 
     def _fire_watches(self, key: bytes, event: str, item: KvItem) -> None:
         keep = []

@@ -160,7 +160,14 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
     "VersionService": {
         "VKvPut": (pb.VKvPutRequest, pb.VKvPutResponse),
         "VKvRange": (pb.VKvRangeRequest, pb.VKvRangeResponse),
+        "VKvDeleteRange": (
+            pb.VKvDeleteRangeRequest, pb.VKvDeleteRangeResponse,
+        ),
+        "VKvCompaction": (pb.VKvCompactionRequest, pb.VKvCompactionResponse),
+        "VKvWatch": (pb.VKvWatchRequest, pb.VKvWatchResponse),
         "LeaseGrant": (pb.LeaseGrantRequest, pb.LeaseGrantResponse),
+        "LeaseRenew": (pb.LeaseRenewRequest, pb.LeaseRenewResponse),
+        "LeaseRevoke": (pb.LeaseRevokeRequest, pb.LeaseRevokeResponse),
     },
 }
 

@@ -1112,7 +1112,10 @@ class VersionService:
     """etcd-like KV (version_service.cc analog over KvControl)."""
 
     def __init__(self, kv: KvControl):
+        import threading
+
         self.kv = kv
+        self._watch_slots = threading.Semaphore(self._MAX_BLOCKED_WATCHES)
 
     def VKvPut(self, req: pb.VKvPutRequest) -> pb.VKvPutResponse:
         resp = pb.VKvPutResponse()
@@ -1122,24 +1125,106 @@ class VersionService:
             return _err(resp, 70001, str(e))
         return resp
 
+    @staticmethod
+    def _item_to_pb(it, o) -> None:
+        o.key = it.key
+        o.value = it.value
+        o.create_revision = it.create_revision
+        o.mod_revision = it.mod_revision
+        o.version = it.version
+
     def VKvRange(self, req: pb.VKvRangeRequest) -> pb.VKvRangeResponse:
-        resp = pb.VKvRangeResponse()
-        items, rev = self.kv.kv_range(
-            req.start, req.end or None, limit=req.limit
+        from dingo_tpu.coordinator.kv_control import (
+            CompactedError,
+            FutureRevError,
         )
+
+        resp = pb.VKvRangeResponse()
+        try:
+            items, rev = self.kv.kv_range(
+                req.start, req.end or None, limit=req.limit,
+                revision=req.revision,
+            )
+        except CompactedError as e:
+            return _err(resp, 70002, str(e))
+        except FutureRevError as e:
+            return _err(resp, 70003, str(e))
         resp.revision = rev
         for it in items:
-            o = resp.items.add()
-            o.key = it.key
-            o.value = it.value
-            o.create_revision = it.create_revision
-            o.mod_revision = it.mod_revision
-            o.version = it.version
+            self._item_to_pb(it, resp.items.add())
+        return resp
+
+    def VKvDeleteRange(self, req: pb.VKvDeleteRangeRequest):
+        resp = pb.VKvDeleteRangeResponse()
+        resp.deleted = self.kv.kv_delete_range(req.start, req.end or None)
+        return resp
+
+    def VKvCompaction(self, req: pb.VKvCompactionRequest):
+        """KvCompaction RPC (kv_control.h:287)."""
+        resp = pb.VKvCompactionResponse()
+        resp.removed_versions = self.kv.kv_compaction(req.revision)
+        resp.compact_revision = self.kv._compact_revision
+        return resp
+
+    #: cap on concurrently BLOCKED watch polls: the grpc pool is shared
+    #: with the puts that would wake the watchers, so unbounded long-polls
+    #: could starve the writers and wedge the server
+    _MAX_BLOCKED_WATCHES = 8
+
+    def VKvWatch(self, req: pb.VKvWatchRequest) -> pb.VKvWatchResponse:
+        """One-time watch with history replay (kv_control.h:47-113):
+        events at/after start_revision fire immediately from the revision
+        chain; otherwise long-poll up to timeout_ms. Unset start_revision
+        means "from now" (etcd watch semantics), NOT from history."""
+        import threading
+
+        from dingo_tpu.coordinator.kv_control import CompactedError
+
+        resp = pb.VKvWatchResponse()
+        fired = threading.Event()
+        holder = {}
+
+        def cb(event, item):
+            holder["event"], holder["item"] = event, item
+            fired.set()
+
+        start = req.start_revision or (self.kv._revision + 1)
+        try:
+            self.kv.watch(req.key, start, cb)
+        except CompactedError as e:
+            return _err(resp, 70002, str(e))
+        if not fired.is_set() and req.timeout_ms:
+            if not self._watch_slots.acquire(blocking=False):
+                self.kv.cancel_watch(req.key, cb)
+                return _err(resp, 70004, "too many blocked watchers")
+            try:
+                fired.wait(req.timeout_ms / 1000.0)
+            finally:
+                self._watch_slots.release()
+        if fired.is_set():
+            resp.fired = True
+            resp.event = holder["event"]
+            self._item_to_pb(holder["item"], resp.item)
+        else:
+            self.kv.cancel_watch(req.key, cb)
         return resp
 
     def LeaseGrant(self, req: pb.LeaseGrantRequest) -> pb.LeaseGrantResponse:
         resp = pb.LeaseGrantResponse()
         resp.lease_id = self.kv.lease_grant(req.ttl_s).lease_id
+        return resp
+
+    def LeaseRenew(self, req: pb.LeaseRenewRequest):
+        resp = pb.LeaseRenewResponse()
+        try:
+            resp.ttl_s = self.kv.lease_renew(req.lease_id).ttl_s
+        except KeyError as e:
+            return _err(resp, 70001, str(e))
+        return resp
+
+    def LeaseRevoke(self, req: pb.LeaseRevokeRequest):
+        resp = pb.LeaseRevokeResponse()
+        resp.deleted = self.kv.lease_revoke(req.lease_id)
         return resp
 
 
