@@ -39,6 +39,10 @@ class DingoClient:
         self._channels: Dict[str, grpc.Channel] = {}
         self._regions: List = []           # RegionDefinition list
         self._leader_hint: Dict[int, str] = {}
+        self._table_cache: Dict[str, object] = {}
+        self._cache_gen = 0   # bumped by every watcher invalidation
+        self._meta_watch_thread = None
+        self._meta_watch_stop = None
 
     # ---------------- plumbing ----------------
     def _stub(self, store_id: str, service: str) -> ServiceStub:
@@ -151,10 +155,86 @@ class DingoClient:
         self.refresh_region_map()
         return resp.definition
 
-    def get_table(self, schema: str, name: str):
+    def get_table(self, schema: str, name: str, cached: bool = False):
+        """cached=True serves from the SDK table cache (filled on miss).
+        Start the meta watcher (start_meta_watch) to have the cache
+        invalidate on coordinator-pushed change events instead of
+        serving stale definitions forever."""
+        if cached:
+            key = f"{schema}.{name}"
+            hit = self._table_cache.get(key)
+            if hit is not None:
+                return hit
+        gen = self._cache_gen
         resp = self.meta.GetTable(pb.GetTableRequest(
             schema_name=schema, table_name=name))
-        return resp.definition if resp.found else None
+        t = resp.definition if resp.found else None
+        # only cache if no invalidation raced the RPC: a drop event
+        # processed mid-flight must not be overwritten by the stale reply
+        if cached and t is not None and gen == self._cache_gen:
+            self._table_cache[f"{schema}.{name}"] = t
+        return t
+
+    def start_meta_watch(self, poll_timeout_ms: int = 2000) -> None:
+        """Background long-poll on MetaWatch: each schema/table change
+        event invalidates the SDK table cache (and the region map on
+        table create/drop) — the reference SDK's meta-watch cache story
+        without client polling of table definitions."""
+        import threading
+
+        if self._meta_watch_thread is not None:
+            return
+        self._meta_watch_stop = threading.Event()
+
+        def loop():
+            start = 0   # 0 = from now (server fills current+1)
+            while not self._meta_watch_stop.is_set():
+                try:
+                    resp = self.meta.MetaWatch(pb.MetaWatchRequest(
+                        start_revision=start,
+                        timeout_ms=poll_timeout_ms,
+                    ))
+                except Exception:
+                    self._meta_watch_stop.wait(0.5)
+                    continue
+                if resp.error.errcode:
+                    # e.g. watcher slots exhausted — back off, don't hammer
+                    self._meta_watch_stop.wait(0.5)
+                    continue
+                # ALWAYS pin the window: a timed-out poll reports where it
+                # watched up to, so events landing between polls replay on
+                # the next call instead of being skipped by "from now"
+                start = resp.revision + 1
+                if not resp.fired:
+                    continue
+                self._cache_gen += 1
+                if resp.event == "resync":
+                    self._table_cache.clear()
+                    # the lost events may include table create/drop
+                    try:
+                        self.refresh_region_map()
+                    except Exception:
+                        pass
+                    continue
+                key = f"{resp.schema_name}.{resp.table_name}"
+                self._table_cache.pop(key, None)
+                if resp.event in ("create_table", "drop_table"):
+                    try:
+                        self.refresh_region_map()
+                    except Exception:
+                        pass
+
+        self._meta_watch_thread = threading.Thread(
+            target=loop, daemon=True, name="meta-watch"
+        )
+        self._meta_watch_thread.start()
+
+    def stop_meta_watch(self) -> None:
+        if self._meta_watch_thread is None:
+            return
+        self._meta_watch_stop.set()
+        self._meta_watch_thread.join(timeout=5)
+        self._meta_watch_thread = None
 
     def list_tables(self, schema: str):
         return list(self.meta.GetTables(
@@ -317,6 +397,7 @@ class DingoClient:
         return resp.value if resp.found else None
 
     def close(self) -> None:
+        self.stop_meta_watch()
         self._coord_channel.close()
         for chan in self._channels.values():
             chan.close()

@@ -109,7 +109,11 @@ class KvControl:
             chain.sort(key=lambda i: i.mod_revision)
         # latest-live index; also seeds chains for pre-history state
         # (a round-2 snapshot has _PREFIX_KV entries but no version log)
-        for k, v in self.engine.scan(CF_META, _PREFIX_KV, _PREFIX_KV + b"\xff"):
+        # materialized: the loop writes version blobs into the SAME CF,
+        # and mutating under a live scan generator double-yields keys
+        for k, v in list(
+            self.engine.scan(CF_META, _PREFIX_KV, _PREFIX_KV + b"\xff")
+        ):
             if k == _KEY_REVISION:
                 continue
             item = persist.loads(v)

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from dingo_tpu.common import persist
 from dingo_tpu.coordinator.control import CoordinatorControl
@@ -31,6 +31,11 @@ from dingo_tpu.store.region import RegionType
 _PREFIX_SCHEMA = b"meta/schema/"
 _PREFIX_TABLE = b"meta/table/"
 _KEY_TABLE_ID = b"meta/next_table_id"
+_KEY_META_REV = b"meta/revision"
+
+#: in-memory meta-event ring size; watchers older than the ring get a
+#: resync signal instead of replay (meta churn is low, 1024 is ~forever)
+_EVENT_RING = 1024
 
 #: reference's built-in schemas (coordinator seeds root/meta/dingo)
 DEFAULT_SCHEMAS = ("root", "meta", "dingo")
@@ -88,6 +93,14 @@ class MetaControl:
         self.tables: Dict[str, TableDefinition] = {}  # "schema.table" -> def
         self._creating: set = set()   # names reserved by in-flight creates
         self._next_table_id = 1
+        #: meta-watch state (reference meta-watch RPCs + crontab entry,
+        #: src/server/meta_service.cc; server.cc:506-700): change events
+        #: carry a monotonic meta revision so SDK caches can invalidate
+        #: without polling. The ring is memory-only — a restarted
+        #: coordinator replays nothing and watchers resync.
+        self._meta_revision = 1
+        self._events: List[dict] = []
+        self._watchers: List[Tuple[int, Callable[[dict], None]]] = []
         self._recover()
         for s in DEFAULT_SCHEMAS:
             if s not in self.schemas:
@@ -98,6 +111,11 @@ class MetaControl:
         blob = self.engine.get(CF_META, _KEY_TABLE_ID)
         if blob:
             self._next_table_id = wire.decode(blob)
+        blob = self.engine.get(CF_META, _KEY_META_REV)
+        if blob:
+            # revision survives restart (monotonic); the event ring does
+            # not — watchers from pre-restart revisions get a resync
+            self._meta_revision = wire.decode(blob)
         for k, v in self.engine.scan(CF_META, _PREFIX_SCHEMA,
                                      _PREFIX_SCHEMA + b"\xff"):
             self.schemas[wire.decode(v)] = []
@@ -126,6 +144,7 @@ class MetaControl:
             if name in self.schemas:
                 raise MetaError(f"schema {name!r} exists")
             self._put_schema(name)
+            self._emit("create_schema", name)
 
     def drop_schema(self, name: str) -> None:
         with self._lock:
@@ -138,6 +157,7 @@ class MetaControl:
                 raise MetaError(f"schema {name!r} is built-in")
             del self.schemas[name]
             self.engine.delete(CF_META, _PREFIX_SCHEMA + name.encode())
+            self._emit("drop_schema", name)
 
     def get_schemas(self) -> List[str]:
         with self._lock:
@@ -216,6 +236,7 @@ class MetaControl:
             self.tables[key] = t
             self.schemas[schema_name].append(name)
             self._put_table(t)
+            self._emit("create_table", schema_name, name, t.table_id)
         return t
 
     def import_table(self, t: TableDefinition) -> TableDefinition:
@@ -236,6 +257,7 @@ class MetaControl:
             self.tables[key] = t
             self.schemas[t.schema_name].append(t.name)
             self._put_table(t)
+            self._emit("create_table", t.schema_name, t.name, t.table_id)
         return t
 
     def drop_table(self, schema_name: str, name: str) -> None:
@@ -249,6 +271,7 @@ class MetaControl:
             self.engine.delete(
                 CF_META, _PREFIX_TABLE + str(t.table_id).encode()
             )
+            self._emit("drop_table", schema_name, name, t.table_id)
         for p in t.partitions:
             self.control.drop_region(p.region_id)
 
@@ -260,3 +283,73 @@ class MetaControl:
         with self._lock:
             return [t for t in self.tables.values()
                     if t.schema_name == schema_name]
+
+    # -- meta watch (meta_service.cc meta-watch analog) ----------------------
+    @property
+    def meta_revision(self) -> int:
+        with self._lock:
+            return self._meta_revision
+
+    def _emit(self, event: str, schema: str, table: str = "",
+              table_id: int = 0) -> None:
+        """Record + fan out one change event. Caller holds self._lock."""
+        self._meta_revision += 1
+        self.engine.put(CF_META, _KEY_META_REV,
+                        wire.encode(self._meta_revision))
+        ev = {
+            "event": event,
+            "schema": schema,
+            "table": table,
+            "table_id": table_id,
+            "revision": self._meta_revision,
+        }
+        self._events.append(ev)
+        if len(self._events) > _EVENT_RING:
+            del self._events[: len(self._events) - _EVENT_RING]
+        still_waiting = []
+        for start, cb in self._watchers:
+            if ev["revision"] >= start:
+                try:
+                    cb(ev)
+                except Exception:
+                    pass
+            else:
+                still_waiting.append((start, cb))
+        self._watchers = still_waiting
+
+    def watch(self, start_revision: int,
+              callback: Callable[[dict], None]) -> None:
+        """One-time meta watch: fires with the OLDEST event at/after
+        start_revision (replayed from the ring when already past), a
+        {"event": "resync"} signal when that history is gone (restart or
+        ring overflow — re-list and watch from the current revision), or
+        registers for the next future event."""
+        with self._lock:
+            if start_revision <= self._meta_revision:
+                # replay only when the ring still covers [start, now] —
+                # revisions are contiguous, so a first retained event
+                # above start means events were evicted (or predate this
+                # process) and a partial replay would silently lose them
+                if self._events and \
+                        self._events[0]["revision"] <= start_revision:
+                    for ev in self._events:
+                        if ev["revision"] >= start_revision:
+                            callback(ev)
+                            return
+                callback({
+                    "event": "resync",
+                    "schema": "",
+                    "table": "",
+                    "table_id": 0,
+                    "revision": self._meta_revision,
+                })
+                return
+            self._watchers.append((start_revision, callback))
+
+    def cancel_watch(self, callback: Callable) -> bool:
+        with self._lock:
+            for pair in self._watchers:
+                if pair[1] is callback:
+                    self._watchers.remove(pair)
+                    return True
+            return False

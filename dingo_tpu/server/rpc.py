@@ -98,6 +98,7 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "DropTable": (pb.DropTableRequest, pb.DropTableResponse),
         "GetTable": (pb.GetTableRequest, pb.GetTableResponse),
         "GetTables": (pb.GetTablesRequest, pb.GetTablesResponse),
+        "MetaWatch": (pb.MetaWatchRequest, pb.MetaWatchResponse),
     },
     "UtilService": {
         "VectorCalcDistance": (pb.VectorCalcDistanceRequest, pb.VectorCalcDistanceResponse),

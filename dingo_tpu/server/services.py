@@ -23,7 +23,11 @@ import numpy as np
 from dingo_tpu.common.failpoint import FAILPOINTS
 from dingo_tpu.common.metrics import METRICS
 from dingo_tpu.coordinator.control import CoordinatorControl, RegionCmd, RegionCmdType
-from dingo_tpu.coordinator.kv_control import KvControl
+from dingo_tpu.coordinator.kv_control import (
+    CompactedError,
+    FutureRevError,
+    KvControl,
+)
 from dingo_tpu.coordinator.tso import TsoControl
 from dingo_tpu.engine.txn import Mutation, Op, TxnEngine, TxnError
 from dingo_tpu.index.base import VectorIndexError
@@ -38,6 +42,42 @@ def _err(resp, code: int, msg: str):
     resp.error.errcode = code
     resp.error.errmsg = msg
     return resp
+
+
+#: server-side ceiling on a single long-poll: a blocked watch holds a
+#: semaphore slot AND a grpc pool thread, so the duration must not be
+#: client-chosen-unbounded
+_MAX_WATCH_TIMEOUT_MS = 30_000
+
+
+def _long_poll_watch(register_fn, cancel_fn, slots, timeout_ms):
+    """Shared one-shot watch harness (VKvWatch + MetaWatch): register a
+    callback that may fire immediately (replay), else block up to the
+    clamped timeout while holding a bounded slot.
+
+    Returns (event_args tuple | None, "busy" | None). register_fn may
+    raise (e.g. CompactedError) — callers map that to their error code."""
+    fired = threading.Event()
+    holder = {}
+
+    def cb(*args):
+        holder["args"] = args
+        fired.set()
+
+    register_fn(cb)
+    timeout_ms = min(int(timeout_ms or 0), _MAX_WATCH_TIMEOUT_MS)
+    if not fired.is_set() and timeout_ms:
+        if not slots.acquire(blocking=False):
+            cancel_fn(cb)
+            return None, "busy"
+        try:
+            fired.wait(timeout_ms / 1000.0)
+        finally:
+            slots.release()
+    if fired.is_set():
+        return holder["args"], None
+    cancel_fn(cb)
+    return None, None
 
 
 def _rebuild_region(node: StoreNode, region: Region) -> None:
@@ -1134,11 +1174,6 @@ class VersionService:
         o.version = it.version
 
     def VKvRange(self, req: pb.VKvRangeRequest) -> pb.VKvRangeResponse:
-        from dingo_tpu.coordinator.kv_control import (
-            CompactedError,
-            FutureRevError,
-        )
-
         resp = pb.VKvRangeResponse()
         try:
             items, rev = self.kv.kv_range(
@@ -1176,37 +1211,23 @@ class VersionService:
         events at/after start_revision fire immediately from the revision
         chain; otherwise long-poll up to timeout_ms. Unset start_revision
         means "from now" (etcd watch semantics), NOT from history."""
-        import threading
-
-        from dingo_tpu.coordinator.kv_control import CompactedError
-
         resp = pb.VKvWatchResponse()
-        fired = threading.Event()
-        holder = {}
-
-        def cb(event, item):
-            holder["event"], holder["item"] = event, item
-            fired.set()
-
         start = req.start_revision or (self.kv._revision + 1)
         try:
-            self.kv.watch(req.key, start, cb)
+            args, busy = _long_poll_watch(
+                lambda cb: self.kv.watch(req.key, start, cb),
+                lambda cb: self.kv.cancel_watch(req.key, cb),
+                self._watch_slots, req.timeout_ms,
+            )
         except CompactedError as e:
             return _err(resp, 70002, str(e))
-        if not fired.is_set() and req.timeout_ms:
-            if not self._watch_slots.acquire(blocking=False):
-                self.kv.cancel_watch(req.key, cb)
-                return _err(resp, 70004, "too many blocked watchers")
-            try:
-                fired.wait(req.timeout_ms / 1000.0)
-            finally:
-                self._watch_slots.release()
-        if fired.is_set():
+        if busy:
+            return _err(resp, 70004, "too many blocked watchers")
+        if args is not None:
+            event, item = args
             resp.fired = True
-            resp.event = holder["event"]
-            self._item_to_pb(holder["item"], resp.item)
-        else:
-            self.kv.cancel_watch(req.key, cb)
+            resp.event = event
+            self._item_to_pb(item, resp.item)
         return resp
 
     def LeaseGrant(self, req: pb.LeaseGrantRequest) -> pb.LeaseGrantResponse:
@@ -1231,10 +1252,17 @@ class VersionService:
 class MetaService:
     """Schema/table meta RPCs (reference src/server/meta_service.cc)."""
 
+    #: same rationale as VersionService: blocked long-polls must not be
+    #: able to occupy the whole shared grpc pool
+    _MAX_BLOCKED_WATCHES = 8
+
     def __init__(self, meta):
+        import threading
+
         from dingo_tpu.coordinator.meta import MetaControl
 
         self.meta: MetaControl = meta
+        self._watch_slots = threading.Semaphore(self._MAX_BLOCKED_WATCHES)
 
     @staticmethod
     def _table_to_pb(t, out) -> None:
@@ -1347,6 +1375,35 @@ class MetaService:
         resp = pb.GetTablesResponse()
         for t in self.meta.get_tables(req.schema_name):
             self._table_to_pb(t, resp.definitions.add())
+        return resp
+
+    def MetaWatch(self, req: pb.MetaWatchRequest) -> pb.MetaWatchResponse:
+        """Meta-watch RPC (meta_service.cc analog): one-shot schema/table
+        change event with replay, or long-poll up to timeout_ms. Unset
+        start_revision = from now. A timed-out response still carries the
+        current revision so the next poll can pin its window (events
+        between polls must not be lost)."""
+        resp = pb.MetaWatchResponse()
+        start = req.start_revision or (self.meta.meta_revision + 1)
+        args, busy = _long_poll_watch(
+            lambda cb: self.meta.watch(start, cb),
+            lambda cb: self.meta.cancel_watch(cb),
+            self._watch_slots, req.timeout_ms,
+        )
+        if busy:
+            return _err(resp, 70004, "too many blocked watchers")
+        if args is not None:
+            (ev,) = args
+            resp.fired = True
+            resp.event = ev["event"]
+            resp.schema_name = ev["schema"]
+            resp.table_name = ev["table"]
+            resp.table_id = ev["table_id"]
+            resp.revision = ev["revision"]
+        else:
+            # not fired: report where the watch window started so the
+            # client resumes from revision+1 without a gap
+            resp.revision = start - 1
         return resp
 
 
