@@ -213,6 +213,9 @@ def main():
 
     print(json.dumps({
         "platform": platform,
+        # faiss-openblas is not in this image; the stand-in is a numpy/
+        # OpenBLAS IVF scan over the SAME trained layout (VERDICT r2 weak #3)
+        "baseline": "numpy-ivf",
         "metric": (
             f"{index_kind}_qps_{n//1000}k_x{d}_nlist{nlist}_nprobe{nprobe}_"
             + ("recall>=0.95" if recall >= 0.95 else f"recall={recall:.2f}")

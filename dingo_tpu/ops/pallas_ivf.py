@@ -27,38 +27,35 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dingo_tpu.ops.pallas_topk import _select_topk
+
 NEG_INF = float("-inf")
 #: output lane padding (TPU lane width; k slots live in the first k lanes)
 OUT_PAD = 128
 
 
-def _select_topk(scores, idx, k):
-    """k rounds of max/argmax/mask over [1, C] -> ([1, k], [1, k])."""
-    vals, ids = [], []
-    for _ in range(k):
-        m = jnp.max(scores, axis=1)
-        am = jnp.argmax(scores, axis=1)
-        vals.append(m)
-        ids.append(jnp.take_along_axis(idx, am[:, None], axis=1)[:, 0])
-        b, c = scores.shape
-        cols = jax.lax.broadcasted_iota(jnp.int32, (b, c), 1)
-        scores = jnp.where(cols == am[:, None], NEG_INF, scores)
-    return jnp.stack(vals, axis=1), jnp.stack(ids, axis=1)
-
-
 def _ivf_kernel(vp_ref, q_ref, qsq_ref, x_ref, xsq_ref, val_ref, slot_ref,
                 outv_ref, outi_ref, *, k, ascending):
+    # Mosaic's tiling rule rejects blocks with a size-1 sublane dim on a
+    # larger array (observed on-chip round 3), so queries/qsq/outputs
+    # arrive as FULL [b, ·] blocks with constant index maps and the kernel
+    # addresses its query's row with a dynamic sublane slice.
     qi = pl.program_id(0)
     r = pl.program_id(1)
+    row = pl.ds(qi, 1)
 
     @pl.when(r == 0)
     def _init():
-        outv_ref[:] = jnp.full_like(outv_ref, NEG_INF)
-        outi_ref[:] = jnp.full_like(outi_ref, -1)
+        outv_ref[row, :] = jnp.full(
+            (1, outv_ref.shape[1]), NEG_INF, jnp.float32
+        )
+        outi_ref[row, :] = jnp.full(
+            (1, outi_ref.shape[1]), -1, jnp.int32
+        )
 
     @pl.when(vp_ref[qi, r] >= 0)
     def _scan_bucket():
-        q = q_ref[:]                                     # [1, d]
+        q = q_ref[row, :]                                # [1, d]
         x = x_ref[0].astype(jnp.float32)                 # [cap, d]
         dots = jax.lax.dot_general(
             q, x, (((1,), (1,)), ((), ())),
@@ -66,28 +63,30 @@ def _ivf_kernel(vp_ref, q_ref, qsq_ref, x_ref, xsq_ref, val_ref, slot_ref,
             precision=jax.lax.Precision.HIGHEST,
         )                                                # [1, cap]
         if ascending:   # L2 score = -(||q||^2 - 2qx + ||x||^2)
-            scores = -(qsq_ref[:] - 2.0 * dots + xsq_ref[:])
+            scores = -(qsq_ref[row, :] - 2.0 * dots + xsq_ref[0])
         else:           # IP
             scores = dots
-        scores = jnp.where(val_ref[:] > 0.5, scores, NEG_INF)
-        slot = slot_ref[:].astype(jnp.int32)             # [1, cap]
+        scores = jnp.where(val_ref[0] > 0.5, scores, NEG_INF)
+        slot = slot_ref[0].astype(jnp.int32)             # [1, cap]
         blk_v, blk_i = _select_topk(scores, slot, k)
-        cat_v = jnp.concatenate([outv_ref[:, :k], blk_v], axis=1)
-        cat_i = jnp.concatenate([outi_ref[:, :k], blk_i], axis=1)
+        cur_v = outv_ref[row, :]
+        cur_i = outi_ref[row, :]
+        cat_v = jnp.concatenate([cur_v[:, :k], blk_v], axis=1)
+        cat_i = jnp.concatenate([cur_i[:, :k], blk_i], axis=1)
         new_v, new_i = _select_topk(cat_v, cat_i, k)
         pad = outv_ref.shape[1] - k
-        outv_ref[:] = jnp.concatenate(
+        outv_ref[row, :] = jnp.concatenate(
             [new_v, jnp.full((1, pad), NEG_INF, jnp.float32)], axis=1
         )
-        outi_ref[:] = jnp.concatenate(
+        outi_ref[row, :] = jnp.concatenate(
             [new_i, jnp.full((1, pad), -1, jnp.int32)], axis=1
         )
 
     @pl.when(r == pl.num_programs(1) - 1)
     def _finish():
-        fv = outv_ref[:]
+        fv = outv_ref[row, :]
         # -inf picks carry arbitrary slots; normalize to -1 like the XLA path
-        outi_ref[:] = jnp.where(jnp.isneginf(fv), -1, outi_ref[:])
+        outi_ref[row, :] = jnp.where(jnp.isneginf(fv), -1, outi_ref[row, :])
 
 
 @functools.partial(
@@ -121,23 +120,23 @@ def ivf_list_topk(
     def bucket_map(q, r, vp):
         return (jnp.maximum(vp[q, r], 0), 0, 0)
 
-    def bucket_row_map(q, r, vp):
-        return (jnp.maximum(vp[q, r], 0), 0)
-
+    # row metadata rides as [B, 1, cap] so each block is (1, 1, cap): the
+    # last two dims equal the array's — Mosaic rejects (1, cap) blocks on
+    # [B, cap] (size-1 sublane on a larger array)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, budget),
         in_specs=[
-            pl.BlockSpec((1, d), lambda q, r, vp: (q, 0)),        # queries
-            pl.BlockSpec((1, 1), lambda q, r, vp: (q, 0)),        # qsq
+            pl.BlockSpec((b, d), lambda q, r, vp: (0, 0)),        # queries
+            pl.BlockSpec((b, 1), lambda q, r, vp: (0, 0)),        # qsq
             pl.BlockSpec((1, cap, d), bucket_map),                # bucket data
-            pl.BlockSpec((1, cap), bucket_row_map),               # sqnorm
-            pl.BlockSpec((1, cap), bucket_row_map),               # valid
-            pl.BlockSpec((1, cap), bucket_row_map),               # slots
+            pl.BlockSpec((1, 1, cap), bucket_map),                # sqnorm
+            pl.BlockSpec((1, 1, cap), bucket_map),                # valid
+            pl.BlockSpec((1, 1, cap), bucket_map),                # slots
         ],
         out_specs=[
-            pl.BlockSpec((1, OUT_PAD), lambda q, r, vp: (q, 0)),
-            pl.BlockSpec((1, OUT_PAD), lambda q, r, vp: (q, 0)),
+            pl.BlockSpec((b, OUT_PAD), lambda q, r, vp: (0, 0)),
+            pl.BlockSpec((b, OUT_PAD), lambda q, r, vp: (0, 0)),
         ],
     )
     out_v, out_i = pl.pallas_call(
@@ -153,9 +152,9 @@ def ivf_list_topk(
         q32,
         qsq,
         buckets,
-        bucket_sqnorm,
-        bucket_valid.astype(jnp.float32),
-        bucket_slot,
+        bucket_sqnorm[:, None, :],
+        bucket_valid.astype(jnp.float32)[:, None, :],
+        bucket_slot[:, None, :],
     )
     return out_v[:, :k], out_i[:, :k]
 

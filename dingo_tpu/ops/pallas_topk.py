@@ -28,17 +28,27 @@ NEG_INF = float("-inf")
 
 
 def _select_topk(scores, idx, k):
-    """k rounds of max/argmax/mask over [b, C] -> ([b, k], [b, k])."""
+    """k rounds of max/argmax/mask over [b, C] -> ([b, k], [b, k]).
+
+    The winner's id is extracted with a masked max reduction rather than
+    take_along_axis: Mosaic's gather lowering only accepts indices shaped
+    operand+(1,), so a [b,1] gather on [b,C] fails to lower (observed
+    on-chip round 3) — and a where+max over the one matching lane is
+    vector-unit work anyway, no gather needed.
+    """
     vals, ids = [], []
+    b, c = scores.shape
+    cols = jax.lax.broadcasted_iota(jnp.int32, (b, c), 1)
     for _ in range(k):
         m = jnp.max(scores, axis=1)                      # [b]
         am = jnp.argmax(scores, axis=1)                  # [b]
+        hit = cols == am[:, None]
+        ids.append(jnp.max(
+            jnp.where(hit, idx, jnp.int32(np.iinfo(np.int32).min)), axis=1
+        ))
         vals.append(m)
-        ids.append(jnp.take_along_axis(idx, am[:, None], axis=1)[:, 0])
         # mask the winner out
-        b, c = scores.shape
-        cols = jax.lax.broadcasted_iota(jnp.int32, (b, c), 1)
-        scores = jnp.where(cols == am[:, None], NEG_INF, scores)
+        scores = jnp.where(hit, NEG_INF, scores)
     return jnp.stack(vals, axis=1), jnp.stack(ids, axis=1)
 
 
