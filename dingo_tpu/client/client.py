@@ -10,6 +10,7 @@ SURVEY.md §5).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -180,14 +181,13 @@ class DingoClient:
         event invalidates the SDK table cache (and the region map on
         table create/drop) — the reference SDK's meta-watch cache story
         without client polling of table definitions."""
-        import threading
-
         if self._meta_watch_thread is not None:
             return
         self._meta_watch_stop = threading.Event()
 
         def loop():
             start = 0   # 0 = from now (server fills current+1)
+            registered = False
             while not self._meta_watch_stop.is_set():
                 try:
                     resp = self.meta.MetaWatch(pb.MetaWatchRequest(
@@ -205,6 +205,14 @@ class DingoClient:
                 # watched up to, so events landing between polls replay on
                 # the next call instead of being skipped by "from now"
                 start = resp.revision + 1
+                if not registered:
+                    # entries cached between start_meta_watch() and this
+                    # first pinned window may predate events the watch
+                    # never saw (the first poll starts "from now") —
+                    # drop them so nothing stale survives the gap
+                    registered = True
+                    self._cache_gen += 1
+                    self._table_cache.clear()
                 if not resp.fired:
                     continue
                 self._cache_gen += 1

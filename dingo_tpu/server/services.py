@@ -1152,8 +1152,6 @@ class VersionService:
     """etcd-like KV (version_service.cc analog over KvControl)."""
 
     def __init__(self, kv: KvControl):
-        import threading
-
         self.kv = kv
         self._watch_slots = threading.Semaphore(self._MAX_BLOCKED_WATCHES)
 
@@ -1213,6 +1211,11 @@ class VersionService:
         means "from now" (etcd watch semantics), NOT from history."""
         resp = pb.VKvWatchResponse()
         start = req.start_revision or (self.kv._revision + 1)
+        # pin the window even on timeout: the server clamps long polls
+        # (_MAX_WATCH_TIMEOUT_MS), so a client that re-polled "from now"
+        # would drop any event landing in the turnaround gap — re-polling
+        # from revision + 1 replays it from the revision chain instead
+        resp.revision = start - 1
         try:
             args, busy = _long_poll_watch(
                 lambda cb: self.kv.watch(req.key, start, cb),
@@ -1227,6 +1230,7 @@ class VersionService:
             event, item = args
             resp.fired = True
             resp.event = event
+            resp.revision = item.mod_revision
             self._item_to_pb(item, resp.item)
         return resp
 
@@ -1257,8 +1261,6 @@ class MetaService:
     _MAX_BLOCKED_WATCHES = 8
 
     def __init__(self, meta):
-        import threading
-
         from dingo_tpu.coordinator.meta import MetaControl
 
         self.meta: MetaControl = meta

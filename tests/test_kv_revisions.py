@@ -204,12 +204,23 @@ def test_rpc_surface(tmp_path):
     t.join()
     assert resp.fired and resp.item.value == b"x"
 
-    # timeout path unregisters
+    # timeout path unregisters AND pins its window: a clamped long-poll
+    # that re-polled "from now" would skip events landing in the RPC
+    # turnaround — resp.revision lets the client resume from history
+    pre = kv._revision
     resp = svc.VKvWatch(pb.VKvWatchRequest(
-        key=b"never", start_revision=kv._revision + 1, timeout_ms=50,
+        key=b"never", start_revision=0, timeout_ms=50,
     ))
     assert not resp.fired
     assert kv._watches == {}
+    assert resp.revision == pre
+    # event lands between polls; re-poll from the pin replays it
+    kv.kv_put(b"never", b"late")
+    resp = svc.VKvWatch(pb.VKvWatchRequest(
+        key=b"never", start_revision=resp.revision + 1,
+    ))
+    assert resp.fired and resp.item.value == b"late"
+    assert resp.revision == kv._revision  # fired pin advances past event
 
     # compaction over RPC; reads below the floor error
     cur = kv.kv_put(b"k", b"v3")

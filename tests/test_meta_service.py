@@ -292,3 +292,33 @@ def test_sdk_cache_invalidation_via_meta_watch(cluster):
         assert "dingo.cachetab" not in client._table_cache
     finally:
         client.stop_meta_watch()
+
+
+def test_meta_watch_registration_gap_invalidates(cluster):
+    """Entries cached between start_meta_watch() and the watcher's first
+    server-side registration could predate events the watch never sees
+    (the first poll starts "from now") — the first pinned window must
+    flush the cache so nothing stale survives the gap."""
+    import time as _time
+
+    client, control, meta, nodes = cluster
+    param = pb.VectorIndexParameter(
+        index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=8,
+        metric_type=pb.METRIC_TYPE_L2,
+    )
+    client.create_vector_table("dingo", "gaptab", param,
+                               partitions=((61, 0, 1 << 20),))
+    try:
+        # cache BEFORE the watcher exists: this entry predates any window
+        assert client.get_table("dingo", "gaptab", cached=True) is not None
+        assert "dingo.gaptab" in client._table_cache
+        gen0 = client._cache_gen
+        client.start_meta_watch(poll_timeout_ms=200)
+        deadline = _time.time() + 5
+        while client._cache_gen == gen0 and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert client._cache_gen > gen0
+        assert "dingo.gaptab" not in client._table_cache
+    finally:
+        client.stop_meta_watch()
+        client.drop_table("dingo", "gaptab")
