@@ -209,10 +209,17 @@ class DingoClient:
                     # entries cached between start_meta_watch() and this
                     # first pinned window may predate events the watch
                     # never saw (the first poll starts "from now") —
-                    # drop them so nothing stale survives the gap
+                    # drop them so nothing stale survives the gap. The
+                    # region map is as stale as the cache (a missed
+                    # create/drop moved regions), so refresh it too,
+                    # exactly like the resync branch.
                     registered = True
                     self._cache_gen += 1
                     self._table_cache.clear()
+                    try:
+                        self.refresh_region_map()
+                    except Exception:
+                        pass
                 if not resp.fired:
                     continue
                 self._cache_gen += 1

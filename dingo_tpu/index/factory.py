@@ -21,6 +21,8 @@ def _sharded_if_enabled(flag: str, index_id: int, parameter: IndexParameter):
         return None
     if flag == "use_mesh_sharded_flat":
         from dingo_tpu.parallel.sharded_flat import TpuShardedFlat as cls
+    elif flag == "use_mesh_sharded_ivfpq":
+        from dingo_tpu.parallel.sharded_pq import TpuShardedIvfPq as cls
     else:
         from dingo_tpu.parallel.sharded_ivf import TpuShardedIvfFlat as cls
     return cls(index_id, parameter)
@@ -59,6 +61,11 @@ def new_index(index_id: int, parameter: IndexParameter) -> VectorIndex:
 
         return TpuBinaryIvfFlat(index_id, parameter)
     if t is IndexType.IVF_PQ:
+        sharded = _sharded_if_enabled(
+            "use_mesh_sharded_ivfpq", index_id, parameter
+        )
+        if sharded is not None:
+            return sharded
         from dingo_tpu.index.ivf_pq import TpuIvfPq
 
         return TpuIvfPq(index_id, parameter)

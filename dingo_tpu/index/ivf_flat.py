@@ -330,10 +330,10 @@ class TpuIvfFlat(_SlotStoreIndex):
                 probes, lay.probe_table, nprobe, lay.max_spill
             )
             valid = self._bucket_valid_for_filter(filter_spec)
-            from dingo_tpu.common.config import FLAGS
+            from dingo_tpu.common.config import pallas_ivf_enabled
 
             if (
-                FLAGS.get("use_pallas_ivf_search")
+                pallas_ivf_enabled(self.dimension)
                 and self.metric in (
                     Metric.L2, Metric.INNER_PRODUCT, Metric.COSINE
                 )

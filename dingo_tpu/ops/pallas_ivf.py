@@ -32,17 +32,22 @@ from dingo_tpu.ops.pallas_topk import _select_topk
 NEG_INF = float("-inf")
 #: output lane padding (TPU lane width; k slots live in the first k lanes)
 OUT_PAD = 128
+#: sublane-aligned row blocking for per-query arrays (batch padded to this)
+ROW_BLOCK = 8
 
 
 def _ivf_kernel(vp_ref, q_ref, qsq_ref, x_ref, xsq_ref, val_ref, slot_ref,
                 outv_ref, outi_ref, *, k, ascending):
     # Mosaic's tiling rule rejects blocks with a size-1 sublane dim on a
     # larger array (observed on-chip round 3), so queries/qsq/outputs
-    # arrive as FULL [b, ·] blocks with constant index maps and the kernel
-    # addresses its query's row with a dynamic sublane slice.
+    # arrive as 8-row sublane-aligned blocks (index q // 8) and the kernel
+    # addresses its query's row within the block with a dynamic slice —
+    # VMEM stays O(1) in the batch, unlike full-batch blocks. The grid is
+    # query-major, so all 8 rows of an output block are initialized and
+    # filled by their own queries before the block index advances.
     qi = pl.program_id(0)
     r = pl.program_id(1)
-    row = pl.ds(qi, 1)
+    row = pl.ds(jax.lax.rem(qi, ROW_BLOCK), 1)
 
     @pl.when(r == 0)
     def _init():
@@ -122,21 +127,30 @@ def ivf_list_topk(
 
     # row metadata rides as [B, 1, cap] so each block is (1, 1, cap): the
     # last two dims equal the array's — Mosaic rejects (1, cap) blocks on
-    # [B, cap] (size-1 sublane on a larger array)
+    # [B, cap] (size-1 sublane on a larger array). Per-query arrays ride
+    # as ROW_BLOCK-row blocks so VMEM stays O(1) in the batch.
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, budget),
         in_specs=[
-            pl.BlockSpec((b, d), lambda q, r, vp: (0, 0)),        # queries
-            pl.BlockSpec((b, 1), lambda q, r, vp: (0, 0)),        # qsq
+            pl.BlockSpec(
+                (ROW_BLOCK, d), lambda q, r, vp: (q // ROW_BLOCK, 0)
+            ),                                                    # queries
+            pl.BlockSpec(
+                (ROW_BLOCK, 1), lambda q, r, vp: (q // ROW_BLOCK, 0)
+            ),                                                    # qsq
             pl.BlockSpec((1, cap, d), bucket_map),                # bucket data
             pl.BlockSpec((1, 1, cap), bucket_map),                # sqnorm
             pl.BlockSpec((1, 1, cap), bucket_map),                # valid
             pl.BlockSpec((1, 1, cap), bucket_map),                # slots
         ],
         out_specs=[
-            pl.BlockSpec((b, OUT_PAD), lambda q, r, vp: (0, 0)),
-            pl.BlockSpec((b, OUT_PAD), lambda q, r, vp: (0, 0)),
+            pl.BlockSpec(
+                (ROW_BLOCK, OUT_PAD), lambda q, r, vp: (q // ROW_BLOCK, 0)
+            ),
+            pl.BlockSpec(
+                (ROW_BLOCK, OUT_PAD), lambda q, r, vp: (q // ROW_BLOCK, 0)
+            ),
         ],
     )
     out_v, out_i = pl.pallas_call(
@@ -163,9 +177,20 @@ def ivf_list_search(
     vprobes, queries, buckets, bucket_sqnorm, bucket_valid, bucket_slot,
     k: int, ascending: bool = True,
 ):
-    """Backend-aware wrapper: interpret mode off-TPU (Mosaic is TPU-only)."""
+    """Backend-aware wrapper: interpret mode off-TPU (Mosaic is TPU-only);
+    pads the batch to ROW_BLOCK (padded queries probe nothing: vprobes -1)."""
+    b = queries.shape[0]
+    pad = (-b) % ROW_BLOCK
+    if pad:
+        queries = jnp.concatenate(
+            [queries, jnp.zeros((pad, queries.shape[1]), queries.dtype)]
+        )
+        vprobes = jnp.concatenate(
+            [vprobes, jnp.full((pad, vprobes.shape[1]), -1, vprobes.dtype)]
+        )
     interpret = jax.default_backend() not in ("tpu", "axon")
-    return ivf_list_topk(
+    vals, slots = ivf_list_topk(
         vprobes, queries, buckets, bucket_sqnorm, bucket_valid, bucket_slot,
         k=k, ascending=ascending, interpret=interpret,
     )
+    return vals[:b], slots[:b]

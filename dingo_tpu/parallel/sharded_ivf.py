@@ -296,7 +296,9 @@ class TpuShardedIvfFlat(TpuShardedFlat):
         return n
 
     # -- bucketed view -------------------------------------------------------
-    def _rebuild_view(self) -> None:
+    def _build_shard_layouts(self):
+        """Per-shard spill-bucket layouts stacked to common shapes (host
+        arrays); shared by the IVF_FLAT and IVF_PQ sharded views."""
         S, cap = self.n_shards, self.cap_per_shard
         liveness = self.ids_by_gslot >= 0
         assign2 = self._assign_h.reshape(S, cap)
@@ -316,11 +318,19 @@ class TpuShardedIvfFlat(TpuShardedFlat):
         bucket_valid = np.zeros((S, B, cap_list), bool)
         probe_table = np.full((S, self.nlist, spill), -1, np.int32)
         gather_idx = np.zeros((S, B * cap_list), np.int32)
+        bucket_coarse = np.zeros((S, B), np.int32)
         for s, l in enumerate(lays):
             bucket_slot[s, : l.nbuckets] = l.bucket_slot_h
             bucket_valid[s, : l.nbuckets] = np.asarray(l.bucket_valid)
             probe_table[s, :, : l.max_spill] = np.asarray(l.probe_table)
             gather_idx[s, : l.nbuckets * cap_list] = np.asarray(l.gather_idx)
+            bucket_coarse[s, : l.nbuckets] = np.asarray(l.bucket_coarse)
+        return (cap_list, spill, B, bucket_slot, bucket_valid, probe_table,
+                gather_idx, bucket_coarse)
+
+    def _rebuild_view(self) -> None:
+        (cap_list, spill, B, bucket_slot, bucket_valid, probe_table,
+         gather_idx, _) = self._build_shard_layouts()
         sh3 = NamedSharding(self.mesh, P("data", None, None))
         sh2 = NamedSharding(self.mesh, P("data", None))
         gidx_dev = jax.device_put(gather_idx, sh2)
@@ -342,20 +352,47 @@ class TpuShardedIvfFlat(TpuShardedFlat):
         )
         self._view_dirty = False
 
-    def _bucket_valid_for_filter(self, filter_spec: Optional[FilterSpec]):
-        view = self._view
+    def _filtered_bucket_valid(self, filter_spec: Optional[FilterSpec],
+                               bucket_valid, bucket_slot_h: np.ndarray):
+        """Apply a scalar filter to a stacked per-shard bucket-validity
+        array (shared by the IVF_FLAT and IVF_PQ sharded views)."""
         if filter_spec is None or filter_spec.is_empty():
-            return view.bucket_valid
+            return bucket_valid
         S, cap = self.n_shards, self.cap_per_shard
         mask2 = filter_spec.slot_mask(self.ids_by_gslot).reshape(S, cap)
-        bslot = view.bucket_slot_h                      # [S, B, cap_list]
-        safe = np.where(bslot >= 0, bslot, 0)
+        safe = np.where(bucket_slot_h >= 0, bucket_slot_h, 0)
         bmask = np.take_along_axis(
-            mask2.reshape(S, cap), safe.reshape(S, -1), axis=1
-        ).reshape(bslot.shape) & (bslot >= 0)
+            mask2, safe.reshape(S, -1), axis=1
+        ).reshape(bucket_slot_h.shape) & (bucket_slot_h >= 0)
         return jax.device_put(
             bmask, NamedSharding(self.mesh, P("data", None, None))
         )
+
+    def _bucket_valid_for_filter(self, filter_spec: Optional[FilterSpec]):
+        return self._filtered_bucket_valid(
+            filter_spec, self._view.bucket_valid, self._view.bucket_slot_h
+        )
+
+    def _make_resolve(self, vals, gslots, b: int,
+                      ids_by_gslot: np.ndarray):
+        """Shared resolver: translate merged gslots to vector ids and
+        scores to wire distances (the caller snapshots ids_by_gslot under
+        its device lock — growth remaps the gslot space)."""
+        vals.copy_to_host_async()
+        gslots.copy_to_host_async()
+        metric = self.metric
+
+        def resolve() -> List[SearchResult]:
+            vals_h, gslots_h = jax.device_get((vals, gslots))
+            vals_h, gslots_h = vals_h[:b], gslots_h[:b]
+            safe = np.where(gslots_h >= 0, gslots_h, 0)
+            ids = np.where(gslots_h >= 0, ids_by_gslot[safe], -1)
+            dists = np.asarray(
+                scores_to_distances(jnp.asarray(vals_h), metric)
+            )
+            return [strip_invalid(i, d) for i, d in zip(ids, dists)]
+
+        return resolve
 
     # -- search --------------------------------------------------------------
     def search(self, queries, topk, filter_spec=None, nprobe=None, **kw):
@@ -386,21 +423,7 @@ class TpuShardedIvfFlat(TpuShardedFlat):
                 max_spill=int(view.max_spill),
             )
             ids_by_gslot = self.ids_by_gslot.copy()
-        vals.copy_to_host_async()
-        gslots.copy_to_host_async()
-        metric = self.metric
-
-        def resolve() -> List[SearchResult]:
-            vals_h, gslots_h = jax.device_get((vals, gslots))
-            vals_h, gslots_h = vals_h[:b], gslots_h[:b]
-            safe = np.where(gslots_h >= 0, gslots_h, 0)
-            ids = np.where(gslots_h >= 0, ids_by_gslot[safe], -1)
-            dists = np.asarray(
-                scores_to_distances(jnp.asarray(vals_h), metric)
-            )
-            return [strip_invalid(i, d) for i, d in zip(ids, dists)]
-
-        return resolve
+        return self._make_resolve(vals, gslots, b, ids_by_gslot)
 
     # -- lifecycle -----------------------------------------------------------
     def save(self, path: str) -> None:

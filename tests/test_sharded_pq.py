@@ -1,0 +1,189 @@
+"""TpuShardedIvfPq: mesh-sharded IVF_PQ on the 8-device virtual CPU mesh —
+recall/contract parity with the single-device TpuIvfPq, shard-local exact
+rerank quality, and factory/service reachability (round-2 VERDICT item 3:
+the last BASELINE config-5 index type over the mesh)."""
+
+import numpy as np
+import pytest
+
+from dingo_tpu.common.config import FLAGS
+from dingo_tpu.index.base import (
+    FilterSpec,
+    IndexParameter,
+    IndexType,
+    Metric,
+    NotTrained,
+)
+from dingo_tpu.index.ivf_pq import TpuIvfPq
+from dingo_tpu.parallel.sharded_pq import TpuShardedIvfPq
+
+DIM = 48
+NLIST = 16
+M = 8
+
+
+def make(metric=Metric.L2, nlist=NLIST):
+    return TpuShardedIvfPq(1, IndexParameter(
+        index_type=IndexType.IVF_PQ, dimension=DIM, metric=metric,
+        ncentroids=nlist, nsubvector=M, default_nprobe=NLIST,
+    ))
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(11)
+    centers = rng.standard_normal((40, DIM), dtype=np.float32)
+    x = centers[rng.integers(0, 40, 4000)] + 0.25 * rng.standard_normal(
+        (4000, DIM)
+    ).astype(np.float32)
+    return np.arange(4000, dtype=np.int64), x
+
+
+def _recall(res, gt, ids):
+    return np.mean(
+        [len(set(r.ids) & set(ids[g])) / len(g) for r, g in zip(res, gt)]
+    )
+
+
+def _gt(x, q, k):
+    d = (q ** 2).sum(1)[:, None] - 2.0 * q @ x.T + (x ** 2).sum(1)[None, :]
+    return np.argsort(d, axis=1)[:, :k]
+
+
+def test_validation():
+    with pytest.raises(Exception):
+        TpuShardedIvfPq(1, IndexParameter(
+            index_type=IndexType.IVF_PQ, dimension=50, ncentroids=4,
+            nsubvector=8,   # 50 % 8 != 0
+        ))
+
+
+def test_untrained_raises(corpus):
+    ids, x = corpus
+    idx = make()
+    idx.upsert(ids[:400], x[:400])
+    with pytest.raises(NotTrained):
+        idx.search(x[:2], 5)
+
+
+def test_recall_parity_with_single_device(corpus):
+    ids, x = corpus
+    sharded = make()
+    single = TpuIvfPq(2, IndexParameter(
+        index_type=IndexType.IVF_PQ, dimension=DIM, ncentroids=NLIST,
+        nsubvector=M, default_nprobe=NLIST,
+    ))
+    sharded.upsert(ids, x)
+    single.upsert(ids, x)
+    sharded.train()
+    single.train()
+    q = x[:16] + 0.01
+    gt = _gt(x, q, 10)
+    r_sh = _recall(sharded.search(q, 10, nprobe=NLIST), gt, ids)
+    r_si = _recall(single.search(q, 10, nprobe=NLIST), gt, ids)
+    # the sharded index exact-reranks on-device; it must do at least as
+    # well as the single-device host rerank path at full probe
+    assert r_sh >= r_si - 0.05
+    assert r_sh >= 0.8
+
+
+def test_exact_rerank_beats_adc(corpus):
+    """With rerank factor 1 the result order is pure ADC top-k reranked
+    exactly; with a large factor the exact rerank recovers ADC misses."""
+    ids, x = corpus
+    idx = make()
+    idx.upsert(ids, x)
+    idx.train()
+    q = x[:16] + 0.01
+    gt = _gt(x, q, 10)
+    old = FLAGS.get("ivfpq_rerank_factor")
+    try:
+        FLAGS.set("ivfpq_rerank_factor", 1)
+        r1 = _recall(idx.search(q, 10, nprobe=NLIST), gt, ids)
+        FLAGS.set("ivfpq_rerank_factor", 16)
+        r16 = _recall(idx.search(q, 10, nprobe=NLIST), gt, ids)
+    finally:
+        FLAGS.set("ivfpq_rerank_factor", old)
+    assert r16 >= r1
+
+
+def test_mutations_after_train(corpus):
+    ids, x = corpus
+    idx = make()
+    idx.upsert(ids[:3000], x[:3000])
+    idx.train()
+    idx.upsert(ids[3000:3200], x[3000:3200])
+    res = idx.search(x[[3100]], 3, nprobe=NLIST)
+    assert res[0].ids[0] == 3100
+    idx.delete(ids[[3100]])
+    res = idx.search(x[[3100]], 3, nprobe=NLIST)
+    assert 3100 not in res[0].ids
+    assert idx.get_count() == 3199
+
+
+def test_growth_preserves_codes(corpus):
+    ids, x = corpus
+    idx = make()
+    idx.upsert(ids[:600], x[:600])
+    idx.train()
+    assert idx.search(x[[50]], 3, nprobe=NLIST)[0].ids[0] == 50
+    # force capacity growth (doubling + gslot remap + code growth)
+    idx.upsert(ids[600:4000], x[600:4000])
+    assert idx.search(x[[50]], 3, nprobe=NLIST)[0].ids[0] == 50
+    assert idx.search(x[[3500]], 3, nprobe=NLIST)[0].ids[0] == 3500
+
+
+def test_filters(corpus):
+    ids, x = corpus
+    idx = make()
+    idx.upsert(ids, x)
+    idx.train()
+    res = idx.search(x[:4], 5, nprobe=NLIST,
+                     filter_spec=FilterSpec(ranges=[(100, 200)]))
+    for r in res:
+        assert all(100 <= i < 200 for i in r.ids)
+
+
+def test_save_load_roundtrip(tmp_path, corpus):
+    ids, x = corpus
+    idx = make()
+    idx.upsert(ids[:800], x[:800])
+    idx.train()
+    want = [(list(r.ids), np.asarray(r.distances))
+            for r in idx.search(x[:4], 5, nprobe=NLIST)]
+    idx.save(str(tmp_path / "s"))
+    idx2 = make()
+    idx2.load(str(tmp_path / "s"))
+    assert idx2.is_trained()
+    got = [(list(r.ids), np.asarray(r.distances))
+           for r in idx2.search(x[:4], 5, nprobe=NLIST)]
+    for (ai, ad), (bi, bd) in zip(want, got):
+        assert ai == bi
+        np.testing.assert_allclose(ad, bd, rtol=1e-4, atol=1e-4)
+
+
+def test_cosine_metric(corpus):
+    ids, x = corpus
+    idx = make(metric=Metric.COSINE)
+    idx.upsert(ids[:2000], x[:2000])
+    idx.train()
+    res = idx.search(x[:4], 5, nprobe=NLIST)
+    assert [r.ids[0] for r in res] == [0, 1, 2, 3]
+
+
+def test_factory_arm(corpus):
+    ids, x = corpus
+    FLAGS.set("use_mesh_sharded_ivfpq", True)
+    try:
+        from dingo_tpu.index.factory import new_index
+
+        idx = new_index(9, IndexParameter(
+            index_type=IndexType.IVF_PQ, dimension=DIM, ncentroids=NLIST,
+            nsubvector=M, default_nprobe=NLIST,
+        ))
+        assert isinstance(idx, TpuShardedIvfPq)
+        idx.upsert(ids[:2000], x[:2000])
+        idx.train()
+        assert idx.search(x[[7]], 3)[0].ids[0] == 7
+    finally:
+        FLAGS.set("use_mesh_sharded_ivfpq", False)
