@@ -99,6 +99,11 @@ def main():
             nsubvector=int(os.environ.get("DINGO_BENCH_M", 96)),
             default_nprobe=nprobe, host_vectors=True,
         )
+        rerank = os.environ.get("DINGO_BENCH_RERANK")
+        if rerank:
+            from dingo_tpu.common.config import FLAGS
+
+            FLAGS.set("ivfpq_rerank_factor", int(rerank))
     else:
         param = IndexParameter(
             index_type=IndexType.IVF_FLAT, dimension=d, ncentroids=nlist,

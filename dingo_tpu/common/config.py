@@ -83,6 +83,12 @@ FLAGS.define("ivfpq_rerank_factor", 8, mutable=True,
              help_="host-vectors IVF_PQ reranks topk*factor ADC candidates "
                    "exactly from host rows (1 disables); same prune+rerank "
                    "recipe as the diskann role")
+FLAGS.define("lsm_sync_writes", False, mutable=True,
+             help_="fsync the native LSM WAL on every commit: power-loss "
+                   "durability instead of process-crash durability. Off by "
+                   "default — raft replication is the availability story "
+                   "and per-commit fsync costs ~ms (rocksdb's "
+                   "WriteOptions.sync analog)")
 FLAGS.define("wal_checkpoint_bytes", 64 * 1024 * 1024, mutable=True,
              help_="WalEngine folds the WAL into a checkpoint once it "
                    "exceeds this size, bounding restart replay time")

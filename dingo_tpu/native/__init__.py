@@ -97,7 +97,7 @@ def load_lsm() -> ctypes.CDLL:
     lib = ctypes.CDLL(_build("libdingolsm.so", "lsm/lsm.cc"))
     c = ctypes
     lib.lsm_open.restype = c.c_void_p
-    lib.lsm_open.argtypes = [c.c_char_p, c.c_uint64]
+    lib.lsm_open.argtypes = [c.c_char_p, c.c_uint64, c.c_int]
     lib.lsm_close.argtypes = [c.c_void_p]
     lib.lsm_write.restype = c.c_int
     lib.lsm_write.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
@@ -128,4 +128,10 @@ def load_lsm() -> ctypes.CDLL:
     lib.lsm_compact.argtypes = [c.c_void_p]
     lib.lsm_sst_count.restype = c.c_uint64
     lib.lsm_sst_count.argtypes = [c.c_void_p]
+    lib.lsm_delete_range.restype = c.c_int64
+    lib.lsm_delete_range.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_uint64, c.c_char_p, c.c_uint64, c.c_int,
+    ]
+    lib.lsm_index_bytes.restype = c.c_uint64
+    lib.lsm_index_bytes.argtypes = [c.c_void_p]
     return lib

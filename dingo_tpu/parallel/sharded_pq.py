@@ -62,6 +62,22 @@ from dingo_tpu.ops.topk import merge_sharded_topk
 from dingo_tpu.parallel.sharded_ivf import TpuShardedIvfFlat
 
 
+def _encode_codes(vecs, assign, centroids, codebooks, m):
+    """Residual PQ encode -> [n, m] uint8 (rows with assign -1 get 0).
+    The ONE encoding pipeline — train-time re-encode and incremental
+    upsert must quantize identically or post-train rows silently lose
+    recall."""
+    safe = jnp.maximum(assign, 0)
+    resid = vecs - jnp.take(centroids, safe, axis=0)
+    subs = split_subvectors(resid, m)               # [m, n, dsub]
+
+    def enc_one(sub, cb):
+        return jnp.argmin(pairwise_l2sqr(sub, cb), axis=1)
+
+    codes = jax.vmap(enc_one)(subs, codebooks).T.astype(jnp.uint8)
+    return jnp.where((assign >= 0)[:, None], codes, 0)
+
+
 @dataclasses.dataclass
 class _PqShardedView:
     """Stacked per-shard code-bucket layout, device-resident."""
@@ -124,15 +140,7 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
 
         def encode_local(vecs, assign, centroids, codebooks):
             # vecs [cap, d], assign [cap] int32 (-1 unassigned)
-            safe = jnp.maximum(assign, 0)
-            resid = vecs - jnp.take(centroids, safe, axis=0)
-            subs = split_subvectors(resid, m)          # [m, cap, dsub]
-
-            def enc_one(sub, cb):
-                return jnp.argmin(pairwise_l2sqr(sub, cb), axis=1)
-
-            codes = jax.vmap(enc_one)(subs, codebooks).T.astype(jnp.uint8)
-            return jnp.where((assign >= 0)[:, None], codes, 0)
+            return _encode_codes(vecs, assign, centroids, codebooks, m)
 
         self._encode_all_jit = jax.jit(shard_map(
             encode_local, mesh=mesh,
@@ -321,14 +329,9 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
             )
             dv = jnp.asarray(vectors)
             assign = jnp.asarray(self._assign_h[slots], jnp.int32)
-            resid = dv - jnp.take(self.centroids, assign, axis=0)
-            subs = split_subvectors(resid, self.m)
-
-            def enc_one(sub, cb):
-                return jnp.argmin(pairwise_l2sqr(sub, cb), axis=1)
-
-            codes = jax.vmap(enc_one)(subs, self.codebooks).T \
-                .astype(jnp.uint8)
+            codes = _encode_codes(
+                dv, assign, self.centroids, self.codebooks, self.m
+            )
             sh = NamedSharding(self.mesh, P("data", None))
             with self._device_lock:
                 self._codes = jax.jit(

