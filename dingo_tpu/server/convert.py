@@ -195,16 +195,33 @@ def coprocessor_from_pb(m) -> "object | None":
         SchemaColumn,
     )
 
+    if m.projections:
+        selection = []
+        for p in m.projections:
+            if p.expr:
+                tree = wire.decode(p.expr)
+                if not isinstance(tree, (list, tuple)):
+                    # a scalar here would be silently taken as a column
+                    # index by CoprocessorDef — reject the malformed expr
+                    raise ValueError(f"projection expr is not a tree: {tree!r}")
+                selection.append(tree)
+            else:
+                selection.append(p.column_index)
+    else:
+        selection = list(m.selection)
     defn = CoprocessorDef(
         original_schema=[
             SchemaColumn(c.name, c.sql_type or "VARCHAR", c.index)
             for c in m.original_schema
         ],
-        selection=list(m.selection),
+        selection=selection,
         filter_expr=wire.decode(m.filter_expr) if m.filter_expr else None,
         group_by=list(m.group_by),
         aggregations=[
-            AggregationSpec(AggOpV2(a.op), a.column_index)
+            AggregationSpec(
+                AggOpV2(a.op), a.column_index,
+                expr=wire.decode(a.expr) if a.expr else None,
+            )
             for a in m.aggregations
         ],
     )
