@@ -68,6 +68,10 @@ class LsmRawEngine(RawEngine):
             self._dbs[cf], key, len(key), ctypes.byref(out),
             ctypes.byref(outl),
         )
+        if rc < 0:
+            # cursor I/O error — NOT "not found": a silent None here could
+            # serve a stale older-SST value to MVCC readers
+            raise OSError(f"lsm_get I/O error rc={rc} (cf={cf})")
         if rc != 0:
             return None
         try:
@@ -81,6 +85,8 @@ class LsmRawEngine(RawEngine):
             self._dbs[cf], start, len(start), end or b"",
             len(end or b""), 1 if has_end else 0, 1 if reverse else 0,
         )
+        if not it:
+            raise OSError(f"lsm_scan I/O error (cf={cf})")
         rows = []
         k = ctypes.POINTER(ctypes.c_char)()
         v = ctypes.POINTER(ctypes.c_char)()
@@ -107,10 +113,13 @@ class LsmRawEngine(RawEngine):
 
     def count(self, cf, start=b"", end=None) -> int:
         has_end = end is not None
-        return int(self._lib.lsm_count(
+        n = int(self._lib.lsm_count(
             self._dbs[cf], start, len(start), end or b"",
             len(end or b""), 1 if has_end else 0,
         ))
+        if n == (1 << 64) - 1:   # native error sentinel
+            raise OSError(f"lsm_count I/O error (cf={cf})")
+        return n
 
     # -- writes --------------------------------------------------------------
     def write(self, batch: WriteBatch) -> None:
@@ -176,13 +185,17 @@ class LsmRawEngine(RawEngine):
     # -- maintenance ---------------------------------------------------------
     def flush(self) -> None:
         with self._lock:
-            for h in self._dbs.values():
-                self._lib.lsm_flush(h)
+            for cf, h in self._dbs.items():
+                if self._lib.lsm_flush(h) != 0:
+                    # a swallowed flush failure here would let checkpoint()
+                    # ship a snapshot missing the memtable's writes
+                    raise OSError(f"lsm_flush failed (cf={cf})")
 
     def compact(self) -> None:
         with self._lock:
-            for h in self._dbs.values():
-                self._lib.lsm_compact(h)
+            for cf, h in self._dbs.items():
+                if self._lib.lsm_compact(h) != 0:
+                    raise OSError(f"lsm_compact failed (cf={cf})")
 
     def sst_counts(self) -> Dict[str, int]:
         return {
@@ -203,9 +216,12 @@ class LsmRawEngine(RawEngine):
         os.makedirs(path, exist_ok=True)
         with self._lock:
             # flush + copy under the lock: a concurrent flush/compaction
-            # would unlink the SST files mid-copy
-            for h in self._dbs.values():
-                self._lib.lsm_flush(h)
+            # would unlink the SST files mid-copy. A failed flush must
+            # abort: the copy would otherwise ship a checkpoint missing
+            # the memtable's acknowledged writes.
+            for cf, h in self._dbs.items():
+                if self._lib.lsm_flush(h) != 0:
+                    raise OSError(f"checkpoint flush failed (cf={cf})")
             for cf in ALL_CFS:
                 src = os.path.join(self.path, f"cf_{cf}")
                 dst = os.path.join(path, f"cf_{cf}")

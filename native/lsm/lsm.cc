@@ -32,6 +32,7 @@
 #include <cstdio>
 #include <cstring>
 #include <dirent.h>
+#include <fcntl.h>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -44,7 +45,9 @@
 namespace {
 
 constexpr uint32_t kWalMagic = 0xD146157A;
-constexpr uint32_t kIdxMagic = 0xD146157B;
+// bumped from ..7B: the .idx format gained a trailing checksum; old files
+// fail the magic check and are rebuilt by one sequential scan
+constexpr uint32_t kIdxMagic = 0xD146157C;
 constexpr uint32_t kTombstone = 0xFFFFFFFFu;
 constexpr uint8_t kOpPut = 1;
 constexpr uint8_t kOpDelete = 2;
@@ -100,6 +103,26 @@ struct Db {
 
 bool write_all(FILE* f, const void* p, size_t n) {
   return fwrite(p, 1, n, f) == n;
+}
+
+// Make a rename/unlink durable: fsync the containing directory. Without
+// this, power loss can persist a later WAL truncation while losing the
+// SST rename it depends on (the acknowledged writes would vanish).
+void fsync_dir(const std::string& dir) {
+  int fd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (fd >= 0) {
+    fsync(fd);
+    close(fd);
+  }
+}
+
+uint32_t fnv1a(const char* p, size_t n) {
+  uint32_t h = 2166136261u;
+  for (size_t i = 0; i < n; ++i) {
+    h ^= (uint8_t)p[i];
+    h *= 16777619u;
+  }
+  return h;
 }
 
 // ---- record IO -----------------------------------------------------------
@@ -170,56 +193,98 @@ uint64_t floor_offset(const Sst& sst, const std::string& key) {
 
 // ---- sparse index persistence -------------------------------------------
 // .idx: [u32 magic][u64 count][u64 data_bytes][u32 max_klen][max_key]
-//       [u32 n][n x (u64 off, u32 klen, key)]
+//       [u32 n][n x (u64 off, u32 klen, key)][u32 fnv1a of everything above]
+//
+// The side file is best-effort (rebuildable by one scan), so the read path
+// must never TRUST it: the whole file is read into memory (bounded by its
+// actual size), checksum-verified — a rename can survive power loss while
+// its data blocks do not — and then parsed with per-field bounds so a
+// corrupt length can neither over-allocate nor over-read.
+void put_bytes(std::string* b, const void* p, size_t n) {
+  b->append((const char*)p, n);
+}
+
 bool write_idx_file(const Db& db, const Sst& sst) {
-  std::string tmp = db.idx_path(sst.id) + ".tmp";
-  FILE* f = fopen(tmp.c_str(), "wb");
-  if (!f) return false;
+  std::string buf;
   uint32_t magic = kIdxMagic;
   uint32_t mkl = (uint32_t)sst.max_key.size();
   uint32_t n = (uint32_t)sst.idx_keys.size();
-  bool ok = write_all(f, &magic, 4) && write_all(f, &sst.count, 8) &&
-            write_all(f, &sst.data_bytes, 8) && write_all(f, &mkl, 4) &&
-            write_all(f, sst.max_key.data(), mkl) && write_all(f, &n, 4);
-  for (uint32_t i = 0; ok && i < n; ++i) {
+  put_bytes(&buf, &magic, 4);
+  put_bytes(&buf, &sst.count, 8);
+  put_bytes(&buf, &sst.data_bytes, 8);
+  put_bytes(&buf, &mkl, 4);
+  buf.append(sst.max_key);
+  put_bytes(&buf, &n, 4);
+  for (uint32_t i = 0; i < n; ++i) {
     uint32_t kl = (uint32_t)sst.idx_keys[i].size();
-    ok = write_all(f, &sst.idx_offs[i], 8) && write_all(f, &kl, 4) &&
-         write_all(f, sst.idx_keys[i].data(), kl);
+    put_bytes(&buf, &sst.idx_offs[i], 8);
+    put_bytes(&buf, &kl, 4);
+    buf.append(sst.idx_keys[i]);
+  }
+  uint32_t sum = fnv1a(buf.data(), buf.size());
+  put_bytes(&buf, &sum, 4);
+  std::string tmp = db.idx_path(sst.id) + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) return false;
+  bool ok = write_all(f, buf.data(), buf.size());
+  if (ok) {
+    fflush(f);
+    fsync(fileno(f));
   }
   fclose(f);
-  if (!ok) return false;
+  if (!ok) {
+    unlink(tmp.c_str());
+    return false;
+  }
   return rename(tmp.c_str(), db.idx_path(sst.id).c_str()) == 0;
 }
 
 bool read_idx_file(const Db& db, Sst* sst, uint64_t file_bytes) {
-  FILE* f = fopen(db.idx_path(sst->id).c_str(), "rb");
+  std::string path = db.idx_path(sst->id);
+  struct stat st;
+  // cap: a sparse index is ~1/kIndexEvery of the SST; anything bigger than
+  // the SST itself (+slack) is garbage, reject before allocating
+  if (stat(path.c_str(), &st) != 0) return false;
+  uint64_t sz = (uint64_t)st.st_size;
+  if (sz < 32 || sz > file_bytes + (1u << 20)) return false;
+  FILE* f = fopen(path.c_str(), "rb");
   if (!f) return false;
+  std::string buf(sz, '\0');
+  bool ok = fread(&buf[0], 1, sz, f) == sz;
+  fclose(f);
+  if (!ok) return false;
+  uint32_t want;
+  memcpy(&want, buf.data() + sz - 4, 4);
+  if (fnv1a(buf.data(), sz - 4) != want) return false;
+  const char* p = buf.data();
+  const char* lim = buf.data() + sz - 4;
+  auto take = [&](void* dst, size_t n) {
+    if ((size_t)(lim - p) < n) return false;
+    memcpy(dst, p, n);
+    p += n;
+    return true;
+  };
   uint32_t magic = 0, mkl = 0, n = 0;
-  bool ok = fread(&magic, 1, 4, f) == 4 && magic == kIdxMagic &&
-            fread(&sst->count, 1, 8, f) == 8 &&
-            fread(&sst->data_bytes, 1, 8, f) == 8 &&
-            fread(&mkl, 1, 4, f) == 4;
-  if (ok) {
-    sst->max_key.resize(mkl);
-    ok = (!mkl || fread(&sst->max_key[0], 1, mkl, f) == mkl) &&
-         fread(&n, 1, 4, f) == 4;
+  if (!take(&magic, 4) || magic != kIdxMagic || !take(&sst->count, 8) ||
+      !take(&sst->data_bytes, 8) || !take(&mkl, 4)) {
+    return false;
   }
-  for (uint32_t i = 0; ok && i < n; ++i) {
+  if ((size_t)(lim - p) < mkl) return false;
+  sst->max_key.assign(p, mkl);
+  p += mkl;
+  if (!take(&n, 4)) return false;
+  for (uint32_t i = 0; i < n; ++i) {
     uint64_t off;
     uint32_t kl;
-    ok = fread(&off, 1, 8, f) == 8 && fread(&kl, 1, 4, f) == 4;
-    if (ok) {
-      std::string k(kl, '\0');
-      ok = !kl || fread(&k[0], 1, kl, f) == kl;
-      if (ok) {
-        sst->idx_offs.push_back(off);
-        sst->idx_keys.push_back(std::move(k));
-      }
+    if (!take(&off, 8) || !take(&kl, 4) || (size_t)(lim - p) < kl) {
+      return false;
     }
+    sst->idx_offs.push_back(off);
+    sst->idx_keys.emplace_back(p, kl);
+    p += kl;
   }
-  fclose(f);
   // stale side file (e.g. partial checkpoint restore): fall back to scan
-  return ok && sst->data_bytes <= file_bytes;
+  return sst->data_bytes <= file_bytes;
 }
 
 // one sequential header walk: offsets + sparse keys, payloads skipped
@@ -355,10 +420,23 @@ struct SstWriter {
     fsync(fileno(f));
     fclose(f);
     f = nullptr;
-    if (failed || rename(tmp.c_str(), final_path.c_str()) != 0) {
+    if (failed) {   // don't touch the live input's .idx on an aborted merge
       unlink(tmp.c_str());
       return nullptr;
     }
+    // a leftover .idx from a previous file under this id (id reuse by
+    // merge_run_locked) must die BEFORE the rename: a crash in between
+    // would otherwise pair the new .sst with an index describing the old
+    // one (the checksum can't catch that — the old idx is self-consistent)
+    unlink(db->idx_path(id).c_str());
+    if (rename(tmp.c_str(), final_path.c_str()) != 0) {
+      unlink(tmp.c_str());
+      return nullptr;
+    }
+    // sync_writes promises power-loss durability: the rename (and the idx
+    // unlink) must hit disk before flush_locked truncates the WAL, or the
+    // fsync'd commits could vanish with the lost rename
+    if (db->sync_writes) fsync_dir(db->dir);
     auto sst = std::make_unique<Sst>();
     sst->id = id;
     sst->f = fopen(final_path.c_str(), "rb");
@@ -388,7 +466,16 @@ int merge_run_locked(Db* db, size_t lo, size_t hi) {
     curs[i].seek_to(0);
   }
   bool drop_tombstones = (lo == 0);
-  uint64_t id = db->next_sst_id++;
+  // The output REUSES the oldest input's id. lsm_open rebuilds age order
+  // by sorting ids, so the merged run must sort exactly where the run
+  // lived; a fresh (highest) id would make the merged OLD data the newest
+  // SST after reopen and resurrect stale/deleted keys. Inputs are deleted
+  // below, and id order == age order held before the merge, so reusing
+  // min(run ids) preserves the invariant. Crash safety: after the rename
+  // clobbers input[lo] but before the other inputs are unlinked, the
+  // leftovers carry newer ids and duplicate the merged content, so
+  // newest-wins resolves identically on reopen.
+  uint64_t id = db->ssts[lo]->id;
   SstWriter w;
   if (!w.open(db->sst_path(id))) return -1;
   auto any_err = [&] {
@@ -427,8 +514,10 @@ int merge_run_locked(Db* db, size_t lo, size_t hi) {
   bool empty = (w.count == 0);
   if (!merged && !empty) return -1;
   for (size_t i = lo; i < hi; ++i) {
-    unlink(db->sst_path(db->ssts[i]->id).c_str());
-    unlink(db->idx_path(db->ssts[i]->id).c_str());
+    uint64_t iid = db->ssts[i]->id;
+    if (iid == id) continue;   // the output now lives under this id
+    unlink(db->sst_path(iid).c_str());
+    unlink(db->idx_path(iid).c_str());
   }
   db->ssts.erase(db->ssts.begin() + lo, db->ssts.begin() + hi);
   if (merged && !empty) {
@@ -437,6 +526,7 @@ int merge_run_locked(Db* db, size_t lo, size_t hi) {
     unlink(db->sst_path(id).c_str());
     unlink(db->idx_path(id).c_str());
   }
+  if (db->sync_writes) fsync_dir(db->dir);
   return 0;
 }
 
@@ -535,52 +625,71 @@ void replay_wal(Db* db) {
 }
 
 // merged newest-wins walk of [start, end): calls fn(key, Entry) for every
-// LIVE (non-tombstone) key in order. Streams every SST from its floor
-// offset; memory is O(distinct keys in range) for the dedup map only when
-// collect=true callers keep rows (scan), O(1) per row otherwise.
+// LIVE (non-tombstone) key in ascending order. A streaming k-way merge
+// over the SST cursors plus the memtable — O(#ssts) state regardless of
+// range size (an unbounded count/delete over millions of keys must not
+// materialize them; the same merge shape as merge_run_locked).
+// Returns false if any cursor hit an I/O error — callers MUST distinguish
+// that from a clean end: an error mistaken for exhaustion silently
+// truncates scans, under-counts, and under-deletes ranges.
 template <typename Fn>
-void merged_range_locked(Db* db, const std::string& start,
+bool merged_range_locked(Db* db, const std::string& start,
                          const std::string& end, bool has_end, bool want_values,
                          Fn&& fn) {
-  struct Best {
-    int age;
-    Entry e;
-  };
-  std::map<std::string, Best> best;
-  int age = 0;
-  for (const auto& sstp : db->ssts) {
-    Sst* sst = sstp.get();
-    if (!sst->max_key.empty() && start > sst->max_key) {
-      ++age;
-      continue;
-    }
-    Cursor c;
-    c.sst = sst;
-    c.skip_values = !want_values;   // count/delete walks stay header-only
-    c.seek_to(floor_offset(*sst, start));
+  size_t n = db->ssts.size();
+  std::vector<Cursor> curs(n);   // index order == age order (older first)
+  for (size_t i = 0; i < n; ++i) {
+    Sst* sst = db->ssts[i].get();
+    curs[i].sst = sst;
+    curs[i].skip_values = !want_values;  // count/delete stay header-only
+    if (start > sst->max_key) continue;  // whole SST precedes the range
+    curs[i].seek_to(floor_offset(*sst, start));
     // skip records before start (floor entry may precede it)
-    while (c.ok && c.cur.key < start) c.advance();
-    for (; c.ok; c.advance()) {
-      if (has_end && c.cur.key >= end) break;
-      auto f = best.find(c.cur.key);
-      if (f == best.end() || f->second.age <= age) {
-        best[c.cur.key] = {age, c.cur};
+    while (curs[i].ok && curs[i].cur.key < start) curs[i].advance();
+  }
+  auto mit = db->memtable.lower_bound(start);
+  auto live = [&](size_t i) {
+    return curs[i].ok && (!has_end || curs[i].cur.key < end);
+  };
+  while (true) {
+    for (size_t i = 0; i < n; ++i) {
+      if (curs[i].err) return false;
+    }
+    // smallest key among live cursors; ties go to the NEWEST (largest i)
+    int best = -1;
+    for (size_t i = 0; i < n; ++i) {
+      if (!live(i)) continue;
+      if (best < 0 || curs[i].cur.key <= curs[best].cur.key) best = (int)i;
+    }
+    bool mem_live = mit != db->memtable.end() &&
+                    (!has_end || mit->first < end);
+    // the memtable is newer than every SST, so it wins ties outright
+    bool use_mem =
+        mem_live && (best < 0 || mit->first <= curs[best].cur.key);
+    if (!use_mem && best < 0) break;
+    if (use_mem) {
+      const std::string& k = mit->first;
+      if (mit->second) {
+        Entry e;
+        e.key = k;
+        if (want_values) e.value = *mit->second;
+        fn(k, e);
+      }
+      for (size_t i = 0; i < n; ++i) {   // pop shadowed SST records
+        while (curs[i].ok && curs[i].cur.key == k) curs[i].advance();
+      }
+      ++mit;
+    } else {
+      // copy, not reference: popping the winning cursor below mutates
+      // its cur.key in place
+      const std::string k = curs[best].cur.key;
+      if (!curs[best].cur.tombstone) fn(k, curs[best].cur);
+      for (size_t i = 0; i < n; ++i) {
+        while (curs[i].ok && curs[i].cur.key == k) curs[i].advance();
       }
     }
-    ++age;
   }
-  for (auto it = db->memtable.lower_bound(start); it != db->memtable.end();
-       ++it) {
-    if (has_end && it->first >= end) break;
-    Entry e;
-    e.key = it->first;
-    e.tombstone = !it->second.has_value();
-    if (it->second && want_values) e.value = *it->second;
-    best[it->first] = {age, std::move(e)};
-  }
-  for (auto& [k, b] : best) {
-    if (!b.e.tombstone) fn(k, b.e);
-  }
+  return true;
 }
 
 struct Iter {
@@ -605,13 +714,23 @@ void* lsm_open(const char* dir, uint64_t memtable_bytes, int sync_writes) {
       std::string name = e->d_name;
       if (name.size() == 16 && name.substr(12) == ".sst") {
         ids.push_back(strtoull(name.c_str(), nullptr, 10));
+      } else if (name.size() > 4 &&
+                 name.compare(name.size() - 4, 4, ".tmp") == 0) {
+        // half-written flush/merge/idx output from a crash
+        unlink((db->dir + "/" + name).c_str());
       }
     }
     closedir(d);
   }
   std::sort(ids.begin(), ids.end());
   for (uint64_t id : ids) {
-    open_sst(db, id);
+    // a failed open (fd exhaustion, I/O error during the index scan) must
+    // fail the WHOLE open: proceeding without one SST would silently serve
+    // not-found / stale values for every key that lived in it
+    if (!open_sst(db, id)) {
+      delete db;
+      return nullptr;
+    }
     db->next_sst_id = std::max(db->next_sst_id, id + 1);
   }
   replay_wal(db);
@@ -670,6 +789,9 @@ int lsm_get(void* h, const char* k, uint64_t kl, char** out, uint64_t* outl) {
         return 0;
       }
     }
+    // an I/O error is NOT "not found": the key may live past the failed
+    // read, and falling through to older SSTs could serve a stale value
+    if (c.err) return -1;
   }
   return 1;
 }
@@ -681,11 +803,15 @@ void* lsm_scan(void* h, const char* s, uint64_t sl, const char* e,
   auto* db = (Db*)h;
   std::lock_guard<std::recursive_mutex> g(db->mu);
   auto* it = new Iter();
-  merged_range_locked(
+  bool ok = merged_range_locked(
       db, std::string(s, sl), std::string(e, el), has_end != 0, true,
       [&](const std::string& k, const Entry& en) {
         it->rows.emplace_back(k, en.value);
       });
+  if (!ok) {   // I/O error mid-merge: a truncated scan must not look clean
+    delete it;
+    return nullptr;
+  }
   if (reverse) std::reverse(it->rows.begin(), it->rows.end());
   return it;
 }
@@ -709,9 +835,11 @@ uint64_t lsm_count(void* h, const char* s, uint64_t sl, const char* e,
   auto* db = (Db*)h;
   std::lock_guard<std::recursive_mutex> g(db->mu);
   uint64_t n = 0;
-  merged_range_locked(db, std::string(s, sl), std::string(e, el),
-                      has_end != 0, false,
-                      [&](const std::string&, const Entry&) { ++n; });
+  if (!merged_range_locked(db, std::string(s, sl), std::string(e, el),
+                           has_end != 0, false,
+                           [&](const std::string&, const Entry&) { ++n; })) {
+    return UINT64_MAX;   // error sentinel (a real count can't reach this)
+  }
   return n;
 }
 
@@ -725,17 +853,20 @@ int64_t lsm_delete_range(void* h, const char* s, uint64_t sl, const char* e,
   std::lock_guard<std::recursive_mutex> g(db->mu);
   std::string ops;
   int64_t n = 0;
-  merged_range_locked(db, std::string(s, sl), std::string(e, el),
-                      has_end != 0,
-                      false, [&](const std::string& k, const Entry&) {
-                        uint8_t op = kOpDelete;
-                        uint32_t kl = (uint32_t)k.size(), vl = 0;
-                        ops.append((const char*)&op, 1);
-                        ops.append((const char*)&kl, 4);
-                        ops.append((const char*)&vl, 4);
-                        ops.append(k);
-                        ++n;
-                      });
+  bool ok = merged_range_locked(
+      db, std::string(s, sl), std::string(e, el), has_end != 0, false,
+      [&](const std::string& k, const Entry&) {
+        uint8_t op = kOpDelete;
+        uint32_t kl = (uint32_t)k.size(), vl = 0;
+        ops.append((const char*)&op, 1);
+        ops.append((const char*)&kl, 4);
+        ops.append((const char*)&vl, 4);
+        ops.append(k);
+        ++n;
+      });
+  // a scan error must abort the whole delete: tombstoning only the prefix
+  // we managed to read and reporting success would diverge raft replicas
+  if (!ok) return -3;
   if (n == 0) return 0;
   if (append_wal(db, ops.data(), ops.size()) != 0) return -1;
   if (!apply_ops(db, ops.data(), ops.size())) return -2;
