@@ -136,6 +136,27 @@ def build_parser() -> argparse.ArgumentParser:
     sdbg.add_argument("--dim", type=int, required=True)
     sdbg.add_argument("--topk", type=int, default=5)
 
+    # dump/restore tooling (client_v2 dump/restore, main.cc:225-237)
+    dump = sub.add_parser("dump").add_subparsers(dest="cmd")
+    dr = dump.add_parser("region")
+    dr.add_argument("--region", type=int, required=True)
+    dr.add_argument("--out", required=True)
+    di = dump.add_parser("inspect")
+    di.add_argument("--file", required=True)
+    di.add_argument("--keys", type=int, default=0,
+                    help="also print the first N keys per CF")
+    ds = dump.add_parser("index-snapshot")
+    ds.add_argument("--store", dest="target_store", required=True)
+    ds.add_argument("--region", type=int, required=True)
+
+    br = sub.add_parser("br").add_subparsers(dest="cmd")
+    bb = br.add_parser("backup")
+    bb.add_argument("--dir", required=True)
+    bb.add_argument("--no-resume", action="store_true",
+                    help="ignore progress.json and redo every region")
+    rr = br.add_parser("restore")
+    rr.add_argument("--dir", required=True)
+
     sub.add_parser("repl")
     return p
 
@@ -330,6 +351,66 @@ def run_command(client: DingoClient, args) -> int:
                 "total": r.total_us,
             },
         }))
+    elif g == "dump" and c == "region":
+        from dingo_tpu.br.remote import RemoteBr
+
+        client.refresh_region_map()
+        d = next((r for r in client._regions
+                  if r.region_id == args.region), None)
+        if d is None:
+            print(f"region {args.region} not in the map", file=sys.stderr)
+            return 1
+        blob = RemoteBr(client, ".")._pull_region(d)
+        with open(args.out, "wb") as f:
+            f.write(blob)
+        print(json.dumps({"region_id": args.region, "bytes": len(blob),
+                          "file": args.out}))
+    elif g == "dump" and c == "inspect":
+        from dingo_tpu.raft import wire
+
+        with open(args.file, "rb") as f:
+            state = wire.decode(f.read())
+        # blob shape: {cf: [(key, value), ...]} (engine/raft_engine.py
+        # region_snapshot — the raft snapshot install representation)
+        out = {}
+        for cf, rows in sorted(state.items()):
+            entry = {"keys": len(rows),
+                     "bytes": sum(len(k) + len(v) for k, v in rows)}
+            if args.keys:
+                entry["first_keys"] = [k.hex() for k, _ in rows[:args.keys]]
+            out[cf] = entry
+        print(json.dumps(out, indent=1))
+    elif g == "dump" and c == "index-snapshot":
+        stub = client._stub(args.target_store, "RegionControlService")
+        r = stub.RegionSnapshot(
+            pb.RegionSnapshotRequest(region_id=args.region))
+        if r.error.errcode:
+            print(r.error.errmsg, file=sys.stderr)
+            return 1
+        nstub = client._stub(args.target_store, "NodeService")
+        meta = nstub.GetVectorIndexSnapshotMeta(
+            pb.VectorIndexSnapshotMetaRequest(region_id=args.region))
+        print(json.dumps({
+            "path": r.path,
+            "snapshot_log_id": meta.snapshot_log_id,
+            "files": [{"name": f.name, "size": f.size}
+                      for f in meta.files],
+        }))
+    elif g == "br" and c == "backup":
+        from dingo_tpu.br.remote import RemoteBr
+
+        manifest = RemoteBr(client, args.dir).backup(
+            resume=not args.no_resume)
+        print(json.dumps({
+            "regions": len(manifest["regions"]),
+            "tables": len(manifest.get("tables", [])),
+            "dir": args.dir,
+        }))
+    elif g == "br" and c == "restore":
+        from dingo_tpu.br.remote import RemoteBr
+
+        n = RemoteBr(client, args.dir).restore()
+        print(json.dumps({"restored_regions": n}))
     elif g == "repl":
         return run_repl(client)
     else:

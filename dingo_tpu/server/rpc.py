@@ -95,6 +95,7 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "DropSchema": (pb.DropSchemaRequest, pb.DropSchemaResponse),
         "GetSchemas": (pb.GetSchemasRequest, pb.GetSchemasResponse),
         "CreateTable": (pb.CreateTableRequest, pb.CreateTableResponse),
+        "ImportTable": (pb.ImportTableRequest, pb.ImportTableResponse),
         "DropTable": (pb.DropTableRequest, pb.DropTableResponse),
         "GetTable": (pb.GetTableRequest, pb.GetTableResponse),
         "GetTables": (pb.GetTablesRequest, pb.GetTablesResponse),
@@ -130,6 +131,7 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "SplitRegion": (pb.SplitRegionRequest, pb.SplitRegionResponse),
         "GetRegionMap": (pb.GetRegionMapRequest, pb.GetRegionMapResponse),
         "Tso": (pb.TsoRequest, pb.TsoResponse),
+        "TsoAdvance": (pb.TsoAdvanceRequest, pb.TsoAdvanceResponse),
         "RequeueRegionCmd": (pb.RequeueRegionCmdRequest, pb.RequeueRegionCmdResponse),
         "GetGCSafePoint": (pb.GetGCSafePointRequest, pb.GetGCSafePointResponse),
     },
@@ -149,6 +151,8 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
             pb.RegionRebuildIndexRequest, pb.RegionRebuildIndexResponse,
         ),
         "RegionDetail": (pb.RegionDetailRequest, pb.RegionDetailResponse),
+        "RegionExport": (pb.RegionExportRequest, pb.RegionExportResponse),
+        "RegionImport": (pb.RegionImportRequest, pb.RegionImportResponse),
     },
     "RaftService": {
         "RaftMessage": (pb.RaftMessageRequest, pb.RaftMessageResponse),

@@ -32,6 +32,7 @@ from dingo_tpu.coordinator.tso import TsoControl
 from dingo_tpu.engine.txn import Mutation, Op, TxnEngine, TxnError
 from dingo_tpu.index.base import VectorIndexError
 from dingo_tpu.ops.distance import Metric
+from dingo_tpu.raft import wire
 from dingo_tpu.raft.core import NotLeader
 from dingo_tpu.server import convert, pb
 from dingo_tpu.store.node import StoreNode
@@ -1147,6 +1148,13 @@ class CoordinatorService:
         resp.count = count
         return resp
 
+    def TsoAdvance(self, req: pb.TsoAdvanceRequest) -> pb.TsoAdvanceResponse:
+        """Restore path: future timestamps must stay above the restored
+        cluster's watermark or MVCC versions would collide."""
+        resp = pb.TsoAdvanceResponse()
+        self.tso.advance_to(req.ts)
+        return resp
+
 
 class VersionService:
     """etcd-like KV (version_service.cc analog over KvControl)."""
@@ -1355,6 +1363,50 @@ class MetaService:
         self._table_to_pb(t, resp.definition)
         return resp
 
+    def ImportTable(self, req: pb.ImportTableRequest):
+        """Restore-path registration: partitions must already point at
+        live regions (no region creation — reference br restore)."""
+        from dingo_tpu.coordinator.meta import (
+            ColumnDefinition,
+            MetaError,
+            PartitionDefinition,
+            TableDefinition,
+        )
+        from dingo_tpu.store.region import RegionType
+
+        resp = pb.ImportTableResponse()
+        d = req.definition
+        t = TableDefinition(
+            table_id=0,
+            schema_name=d.schema_name,
+            name=d.name,
+            table_type=[RegionType.STORE, RegionType.INDEX,
+                        RegionType.DOCUMENT][d.table_type],
+            columns=[
+                ColumnDefinition(c.name, c.sql_type or "VARCHAR",
+                                 c.nullable, c.primary)
+                for c in d.columns
+            ],
+            partitions=[
+                PartitionDefinition(
+                    partition_id=p.partition_id, id_lo=p.id_lo,
+                    id_hi=p.id_hi, start_key=p.start_key,
+                    end_key=p.end_key, region_id=p.region_id,
+                )
+                for p in d.partitions
+            ],
+            index_parameter=(
+                convert.index_parameter_from_pb(d.index_parameter)
+                if d.HasField("index_parameter") else None
+            ),
+        )
+        try:
+            registered = self.meta.import_table(t)
+        except (MetaError, RuntimeError) as e:
+            return _err(resp, 40001, str(e))
+        self._table_to_pb(registered, resp.definition)
+        return resp
+
     def DropTable(self, req: pb.DropTableRequest):
         from dingo_tpu.coordinator.meta import MetaError
 
@@ -1466,10 +1518,119 @@ class ClusterStatService:
 
 class RegionControlService:
     """Store-side forced region operations (reference RegionControlService):
-    snapshot / index rebuild / detailed state dump."""
+    snapshot / index rebuild / detailed state dump, plus the BR transport
+    (chunked region export/import — reference src/br/ backup RPCs)."""
+
+    _EXPORT_CHUNK = 1 << 20
+    _TRANSFER_TTL_S = 300.0   # abandoned transfer sessions die after this
 
     def __init__(self, node: StoreNode):
         self.node = node
+        # Transfer sessions, guarded by one lock (the grpc pool is
+        # 16-threaded; two br runs against the same region must not
+        # corrupt each other's stream):
+        #   exports: export_id -> (blob, last_access)   server-assigned id
+        #   imports: (region_id, import_id) -> (bytearray, last_access)
+        self._transfer_lock = threading.Lock()
+        self._exports: Dict[int, list] = {}
+        self._imports: Dict[tuple, list] = {}
+        self._next_export_id = 1
+
+    def _gc_transfers_locked(self) -> None:
+        now = time.monotonic()
+        for d in (self._exports, self._imports):
+            dead = [k for k, v in d.items()
+                    if now - v[1] > self._TRANSFER_TTL_S]
+            for k in dead:   # crashed client: drop its multi-MB buffer
+                del d[k]
+
+    def RegionExport(self, req: pb.RegionExportRequest):
+        from dingo_tpu.engine.raft_engine import region_snapshot
+
+        resp = pb.RegionExportResponse()
+        region = self.node.get_region(req.region_id)
+        if region is None:
+            return _err(resp, 10001, f"region {req.region_id} not found")
+        # leader-gated: a follower can lag raft apply, and a backup that
+        # silently exports a stale replica is a data-losing backup. 20001
+        # routes the client's retry to the leader (reference br backs up
+        # through the leader too).
+        raft = self.node.engine.get_node(req.region_id)
+        if raft is not None and not raft.is_leader():
+            hint = getattr(raft, "leader_id", None) or ""
+            return _err(resp, 20001, f"not leader: {hint}")
+        with self._transfer_lock:
+            self._gc_transfers_locked()
+            if req.export_id == 0:
+                if req.offset != 0:
+                    return _err(resp, 70004,
+                                "offset > 0 requires an export_id")
+                try:
+                    blob = wire.encode(
+                        region_snapshot(self.node.raw, region))
+                except OSError as e:
+                    return _err(resp, 70003, f"export snapshot failed: {e}")
+                export_id = self._next_export_id
+                self._next_export_id += 1
+                self._exports[export_id] = [blob, time.monotonic()]
+            else:
+                export_id = int(req.export_id)
+                ses = self._exports.get(export_id)
+                if ses is None:
+                    return _err(resp, 70004,
+                                f"unknown/expired export {export_id}")
+                ses[1] = time.monotonic()
+                blob = ses[0]
+            limit = (int(req.max_bytes) if req.max_bytes > 0
+                     else self._EXPORT_CHUNK)
+            if not 0 <= req.offset <= len(blob):
+                return _err(resp, 70004, f"bad export offset {req.offset}")
+            resp.data = blob[req.offset:req.offset + limit]
+            resp.total_bytes = len(blob)
+            resp.export_id = export_id
+            resp.eof = req.offset + len(resp.data) >= len(blob)
+            if resp.eof:
+                resp.checksum = wire.blob_checksum(blob)
+                self._exports.pop(export_id, None)
+        return resp
+
+    def RegionImport(self, req: pb.RegionImportRequest):
+        from dingo_tpu.engine.raft_engine import region_install
+
+        resp = pb.RegionImportResponse()
+        region = self.node.get_region(req.region_id)
+        if region is None:
+            return _err(resp, 10001, f"region {req.region_id} not found")
+        key = (int(req.region_id), int(req.import_id))
+        with self._transfer_lock:
+            self._gc_transfers_locked()
+            ses = self._imports.setdefault(key, [bytearray(), 0.0])
+            buf = ses[0]
+            if req.offset != len(buf):
+                if req.offset == 0:
+                    buf.clear()   # restarted push: drop the stale prefix
+                else:
+                    self._imports.pop(key, None)
+                    return _err(resp, 70005,
+                                f"import offset {req.offset} != {len(buf)}")
+            buf.extend(req.data)
+            ses[1] = time.monotonic()
+            if not req.commit:
+                return resp
+            blob = bytes(self._imports.pop(key)[0])
+        if (req.total_bytes != len(blob)
+                or wire.blob_checksum(blob) != req.checksum):
+            return _err(resp, 70006,
+                        "import blob size/checksum mismatch (torn upload)")
+        try:
+            region_install(self.node.raw, region, wire.decode(blob))
+        except (ValueError, OSError) as e:
+            return _err(resp, 70007, f"install failed: {e}")
+        if region.vector_index_wrapper is not None:
+            self.node.index_manager.rebuild(region)
+        if region.document_index is not None:
+            self.node.rebuild_document_index(region)
+        return resp
 
     def RegionSnapshot(self, req: pb.RegionSnapshotRequest):
         resp = pb.RegionSnapshotResponse()
