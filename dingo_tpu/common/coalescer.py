@@ -39,8 +39,11 @@ class SearchCoalescer:
     run_fn(key, queries[batch, d]) must return a list of per-query result
     rows; callers receive exactly their rows. Flush happens when the window
     expires or the batch hits max_batch. One daemon timer thread serves all
-    keys (flushing runs the search on the submitting thread's behalf, so
-    device dispatch order stays sane).
+    keys, sleeping until the earliest pending deadline; a caller whose own
+    submission fills a batch runs that batch inline (its results are in
+    it), while a cap-displaced previous batch is flushed on its own thread
+    so the new caller never pays for a search it is not part of and the
+    timer thread stays free for other keys' expiries.
     """
 
     def __init__(self, run_fn: Callable[[Any, np.ndarray], Sequence],
@@ -75,8 +78,11 @@ class SearchCoalescer:
             if batch is not None and (
                 sum(len(q) for q in batch.queries) + len(queries) > cap
             ):
-                # adding would exceed the cap: flush what's queued, start
-                # a fresh batch for this request
+                # adding would exceed the cap: flush the queued batch on
+                # its own thread (running it HERE would charge the
+                # previous batch's whole search to this caller's latency,
+                # and the shared timer thread must stay free for other
+                # keys' window expiries) and start fresh for this request
                 flush_first = self._pending.pop(key)
                 batch = None
             if batch is None:
@@ -86,8 +92,13 @@ class SearchCoalescer:
             if sum(len(q) for q in batch.queries) >= cap:
                 flush_now = self._pending.pop(key)
         if flush_first is not None:
-            self._run(key, flush_first)
+            threading.Thread(
+                target=self._run, args=(key, flush_first),
+                name="coalescer-flush", daemon=True,
+            ).start()
         if flush_now is not None:
+            # the caller's own batch is full: run it inline (lowest
+            # latency for everyone already in it)
             self._run(key, flush_now)
         else:
             self._wake.set()
@@ -108,19 +119,27 @@ class SearchCoalescer:
                     fut.set_exception(e)
 
     def _flush_loop(self) -> None:
+        timeout = None   # nothing pending: sleep until a submit wakes us
         while True:
-            # poll at half-window granularity: adds <= window/2 extra wait,
-            # keeps the loop free of per-key timers
-            self._wake.wait(timeout=self.window_s / 2)
+            # wait until the EARLIEST pending batch's deadline (not a
+            # fixed half-window poll, which stretched worst-case wait to
+            # 1.5x the configured window)
+            self._wake.wait(timeout=timeout)
             self._wake.clear()
             if self._stop:
                 return
             now = time.monotonic()
             due: List[Tuple[Any, _PendingBatch]] = []
+            timeout = None
             with self._lock:
                 for key in list(self._pending):
-                    if now - self._pending[key].created >= self.window_s:
+                    age = now - self._pending[key].created
+                    if age >= self.window_s:
                         due.append((key, self._pending.pop(key)))
+                    else:
+                        remain = self.window_s - age
+                        timeout = remain if timeout is None else min(
+                            timeout, remain)
             for key, batch in due:
                 self._run(key, batch)
 

@@ -95,6 +95,12 @@ FLAGS.define("wal_checkpoint_bytes", 64 * 1024 * 1024, mutable=True,
 FLAGS.define("diskann_server_addr", "", mutable=True,
              help_="endpoint of the --role=diskann server; required to "
                    "create VECTOR_INDEX_TYPE_DISKANN indexes")
+FLAGS.define("diskann_rerank_io_rows", 8192, mutable=True,
+             help_="exact-rerank disk gathers read at most this many "
+                   "(sorted, deduplicated) rows per memmap access — an IO "
+                   "budget so a big batch*k*rerank_factor fan-out cannot "
+                   "issue one unbounded random-read burst on spinning "
+                   "or network storage")
 FLAGS.define("use_mesh_sharded_flat", False, mutable=True,
              help_="serve FLAT regions from a mesh-sharded index "
                    "(TpuShardedFlat): rows over the 'data' axis, feature "

@@ -173,3 +173,38 @@ def test_per_submit_cap_splits_batches():
         assert all(c <= 8 for c in calls), calls
     finally:
         co.stop()
+
+
+def test_cap_displaced_batch_does_not_block_submitter():
+    """A submission that displaces a full previous batch must not run that
+    batch's search inline — the displaced batch flushes on the timer
+    thread while the new caller's submit returns immediately."""
+    import threading
+    import time as _time
+
+    release = threading.Event()
+    started = threading.Event()
+
+    def run(key, stacked):
+        if len(stacked) == 6:          # the displaced batch
+            started.set()
+            assert release.wait(5)
+        return list(range(len(stacked)))
+
+    # window long enough that the 6-row batch CANNOT flush by expiry
+    # between the two submits (the displacement path must actually run)
+    co = SearchCoalescer(run, window_ms=500.0, max_batch=1024)
+    try:
+        f1 = co.submit("k", np.zeros((6, 2), np.float32), max_batch=8)
+        t0 = _time.monotonic()
+        f2 = co.submit("k", np.zeros((4, 2), np.float32), max_batch=8)
+        submit_s = _time.monotonic() - t0
+        # the displaced batch's (blocked) search runs elsewhere
+        assert submit_s < 1.0, submit_s
+        assert started.wait(5)
+        assert not f1.done()           # still blocked in run_fn
+        release.set()
+        assert len(f1.result(timeout=5)) == 6
+        assert len(f2.result(timeout=5)) == 4
+    finally:
+        co.stop()

@@ -65,16 +65,28 @@ def wait_leader(stores, timeout=5.0):
     raise AssertionError("no unique leader")
 
 
+def _on_leader(stores, fn, attempts=8):
+    """Run fn(storage, region) on the current leader, retrying across
+    leadership churn (elections can fire between wait_leader and the
+    write when the suite loads the CPU and delays ticks)."""
+    for _ in range(attempts):
+        leader_id = wait_leader(stores)
+        engine, region = stores[leader_id]
+        try:
+            return fn(Storage(engine), region)
+        except NotLeader:
+            time.sleep(0.1)
+    raise AssertionError("leadership never stabilized")
+
+
 def test_vector_write_replicates_to_all(cluster):
     transport, stores = cluster
-    leader_id = wait_leader(stores)
-    engine, region = stores[leader_id]
-    storage = Storage(engine)
     rng = np.random.default_rng(0)
     x = rng.standard_normal((50, DIM)).astype(np.float32)
     ids = np.arange(50, dtype=np.int64)
-    storage.vector_add(region, ids, x, [{"i": int(i)} for i in ids])
-    storage.vector_delete(region, [0, 1])
+    _on_leader(stores, lambda s, r: s.vector_add(
+        r, ids, x, [{"i": int(i)} for i in ids]))
+    _on_leader(stores, lambda s, r: s.vector_delete(r, [0, 1]))
 
     time.sleep(0.4)  # let followers apply via heartbeats
     for sid, (e, r) in stores.items():
