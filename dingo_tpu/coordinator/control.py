@@ -75,6 +75,9 @@ class RegionCmd:
     target_store_id: str = ""
     status: str = "pending"
     retries: int = 0
+    #: store the cmd was queued to (job attribution; queues themselves are
+    #: pruned once the store acks execution, so history lives in `jobs`)
+    store_id: str = ""
 
 
 @persist.register
@@ -144,12 +147,16 @@ class CoordinatorControl:
         self._persist(_KEY_OPS, (self.store_ops, self.region_leaders))
 
     # ---------------- store registry ----------------------------------------
-    def register_store(self, store_id: str, address: str = "") -> None:
+    def register_store(self, store_id: str, address: str = "",
+                       now_ms: Optional[int] = None) -> None:
+        """`now_ms` is supplied by the raft-meta harness so the op applies
+        identically on every coordinator replica (wall clock is not
+        deterministic); direct single-coordinator callers omit it."""
         with self._lock:
             info = self.stores.get(store_id) or StoreInfo(store_id, address)
             info.address = address or info.address
             info.state = StoreState.NORMAL
-            info.last_heartbeat_ms = int(time.time() * 1000)
+            info.last_heartbeat_ms = now_ms or int(time.time() * 1000)
             self.stores[store_id] = info
             self.store_ops.setdefault(store_id, [])
             self._persist(_PREFIX_STORE + store_id.encode(), info)
@@ -162,6 +169,8 @@ class CoordinatorControl:
         capacity_bytes: int = 0,
         used_bytes: int = 0,
         region_defs: Sequence[RegionDefinition] = (),
+        now_ms: Optional[int] = None,
+        done_cmd_ids: Sequence[int] = (),
     ) -> List[RegionCmd]:
         """StoreHeartbeat: record metrics, reconcile region topology from the
         store's reported definitions (splits survive leader crashes this
@@ -178,9 +187,9 @@ class CoordinatorControl:
                     )
             info = self.stores.get(store_id)
             if info is None:
-                self.register_store(store_id)
+                self.register_store(store_id, now_ms=now_ms)
                 info = self.stores[store_id]
-            info.last_heartbeat_ms = int(time.time() * 1000)
+            info.last_heartbeat_ms = now_ms or int(time.time() * 1000)
             info.region_ids = list(region_ids)
             info.leader_region_ids = list(leader_region_ids)
             info.capacity_bytes = capacity_bytes
@@ -189,17 +198,48 @@ class CoordinatorControl:
                 self.region_leaders[rid] = store_id
             self._persist(_PREFIX_STORE + store_id.encode(), info)
             ops = self.store_ops.get(store_id, [])
+            # ack: drop commands the store reports executed — without this
+            # a remote (or raft-replicated) coordinator never learns a cmd
+            # finished, and every leader election would re-deliver the whole
+            # history via reset_sent_cmds
+            if done_cmd_ids:
+                done = set(done_cmd_ids)
+                ops[:] = [c for c in ops if c.cmd_id not in done]
+                for j in self.jobs:
+                    # "pending" too: a leader election may have re-armed the
+                    # job (reset_sent_cmds) before the store's ack landed
+                    if j.cmd_id in done and j.status in ("sent", "pending"):
+                        j.status = "done"
             pending = [c for c in ops if c.status == "pending"]
             for c in pending:
                 c.status = "sent"
-            if pending:
+            if pending or done_cmd_ids:
                 self._persist_ops()
             return pending
 
-    def update_store_states(self) -> List[str]:
+    def reset_sent_cmds(self) -> int:
+        """Mark every 'sent' command deliverable again. A command is 'sent'
+        once handed to a store in a heartbeat response; if the coordinator
+        (leader) dies before the response reaches the store, no survivor
+        would re-deliver it. The new raft leader proposes this op on
+        election — the store side dedups by cmd_id, so re-delivery is safe
+        (reference re-pushes store operations the same way,
+        RpcSendPushStoreOperation coordinator_control.h:547)."""
+        with self._lock:
+            n = 0
+            for q in self.store_ops.values():
+                for c in q:
+                    if c.status == "sent":
+                        c.status = "pending"
+                        n += 1
+            if n:
+                self._persist_ops()
+            return n
+
+    def update_store_states(self, now_ms: Optional[int] = None) -> List[str]:
         """UpdateStoreState crontab: mark silent stores OFFLINE; returns the
         newly-offline store ids (region health checks follow)."""
-        now = int(time.time() * 1000)
+        now = now_ms or int(time.time() * 1000)
         newly = []
         with self._lock:
             for info in self.stores.values():
@@ -296,9 +336,15 @@ class CoordinatorControl:
         )
         return [s.store_id for s in alive[:n]]
 
+    #: retained job-history entries (introspection; oldest trimmed)
+    JOB_HISTORY_MAX = 10_000
+
     def _queue_cmd(self, store_id: str, cmd: RegionCmd) -> None:
+        cmd.store_id = store_id
         self.store_ops.setdefault(store_id, []).append(cmd)
         self.jobs.append(cmd)
+        if len(self.jobs) > self.JOB_HISTORY_MAX:
+            del self.jobs[: len(self.jobs) - self.JOB_HISTORY_MAX]
         self._persist_ops()
 
     def requeue_cmd(self, cmd: RegionCmd, store_id: str,
@@ -310,12 +356,21 @@ class CoordinatorControl:
         with self._lock:
             if from_store is not None:
                 src = self.store_ops.get(from_store, [])
-                if cmd in src:
-                    src.remove(cmd)
+                src[:] = [c for c in src if c.cmd_id != cmd.cmd_id]
             cmd.status = "pending"
+            cmd.store_id = store_id
             q = self.store_ops.setdefault(store_id, [])
-            if cmd not in q:
+            if all(c.cmd_id != cmd.cmd_id for c in q):
                 q.append(cmd)
+            # keep the jobs history pointing at the LIVE object (a remote
+            # requeue arrives as a fresh pb-decoded copy; the stale entry
+            # would otherwise show the old store/status forever)
+            for i, j in enumerate(self.jobs):
+                if j.cmd_id == cmd.cmd_id:
+                    self.jobs[i] = cmd
+                    break
+            else:
+                self.jobs.append(cmd)
             self._persist_ops()
 
     def drop_region(self, region_id: int) -> None:

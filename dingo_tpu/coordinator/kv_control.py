@@ -159,12 +159,13 @@ class KvControl:
         )
 
     # ---------------- KV ------------------------------------------------------
-    def kv_put(self, key: bytes, value: bytes, lease_id: int = 0) -> int:
+    def kv_put(self, key: bytes, value: bytes, lease_id: int = 0,
+               now_ms: Optional[int] = None) -> int:
         """Returns the new revision (KvPut, kv_control.h:263)."""
         with self._lock:
             if lease_id:
                 lease = self._leases.get(lease_id)
-                if lease is None or lease.expired():
+                if lease is None or lease.expired(now_ms):
                     raise KeyError(f"lease {lease_id} not found/expired")
                 if key not in lease.keys:
                     lease.keys.append(key)
@@ -205,7 +206,10 @@ class KvControl:
         revision > 0, reads as of that PAST revision (etcd range
         revision); below the compaction floor raises CompactedError."""
         with self._lock:
-            self._expire_leases()
+            # NOTE deliberately no lease expiry here: a read must not mutate
+            # state (in raft-meta mode a follower read would fork replica
+            # state off-log). The lease_gc crontab — replicated through the
+            # log on the leader — is the only expiry path.
             if revision and revision < self._compact_revision:
                 raise CompactedError(
                     f"revision {revision} compacted "
@@ -291,22 +295,26 @@ class KvControl:
             return removed
 
     # ---------------- leases --------------------------------------------------
-    def lease_grant(self, ttl_s: int, lease_id: int = 0) -> Lease:
+    def lease_grant(self, ttl_s: int, lease_id: int = 0,
+                    now_ms: Optional[int] = None) -> Lease:
+        """`now_ms` comes from the raft-meta harness in replicated mode so
+        lease clocks are identical on every coordinator replica."""
         with self._lock:
             lid = lease_id or self._next_lease
             self._next_lease = max(self._next_lease, lid + 1)
             lease = Lease(lease_id=lid, ttl_s=ttl_s,
-                          granted_ms=int(time.time() * 1000))
+                          granted_ms=now_ms or int(time.time() * 1000))
             self._leases[lid] = lease
             self._persist_lease(lease)
             return lease
 
-    def lease_renew(self, lease_id: int) -> Lease:
+    def lease_renew(self, lease_id: int,
+                    now_ms: Optional[int] = None) -> Lease:
         with self._lock:
             lease = self._leases.get(lease_id)
-            if lease is None or lease.expired():
+            if lease is None or lease.expired(now_ms):
                 raise KeyError(f"lease {lease_id} not found/expired")
-            lease.granted_ms = int(time.time() * 1000)
+            lease.granted_ms = now_ms or int(time.time() * 1000)
             self._persist_lease(lease)
             return lease
 
@@ -322,15 +330,15 @@ class KvControl:
                 n += self.kv_delete_range(key)
             return n
 
-    def _expire_leases(self) -> None:
+    def _expire_leases(self, now_ms: Optional[int] = None) -> None:
         for lid, lease in list(self._leases.items()):
-            if lease.expired():
+            if lease.expired(now_ms):
                 self.lease_revoke(lid)
 
-    def lease_gc(self) -> None:
+    def lease_gc(self, now_ms: Optional[int] = None) -> None:
         """Crontab entry point (lease expiry sweep)."""
         with self._lock:
-            self._expire_leases()
+            self._expire_leases(now_ms)
 
     # ---------------- watches -------------------------------------------------
     def watch(self, key: bytes, start_revision: int,

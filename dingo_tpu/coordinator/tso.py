@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Tuple
+from typing import Optional, Tuple
 
 from dingo_tpu.common import persist
 from dingo_tpu.engine.raw_engine import CF_META, RawEngine
@@ -24,16 +24,26 @@ SAVE_AHEAD_MS = 3000
 
 
 class TsoControl:
-    def __init__(self, engine: RawEngine):
+    def __init__(self, engine: RawEngine, clock_init: bool = True):
+        """clock_init=False (raft-meta mode) initializes the physical mark
+        from PERSISTED state only: seeding from the local wall clock would
+        let a clock-skewed leader issue timestamps above anything recorded
+        in the replicated log, which a failover successor (whose state is
+        exactly the applied log) could then re-issue. Deterministic mode
+        takes time exclusively from the now_ms the leader stamps into each
+        replicated gen_ts op."""
         self.engine = engine
         self._lock = threading.Lock()
         blob = engine.get(CF_META, _KEY)
         persisted = persist.loads(blob) if blob else 0
         # never go below the persisted watermark (failover safety)
-        self._physical = max(persisted, int(time.time() * 1000))
+        self._physical = max(
+            persisted, int(time.time() * 1000) if clock_init else 0
+        )
         self._logical = 0
         self._persisted_until = persisted
-        self._save_ahead()
+        if clock_init:
+            self._save_ahead()
 
     def _save_ahead(self) -> None:
         target = self._physical + SAVE_AHEAD_MS
@@ -41,10 +51,13 @@ class TsoControl:
             self.engine.put(CF_META, _KEY, persist.dumps(target))
             self._persisted_until = target
 
-    def gen_ts(self, count: int = 1) -> Tuple[int, int]:
-        """GenerateTso: a contiguous block [first, first+count)."""
+    def gen_ts(self, count: int = 1,
+               now_ms: Optional[int] = None) -> Tuple[int, int]:
+        """GenerateTso: a contiguous block [first, first+count). In
+        raft-meta mode now_ms is the leader's stamp so the op applies
+        identically on every replica."""
         with self._lock:
-            now = int(time.time() * 1000)
+            now = now_ms or int(time.time() * 1000)
             if now > self._physical:
                 self._physical = now
                 self._logical = 0

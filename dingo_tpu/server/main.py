@@ -60,26 +60,79 @@ def _make_engine(args):
 
 def serve_coordinator(args) -> None:
     engine = _make_engine(args)
-    control = CoordinatorControl(engine, replication=args.replication)
-    tso = TsoControl(engine)
-    kv_control = KvControl(engine)
+    raft_coordinator = None
+    if args.coor_peers:
+        # replicated coordinator: every control mutation rides a raft group
+        # (coordinator_control.h:218 SubmitMetaIncrementSync analog)
+        import os
+
+        from dingo_tpu.coordinator.raft_meta import RaftMetaCoordinator
+        from dingo_tpu.raft.grpc_transport import GrpcRaftTransport
+        from dingo_tpu.raft.log import RaftLog
+
+        transport = GrpcRaftTransport(args.id,
+                                      cluster_token=args.cluster_token)
+        peer_ids = []
+        for spec in args.coor_peers.split(","):
+            cid, eq, addr = spec.strip().partition("=")
+            if not eq or not cid or not addr:
+                raise SystemExit(
+                    f"--coor-peers: malformed entry {spec!r} "
+                    "(want coor_id=host:port)"
+                )
+            transport.set_peer(cid.strip(), addr.strip())
+            peer_ids.append(cid.strip())
+        log = RaftLog(os.path.join(args.data_dir, "meta_raft.log")) \
+            if args.data_dir else None
+        raft_coordinator = RaftMetaCoordinator(
+            args.id, peer_ids, transport, engine,
+            replication=args.replication, log=log,
+        )
+        raft_coordinator.start()
+        control = raft_coordinator.control
+        tso = raft_coordinator.tso
+        kv_control = raft_coordinator.kv
+        meta = raft_coordinator.meta
+        is_leader = raft_coordinator.is_leader
+    else:
+        control = CoordinatorControl(engine, replication=args.replication)
+        tso = TsoControl(engine)
+        kv_control = KvControl(engine)
+        meta = None
+        is_leader = lambda: True  # noqa: E731 — single coordinator
 
     server = DingoServer(args.port)
-    server.host_coordinator_role(control, tso, kv_control)
+    server.host_coordinator_role(
+        control, tso, kv_control, meta=meta,
+        raft_transport=(raft_coordinator and transport) or None,
+    )
     port = server.start()
 
+    def when_leader(fn):
+        """Crontab mutations run only on the raft leader — a follower
+        proposing would just bounce with NotLeader."""
+        return lambda: fn() if is_leader() else None
+
     crontab = CrontabManager()
-    crontab.add("update_store_state", 5.0, control.update_store_states)
-    crontab.add("lease_gc", 10.0, kv_control.lease_gc)
+    crontab.add("update_store_state", 5.0,
+                when_leader(control.update_store_states))
+    crontab.add("lease_gc", 10.0, when_leader(kv_control.lease_gc))
     crontab.add(
-        "balance_leader", 30.0, BalanceLeaderScheduler(control).dispatch
+        "balance_leader", 30.0,
+        when_leader(BalanceLeaderScheduler(control).dispatch),
     )
     crontab.add(
-        "balance_region", 60.0, BalanceRegionScheduler(control).dispatch
+        "balance_region", 60.0,
+        when_leader(BalanceRegionScheduler(control).dispatch),
     )
     crontab.start()
-    print(f"coordinator listening on 127.0.0.1:{port}", flush=True)
-    _wait(server, crontab)
+    print(f"coordinator {args.id} listening on 127.0.0.1:{port}"
+          + (" (raft group)" if raft_coordinator else ""), flush=True)
+    try:
+        _wait(server, crontab)
+    finally:
+        if raft_coordinator is not None:
+            raft_coordinator.stop()
 
 
 def serve_store(args) -> None:
@@ -235,6 +288,10 @@ def main(argv=None) -> int:
                    help="shared secret gating the raft transport")
     p.add_argument("--raft-peers", default="",
                    help="store raft endpoints: s0=host:port,s1=host:port,...")
+    p.add_argument("--coor-peers", default="",
+                   help="coordinator raft group endpoints: "
+                        "coor0=host:port,... (replicated coordinator; this "
+                        "process's --id must be one of the ids)")
     args = p.parse_args(argv)
     if args.engine in ("lsm", "wal") and not args.data_dir \
             and args.role != "diskann":

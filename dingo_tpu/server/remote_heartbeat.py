@@ -3,46 +3,109 @@
 The in-process path calls CoordinatorControl directly (StoreNode.heartbeat_
 once); multi-process stores use this grpc client instead — same payload,
 same command execution on the response (store/heartbeat.cc:61,294 flow).
+
+Replicated-coordinator aware: `coordinator_addr` may be a comma-separated
+list of the raft group's endpoints. A follower answers StoreHeartbeat with
+errcode 20001 ("not leader"); the client rotates to the next endpoint until
+one accepts, the same retry contract the SDK uses for store-side NotLeader.
+Executed commands are deduped by cmd_id (coordinator failover re-delivers)
+and acked back via done_cmd_ids so the coordinator prunes its queues.
 """
 
 from __future__ import annotations
 
+from typing import List
+
 import grpc
 
-from dingo_tpu.coordinator.control import RegionCmd, RegionCmdType
 from dingo_tpu.server import convert, pb
 from dingo_tpu.server.rpc import ServiceStub
+
+_ERR_NOT_LEADER = 20001
+
+
+class HeartbeatError(RuntimeError):
+    pass
 
 
 class RemoteHeartbeat:
     def __init__(self, node, coordinator_addr: str):
         self.node = node
-        self._channel = grpc.insecure_channel(coordinator_addr)
+        self._addrs: List[str] = [
+            a.strip() for a in coordinator_addr.split(",") if a.strip()
+        ]
+        self._active = 0
+        self._channel = None
+        self._stub = None
+        self._connect(self._active)
+
+    def _connect(self, idx: int) -> None:
+        if self._channel is not None:
+            self._channel.close()
+        self._active = idx % len(self._addrs)
+        self._channel = grpc.insecure_channel(self._addrs[self._active])
         self._stub = ServiceStub(self._channel, "CoordinatorService")
 
+    def _call(self, method: str, req):
+        """Invoke on the active coordinator; on NotLeader/connect failure
+        rotate through the remaining endpoints once before giving up."""
+        last = None
+        for _attempt in range(len(self._addrs)):
+            try:
+                resp = getattr(self._stub, method)(req)
+            except grpc.RpcError as e:
+                last = HeartbeatError(
+                    f"{method} via {self._addrs[self._active]}: {e.code()}"
+                )
+                self._connect(self._active + 1)
+                continue
+            err = getattr(resp, "error", None)
+            if err is not None and err.errcode == _ERR_NOT_LEADER:
+                last = HeartbeatError(
+                    f"{method}: {self._addrs[self._active]} is not leader "
+                    f"({err.errmsg})"
+                )
+                self._connect(self._active + 1)
+                continue
+            if err is not None and err.errcode:
+                raise HeartbeatError(f"{method}: {err.errmsg}")
+            return resp
+        raise last or HeartbeatError(f"{method}: no coordinator reachable")
+
     def beat(self) -> int:
-        regions = self.node.meta.get_all_regions()
+        node = self.node
+        regions = node.meta.get_all_regions()
         leader_ids = [
             r.id for r in regions
-            if (n := self.node.engine.get_node(r.id)) is not None
+            if (n := node.engine.get_node(r.id)) is not None
             and n.is_leader()
         ]
         req = pb.StoreHeartbeatRequest()
-        req.store_id = self.node.store_id
+        req.store_id = node.store_id
         req.region_ids.extend(r.id for r in regions)
         req.leader_region_ids.extend(leader_ids)
+        acking = list(node._unacked_done)
+        req.done_cmd_ids.extend(acking)
         for r in regions:
             if r.id in leader_ids:
                 req.region_definitions.add().CopyFrom(
                     convert.region_def_to_pb(r.definition)
                 )
-        resp = self._stub.StoreHeartbeat(req)
+        resp = self._call("StoreHeartbeat", req)
+        node._unacked_done.difference_update(acking)
         executed = 0
         for c in resp.commands:
+            if c.cmd_id in node._done_cmd_ids:
+                node._unacked_done.add(c.cmd_id)   # re-delivered: re-ack
+                continue
             cmd = convert.region_cmd_from_pb(c)
             try:
-                self.node.execute_region_cmd(cmd)
+                node.execute_region_cmd(cmd)
                 executed += 1
+                node._done_cmd_ids[c.cmd_id] = None
+                node._unacked_done.add(c.cmd_id)
+                while len(node._done_cmd_ids) > 10_000:
+                    node._done_cmd_ids.popitem(last=False)
             except Exception as e:  # noqa: BLE001
                 from dingo_tpu.raft.core import NotLeader
 
@@ -52,9 +115,9 @@ class RemoteHeartbeat:
                     rq = pb.RequeueRegionCmdRequest()
                     rq.cmd.CopyFrom(c)
                     rq.target_store_id = e.leader_hint.split("/")[0]
-                    rq.from_store_id = self.node.store_id
+                    rq.from_store_id = node.store_id
                     try:
-                        self._stub.RequeueRegionCmd(rq)
-                    except Exception:
+                        self._call("RequeueRegionCmd", rq)
+                    except HeartbeatError:
                         pass
         return executed

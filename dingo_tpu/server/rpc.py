@@ -12,6 +12,7 @@ from typing import Dict, Optional, Tuple
 
 import grpc
 
+from dingo_tpu.raft.core import NotLeader
 from dingo_tpu.server import pb
 from dingo_tpu.server.services import (
     CoordinatorService,
@@ -187,6 +188,15 @@ def _register(server: grpc.Server, service_name: str, impl) -> None:
             def handler(request, context):
                 try:
                     return fn(request)
+                except NotLeader as e:
+                    # replicated-coordinator followers (raft_meta proxies)
+                    # surface the hint so clients re-route, same contract
+                    # as store-side region writes
+                    resp = resp_t()
+                    if hasattr(resp, "error"):
+                        resp.error.errcode = 20001
+                        resp.error.errmsg = f"not leader: {e.leader_hint}"
+                    return resp
                 except Exception as e:  # noqa: BLE001
                     # unexpected failures (incl. injected failpoints) become
                     # in-band errors instead of opaque grpc UNKNOWNs
@@ -247,9 +257,17 @@ class DingoServer:
         _register(self._server, "DebugService", DebugService())
 
     def host_coordinator_role(self, control, tso, kv_control,
-                              meta=None) -> None:
-        """--role=coordinator service set."""
+                              meta=None, raft_transport=None) -> None:
+        """--role=coordinator service set. `raft_transport` (a
+        GrpcRaftTransport) is set for replicated-coordinator deployments so
+        the meta raft group's RPCs land here."""
         from dingo_tpu.server.services import MetaService
+
+        if raft_transport is not None:
+            from dingo_tpu.raft.grpc_transport import RaftService
+
+            _register(self._server, "RaftService",
+                      RaftService(raft_transport))
 
         _register(self._server, "CoordinatorService",
                   CoordinatorService(control, tso))

@@ -1079,6 +1079,7 @@ class CoordinatorService:
             region_defs=[
                 convert.region_def_from_pb(d) for d in req.region_definitions
             ],
+            done_cmd_ids=list(req.done_cmd_ids),
         )
         for c in cmds:
             out = resp.commands.add()
@@ -1471,17 +1472,18 @@ class JobService:
     def ListJobs(self, req: pb.ListJobsRequest):
         resp = pb.ListJobsResponse()
         with self.control._lock:
-            for store_id, cmds in self.control.store_ops.items():
-                for cmd in cmds:
-                    if cmd.status == "done" and not req.include_done:
-                        continue
-                    j = resp.jobs.add()
-                    j.cmd_id = cmd.cmd_id
-                    j.region_id = cmd.region_id
-                    j.cmd_type = cmd.cmd_type.value
-                    j.status = cmd.status
-                    j.store_id = store_id
-                    j.retries = cmd.retries
+            # jobs is the retained history — store_ops queues are pruned
+            # once the store acks execution
+            for cmd in self.control.jobs:
+                if cmd.status == "done" and not req.include_done:
+                    continue
+                j = resp.jobs.add()
+                j.cmd_id = cmd.cmd_id
+                j.region_id = cmd.region_id
+                j.cmd_type = cmd.cmd_type.value
+                j.status = cmd.status
+                j.store_id = cmd.store_id
+                j.retries = cmd.retries
         return resp
 
 

@@ -11,6 +11,7 @@ parent's vector index until the child's own rebuild completes
 from __future__ import annotations
 
 import copy
+from collections import OrderedDict
 import dataclasses
 import threading
 import time
@@ -58,6 +59,13 @@ class StoreNode:
         self._lock = threading.RLock()
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        #: cmd_ids already executed — a coordinator leader failover re-arms
+        #: 'sent' commands (reset_sent_cmds) so delivery is at-least-once;
+        #: this makes execution exactly-once on the store
+        self._done_cmd_ids: "OrderedDict[int, None]" = OrderedDict()
+        #: executed cmd_ids not yet acked to the coordinator; reported in
+        #: the next heartbeat so the coordinator prunes its queues
+        self._unacked_done: set = set()
         if coordinator is not None:
             coordinator.register_store(store_id)
 
@@ -339,19 +347,32 @@ class StoreNode:
             r.id for r in regions
             if (n := self.engine.get_node(r.id)) is not None and n.is_leader()
         ]
+        acking = list(self._unacked_done)
         cmds = self.coordinator.store_heartbeat(
             self.store_id,
             region_ids=[r.id for r in regions],
             leader_region_ids=leader_ids,
             region_defs=[r.definition for r in regions
                          if r.id in leader_ids],
+            done_cmd_ids=acking,
         )
+        # the call returned, so the coordinator applied the acks (raft-
+        # replicated coordinators apply before responding)
+        self._unacked_done.difference_update(acking)
         from dingo_tpu.raft.core import NotLeader
 
         for cmd in cmds:
+            if cmd.cmd_id in self._done_cmd_ids:
+                cmd.status = "done"    # duplicate delivery after coordinator
+                self._unacked_done.add(cmd.cmd_id)  # failover — re-ack only
+                continue
             try:
                 self.execute_region_cmd(cmd)
                 cmd.status = "done"
+                self._done_cmd_ids[cmd.cmd_id] = None
+                self._unacked_done.add(cmd.cmd_id)
+                while len(self._done_cmd_ids) > 10_000:
+                    self._done_cmd_ids.popitem(last=False)
             except NotLeader as e:
                 # leadership moved: hand the command to the hinted leader
                 # ("<store>/r<region>" address) or back to the queue

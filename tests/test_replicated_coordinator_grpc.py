@@ -1,0 +1,123 @@
+"""Replicated coordinator over REAL grpc sockets + RemoteHeartbeat failover.
+
+Three coordinator processes-worth of RaftMetaCoordinator, each behind its
+own DingoServer with a GrpcRaftTransport (the --coor-peers deployment shape
+from server/main.py), plus a store heartbeating through RemoteHeartbeat
+with the full endpoint list. Verifies: NotLeader rotation, ack-based queue
+pruning, and command delivery surviving a coordinator leader kill.
+"""
+
+import time
+
+import pytest
+
+from dingo_tpu.coordinator.raft_meta import RaftMetaCoordinator
+from dingo_tpu.engine.raw_engine import MemEngine
+from dingo_tpu.raft.grpc_transport import GrpcRaftTransport
+from dingo_tpu.raft.transport import LocalTransport
+from dingo_tpu.server.remote_heartbeat import RemoteHeartbeat
+from dingo_tpu.server.rpc import DingoServer
+from dingo_tpu.store.node import StoreNode
+
+COORS = ["coor0", "coor1", "coor2"]
+FAST = dict(election_timeout=(0.1, 0.25), heartbeat_interval=0.04)
+
+
+@pytest.fixture()
+def coor_group():
+    coords, servers, transports, addrs = [], [], [], {}
+    for i, cid in enumerate(COORS):
+        t = GrpcRaftTransport(cid)
+        c = RaftMetaCoordinator(cid, COORS, t, MemEngine(),
+                                **FAST, seed=i)
+        srv = DingoServer()
+        srv.host_coordinator_role(c.control, c.tso, c.kv, meta=c.meta,
+                                  raft_transport=t)
+        port = srv.start()
+        addrs[cid] = f"127.0.0.1:{port}"
+        coords.append(c)
+        servers.append(srv)
+        transports.append(t)
+    for t in transports:
+        for cid, addr in addrs.items():
+            t.set_peer(cid, addr)
+    for c in coords:
+        c.start()
+    yield coords, servers, addrs
+    for c in coords:
+        try:
+            c.stop()
+        except Exception:
+            pass
+    for s in servers:
+        s.stop()
+    for t in transports:
+        t.close()
+
+
+def wait_leader(coords, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for c in coords:
+            if c.is_leader():
+                return c
+        time.sleep(0.02)
+    raise AssertionError("no coordinator leader")
+
+
+def test_remote_heartbeat_rotates_to_leader_and_acks(coor_group):
+    coords, _servers, addrs = coor_group
+    leader = wait_leader(coords)
+    follower = next(c for c in coords if c is not leader)
+    follower_first = [addrs[follower.node.id]] + [
+        a for cid, a in addrs.items() if cid != follower.node.id
+    ]
+    # store's endpoint list deliberately starts at a FOLLOWER
+    store = StoreNode("s1", LocalTransport(), coordinator=None)
+    hb = RemoteHeartbeat(store, ",".join(follower_first))
+    hb.beat()   # must rotate to the leader instead of silently no-oping
+    assert "s1" in leader.sm.control.stores
+
+    # queue a region create; next beat executes + acks; the beat after
+    # that must show a pruned queue on the coordinator
+    definition = leader.control.create_region(b"a", b"z", replication=1)
+    executed = 0
+    deadline = time.monotonic() + 5
+    while executed == 0 and time.monotonic() < deadline:
+        executed = hb.beat()
+        time.sleep(0.05)
+    assert executed == 1
+    assert store.get_region(definition.region_id) is not None
+    hb.beat()   # carries the ack
+    assert leader.sm.control.store_ops.get("s1") == []
+
+
+def test_command_delivery_survives_coordinator_leader_kill(coor_group):
+    coords, servers, addrs = coor_group
+    leader = wait_leader(coords)
+    store = StoreNode("s1", LocalTransport(), coordinator=None)
+    hb = RemoteHeartbeat(store, ",".join(addrs.values()))
+    hb.beat()
+    definition = leader.control.create_region(b"a", b"z", replication=1)
+    # deliver once ('sent') but DON'T let the store ack or execute: simulate
+    # by asking the coordinator directly, bypassing hb
+    leader.control.store_heartbeat("s1")
+    # kill the leader PROCESS (raft node + its grpc server)
+    servers[coords.index(leader)].stop()
+    leader.stop()
+    survivors = [c for c in coords if c is not leader]
+    new_leader = wait_leader(survivors)
+    # new leader re-arms 'sent' cmds; the store's next beats (rotating to
+    # the new leader) must execute the create exactly once
+    executed, deadline = 0, time.monotonic() + 8
+    while executed == 0 and time.monotonic() < deadline:
+        try:
+            executed += hb.beat()
+        except Exception:
+            pass
+        time.sleep(0.05)
+    assert executed == 1
+    assert store.get_region(definition.region_id) is not None
+    # and once more: no duplicate execution on further beats
+    assert hb.beat() == 0
+    assert new_leader.sm.control.store_ops.get("s1") == []

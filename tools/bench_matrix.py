@@ -1,0 +1,269 @@
+"""BASELINE.md matrix rows (beyond row 2, which bench.py owns).
+
+    python tools/bench_matrix.py --row 1   # FLAT 100K x 128 exact parity
+    python tools/bench_matrix.py --row 3   # IVF_PQ 10M x 768 nlist=4096 m=96
+    python tools/bench_matrix.py --row 4   # HNSW + TPU re-rank
+
+Each run prints ONE JSON line on stdout and appends it to
+BASELINE_RESULTS.jsonl at the repo root (the artifact VERDICT r3 Next #2
+asks for). Scale knobs are env-tunable because the host has ONE cpu core:
+row 4's HNSW graph build is CPU-bound, so its default n is reduced and the
+metric string records the actual scale — reduced-scale numbers are labeled,
+never passed off as spec scale.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def ensure_backend() -> str:
+    from bench import ensure_backend as _eb
+
+    return _eb()
+
+
+def gen_clustered(rng, n, d, chunk=1_000_000):
+    """Mixture-of-gaussians corpus, generated in chunks (10M x 768 f32 is
+    ~30 GB; one-shot generation would peak ~3x that)."""
+    ncl = max(64, n // 1000)
+    centers = rng.standard_normal((ncl, d), dtype=np.float32)
+    x = np.empty((n, d), np.float32)
+    for i in range(0, n, chunk):
+        j = min(n, i + chunk)
+        x[i:j] = centers[rng.integers(0, ncl, j - i)]
+        x[i:j] += 0.35 * rng.standard_normal((j - i, d)).astype(np.float32)
+    return x
+
+
+def ground_truth(x, ids, qs, k, chunk=200_000):
+    best = None
+    for i in range(0, len(x), chunk):
+        dmat = (
+            (qs ** 2).sum(1)[:, None]
+            - 2.0 * qs @ x[i:i + chunk].T
+            + (x[i:i + chunk] ** 2).sum(1)[None, :]
+        )
+        idxs = np.argsort(dmat, axis=1)[:, :k]
+        cand = np.take_along_axis(dmat, idxs, 1)
+        cids = ids[i:i + chunk][idxs]
+        if best is not None:
+            cand = np.concatenate([best[0], cand], axis=1)
+            cids = np.concatenate([best[1], cids], axis=1)
+        order = np.argsort(cand, axis=1)[:, :k]
+        best = (
+            np.take_along_axis(cand, order, 1),
+            np.take_along_axis(cids, order, 1),
+        )
+    return best[1]
+
+
+def measure(idx, queries, k, batch, iters=50, lat_iters=40, **kw):
+    idx.search(queries, k, **kw)  # warm compile
+    t0 = time.perf_counter()
+    thunks = [idx.search_async(queries, k, **kw) for _ in range(iters)]
+    for t in thunks:
+        t()
+    dt = (time.perf_counter() - t0) / iters
+    lats = []
+    for _ in range(lat_iters):
+        t0 = time.perf_counter()
+        idx.search(queries, k, **kw)
+        lats.append((time.perf_counter() - t0) * 1e3)
+    lats.sort()
+    return {
+        "value": round(batch / dt, 1),
+        "unit": "qps",
+        "pipelined_ms_per_batch": round(dt * 1e3, 3),
+        "p50_ms": round(lats[len(lats) // 2], 3),
+        "p99_ms": round(lats[min(len(lats) - 1, int(len(lats) * 0.99))], 3),
+    }
+
+
+def row1_flat(platform):
+    """FLAT brute-force L2, 100K x 128: gate is EXACT parity (recall 1.0)."""
+    from dingo_tpu.index import IndexParameter, IndexType, new_index
+
+    n = int(os.environ.get("DINGO_ROW1_N", 100_000))
+    d, batch, k = 128, 64, 10
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((n, d), dtype=np.float32)
+    ids = np.arange(n, dtype=np.int64)
+    queries = x[rng.choice(n, batch, replace=False)] + 0.02 * rng.standard_normal(
+        (batch, d)
+    ).astype(np.float32)
+    idx = new_index(1, IndexParameter(index_type=IndexType.FLAT, dimension=d))
+    idx.store.reserve(n)
+    idx.upsert(ids, x)
+
+    gt = ground_truth(x, ids, queries, k)
+    res = idx.search(queries, k)
+    recall = float(np.mean(
+        [len(set(r.ids) & set(g)) / k for r, g in zip(res, gt)]
+    ))
+    stats = measure(idx, queries, k, batch)
+
+    # CPU baseline: one BLAS matmul + argpartition over the full corpus —
+    # what faiss IndexFlat does (faiss-openblas is not in this image).
+    xn = (x ** 2).sum(1)
+
+    def cpu_flat(qb):
+        dmat = (qb ** 2).sum(1)[:, None] - 2.0 * qb @ x.T + xn[None, :]
+        top = np.argpartition(dmat, k, axis=1)[:, :k]
+        dd = np.take_along_axis(dmat, top, 1)
+        return np.take_along_axis(top, np.argsort(dd, axis=1), 1)
+
+    cpu_flat(queries[:8])
+    t0 = time.perf_counter()
+    for _ in range(3):
+        cpu_flat(queries)
+    cpu_qps = batch / ((time.perf_counter() - t0) / 3)
+    return {
+        "row": 1,
+        "platform": platform,
+        "baseline": "numpy-flat",
+        "metric": f"flat_qps_{n//1000}k_x{d}_"
+                  + ("exact" if recall == 1.0 else f"recall={recall:.4f}"),
+        "recall_at_10": round(recall, 4),
+        "cpu_baseline_qps": round(cpu_qps, 1),
+        "vs_baseline": round(stats["value"] / cpu_qps, 2),
+        **stats,
+    }
+
+
+def row3_ivfpq(platform):
+    """IVF_PQ nlist=4096 m=96, host-resident vectors (10M x 768 at spec)."""
+    from dingo_tpu.index import IndexParameter, IndexType, new_index
+
+    big = platform == "tpu"
+    n = int(os.environ.get("DINGO_ROW3_N", 10_000_000 if big else 500_000))
+    d = 768
+    nlist = int(os.environ.get("DINGO_ROW3_NLIST", 4096 if big else 512))
+    m, batch, k = 96, 64, 10
+    rng = np.random.default_rng(3)
+    log(f"row3: generating {n}x{d} ...")
+    x = gen_clustered(rng, n, d)
+    ids = np.arange(n, dtype=np.int64)
+    queries = x[rng.choice(n, batch, replace=False)] + 0.05 * rng.standard_normal(
+        (batch, d)
+    ).astype(np.float32)
+    param = IndexParameter(
+        index_type=IndexType.IVF_PQ, dimension=d, ncentroids=nlist,
+        nsubvector=m, default_nprobe=64, host_vectors=True,
+    )
+    idx = new_index(1, param)
+    idx.store.reserve(n)
+    t0 = time.perf_counter()
+    for i in range(0, n, 50_000):
+        idx.upsert(ids[i:i + 50_000], x[i:i + 50_000])
+    log(f"row3 ingest: {time.perf_counter()-t0:.1f}s")
+    t0 = time.perf_counter()
+    idx.train()
+    log(f"row3 train: {time.perf_counter()-t0:.1f}s")
+
+    sample = 16
+    gt = ground_truth(x, ids, queries[:sample], k)
+
+    def recall_at(nprobe):
+        res = idx.search(queries[:sample], k, nprobe=nprobe)
+        return float(np.mean(
+            [len(set(r.ids) & set(g)) / k for r, g in zip(res, gt)]
+        ))
+
+    chosen, recall = nlist, 0.0
+    for cand in (32, 48, 64, 96, 128, 192, 256):
+        if cand > nlist:
+            break
+        recall = recall_at(cand)
+        log(f"row3 nprobe={cand}: recall@10={recall:.4f}")
+        chosen = cand
+        if recall >= 0.95:
+            break
+    stats = measure(idx, queries, k, batch, nprobe=chosen)
+    return {
+        "row": 3,
+        "platform": platform,
+        "metric": f"ivf_pq_qps_{n//1000}k_x{d}_nlist{nlist}_m{m}_"
+                  f"nprobe{chosen}_recall={recall:.3f}",
+        "recall_at_10": round(recall, 4),
+        **stats,
+    }
+
+
+def row4_hnsw(platform):
+    """HNSW M=32 efc=200 + TPU exact re-rank. Graph build is single-thread
+    CPU (one core on this host) so default n is reduced; the metric string
+    carries the real n."""
+    from dingo_tpu.index import IndexParameter, IndexType, new_index
+
+    n = int(os.environ.get("DINGO_ROW4_N", 200_000))
+    d, batch, k, ef = 768, 64, 10, 200
+    rng = np.random.default_rng(4)
+    x = gen_clustered(rng, n, d)
+    ids = np.arange(n, dtype=np.int64)
+    queries = x[rng.choice(n, batch, replace=False)] + 0.05 * rng.standard_normal(
+        (batch, d)
+    ).astype(np.float32)
+    idx = new_index(1, IndexParameter(
+        index_type=IndexType.HNSW, dimension=d, nlinks=32,
+        efconstruction=200, max_elements=n,
+    ))
+    t0 = time.perf_counter()
+    for i in range(0, n, 20_000):
+        idx.upsert(ids[i:i + 20_000], x[i:i + 20_000])
+        if i % 100_000 == 0:
+            log(f"row4 built {i + 20_000}/{n} ({time.perf_counter()-t0:.0f}s)")
+    build_s = time.perf_counter() - t0
+    log(f"row4 build: {build_s:.1f}s")
+
+    sample = 16
+    gt = ground_truth(x, ids, queries[:sample], k)
+    res = idx.search(queries[:sample], k, ef=ef)
+    recall = float(np.mean(
+        [len(set(r.ids) & set(g)) / k for r, g in zip(res, gt)]
+    ))
+    log(f"row4 ef={ef}: recall@10={recall:.4f}")
+    stats = measure(idx, queries, k, batch, iters=20, lat_iters=20, ef=ef)
+    return {
+        "row": 4,
+        "platform": platform,
+        "metric": f"hnsw_qps_{n//1000}k_x{d}_M32_ef{ef}_recall={recall:.3f}",
+        "recall_at_10": round(recall, 4),
+        "build_s": round(build_s, 1),
+        **stats,
+    }
+
+
+ROWS = {1: row1_flat, 3: row3_ivfpq, 4: row4_hnsw}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--row", type=int, required=True, choices=sorted(ROWS))
+    args = ap.parse_args()
+    platform = ensure_backend()
+    from dingo_tpu.common.config import enable_compile_cache
+
+    enable_compile_cache(log)
+    result = ROWS[args.row](platform)
+    result["measured_at"] = time.time()
+    with open(os.path.join(REPO, "BASELINE_RESULTS.jsonl"), "a") as f:
+        f.write(json.dumps(result) + "\n")
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

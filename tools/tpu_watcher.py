@@ -1,0 +1,201 @@
+"""Round-long TPU lease watcher (round-4 VERDICT Next #1).
+
+The axon TPU lease is intermittently available: round 3 saw the chip answer
+mid-round while every end-of-round bench probe timed out. This watcher runs
+for the whole round as a detached background process, probing cheaply every
+few minutes; the moment the chip answers it runs the on-chip work queue
+(smoke suite, BASELINE row-2 bench, then the rest of the BASELINE matrix)
+and PERSISTS every result so the end-of-round driver run of bench.py can
+serve a real TPU number even if the lease is wedged at that moment.
+
+    python tools/tpu_watcher.py &        # normally launched via nohup
+
+State:    TPU_WATCHER_STATE.json   (repo root; progress + results)
+Log:      tools/tpu_watcher.log
+Results:  SMOKE_r04.json, TPU_BENCH_CACHE.json (written by bench.py),
+          BASELINE_RESULTS.jsonl (appended by tools/bench_matrix.py)
+
+Lease etiquette: never SIGKILL a process holding the chip (the lease wedges
+for minutes). Steps get generous timeouts, then SIGTERM + a long grace
+period; SIGKILL only as a last resort.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STATE_PATH = os.path.join(REPO, "TPU_WATCHER_STATE.json")
+LOG_PATH = os.path.join(REPO, "tools", "tpu_watcher.log")
+PID_PATH = os.path.join(REPO, "tools", "tpu_watcher.pid")
+
+PROBE_TIMEOUT_S = 120
+PROBE_INTERVAL_S = 240
+ROUND_DEADLINE_S = 11.0 * 3600  # stop probing near end of round
+
+# (name, argv, timeout_s). Ordered by value: the row-2 bench IS the round
+# deliverable; smoke first because it validates the Pallas kernels the bench
+# may route through. Matrix rows fill BASELINE.md opportunistically.
+QUEUE = [
+    ("smoke", [sys.executable, "tpu_smoke.py"], 2400),
+    ("bench_row2", [sys.executable, "bench.py"], 7200),
+    ("row1_flat", [sys.executable, "tools/bench_matrix.py", "--row", "1"], 2400),
+    ("row4_hnsw", [sys.executable, "tools/bench_matrix.py", "--row", "4"], 5400),
+    ("row3_ivfpq", [sys.executable, "tools/bench_matrix.py", "--row", "3"], 9000),
+]
+
+
+def log(msg: str) -> None:
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    with open(LOG_PATH, "a") as f:
+        f.write(line + "\n")
+
+
+def load_state() -> dict:
+    fresh = {"done": {}, "probes": 0, "started": time.time()}
+    try:
+        with open(STATE_PATH) as f:
+            st = json.load(f)
+    except (OSError, ValueError):
+        return fresh
+    # a state file left by a PREVIOUS round must not satisfy this one: its
+    # 'done' results came from old code and its 'started' would make the
+    # deadline check exit immediately
+    if time.time() - st.get("started", 0) > ROUND_DEADLINE_S:
+        log("discarding stale watcher state from a previous round")
+        return fresh
+    return st
+
+
+def save_state(st: dict) -> None:
+    tmp = STATE_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(st, f, indent=1)
+    os.replace(tmp, STATE_PATH)
+
+
+def probe_tpu() -> bool:
+    code = (
+        "import jax; d = jax.devices(); import jax.numpy as jnp; "
+        "(jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready(); "
+        "print('PLATFORM=' + d[0].platform)"
+    )
+    # same lease etiquette as run_step: the probe child itself holds the
+    # lease mid-acquisition, so a SIGKILL (what subprocess.run's timeout
+    # sends) would wedge the very lease we are waiting for
+    p = subprocess.Popen(
+        [sys.executable, "-c", code], cwd=REPO, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+    )
+    try:
+        out, _ = p.communicate(timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        p.send_signal(signal.SIGTERM)
+        try:
+            p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            log("probe ignored SIGTERM for 120s; leaving it to exit on "
+                "its own (no SIGKILL — lease etiquette)")
+            threading.Thread(target=p.communicate, daemon=True).start()
+        return False
+    return p.returncode == 0 and (
+        "PLATFORM=tpu" in (out or "") or "PLATFORM=axon" in (out or "")
+    )
+
+
+def run_step(name: str, argv: list[str], timeout_s: int) -> tuple[int, str]:
+    """Run one on-chip step with graceful termination (no surprise SIGKILL
+    of a lease holder)."""
+    env = dict(os.environ)
+    env.setdefault("DINGO_BENCH_PROBE_S", "90")
+    env.setdefault("DINGO_SMOKE_PROBE_S", "90")
+    p = subprocess.Popen(
+        argv, cwd=REPO, env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        out, _ = p.communicate(timeout=timeout_s)
+        return p.returncode, out or ""
+    except subprocess.TimeoutExpired:
+        log(f"step {name}: timeout after {timeout_s}s, SIGTERM")
+        p.send_signal(signal.SIGTERM)
+        try:
+            out, _ = p.communicate(timeout=180)
+            return -signal.SIGTERM, out or ""
+        except subprocess.TimeoutExpired:
+            log(f"step {name}: still alive 180s after SIGTERM, SIGKILL "
+                "(lease may wedge for a few minutes)")
+            p.kill()
+            out, _ = p.communicate()
+            return -signal.SIGKILL, out or ""
+
+
+def step_done(name: str, rc: int, out: str) -> bool:
+    """Did this step produce a real TPU result (vs a CPU fallback)?"""
+    if name == "smoke":
+        if rc in (0, 1):  # 1 = ran on chip but a check failed: evidence too
+            with open(os.path.join(REPO, "SMOKE_r04.json"), "w") as f:
+                json.dump({"rc": rc, "ts": time.time(),
+                           "output": out[-4000:]}, f, indent=1)
+            return True
+        return False  # rc==2 no TPU → requeue
+    # bench steps: last stdout line should be the JSON with platform=tpu;
+    # a served cache ("cached": true) is NOT a fresh measurement — requeue
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{") and '"platform"' in line:
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                return False
+            return parsed.get("platform") == "tpu" and not parsed.get("cached")
+    return False
+
+
+def main() -> None:
+    with open(PID_PATH, "w") as f:
+        f.write(str(os.getpid()))
+    st = load_state()
+    start = st.get("started", time.time())
+    log(f"watcher up pid={os.getpid()} done={list(st['done'])}")
+    while time.time() - start < ROUND_DEADLINE_S:
+        pending = [q for q in QUEUE if q[0] not in st["done"]]
+        if not pending:
+            log("queue complete; watcher exiting")
+            break
+        st["probes"] += 1
+        if not probe_tpu():
+            st["last_probe"] = "miss"
+            save_state(st)
+            time.sleep(PROBE_INTERVAL_S)
+            continue
+        log(f"TPU ANSWERED (probe #{st['probes']}); running "
+            f"{[q[0] for q in pending]}")
+        st["last_probe"] = "hit"
+        save_state(st)
+        for name, argv, timeout_s in pending:
+            t0 = time.time()
+            rc, out = run_step(name, argv, timeout_s)
+            dt = time.time() - t0
+            ok = step_done(name, rc, out)
+            log(f"step {name}: rc={rc} {dt:.0f}s done={ok}; "
+                f"tail={out[-400:]!r}")
+            if ok:
+                st["done"][name] = {"rc": rc, "secs": round(dt), "ts": time.time()}
+                save_state(st)
+            else:
+                # lease lost mid-queue — go back to probing
+                log(f"step {name}: no TPU result; re-probing")
+                break
+        time.sleep(30)
+    log("watcher done")
+
+
+if __name__ == "__main__":
+    main()
