@@ -84,6 +84,12 @@ class IndexParameter:
     # keep full vectors in HOST memory (IVF_PQ/DiskANN-class indexes whose
     # search path reads only codes; lifts the HBM cap at 10M x 768 scale)
     host_vectors: bool = False
+    # scalar fields flagged for pre-filter acceleration: apply writes a
+    # NARROW scalar subset to the vector_scalar_key_speed_up CF so scalar
+    # pre-filter scans read it instead of the full scalar CF (reference
+    # ScalarSchema.enable_speed_up + VectorIndexUtils::SplitVectorScalarData,
+    # raft_apply_handler.cc:1115)
+    scalar_speedup_keys: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass
