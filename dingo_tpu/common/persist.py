@@ -12,6 +12,7 @@ Envelope forms inside the plain tree:
   {"__dc": "Name", "f": {field: value}}   registered dataclass
   {"__en": "Name", "v": value}            registered enum
   {"__d": [[k, v], ...]}                  dict with non-str keys
+  {"__t": [items]}                        tuple (lists encode bare)
 
 Legacy pickle blobs are NOT readable by default; set
 DINGO_ALLOW_PICKLE_MIGRATION=1 for a one-time migration load of data you
@@ -67,11 +68,13 @@ def to_plain(v: Any) -> Any:
         return {"__en": _ensure_registered(type(v)), "v": v.value}
     if isinstance(v, dict):
         if all(isinstance(k, str) for k in v) and not (
-            set(v) & {"__dc", "__en", "__d"}
+            set(v) & {"__dc", "__en", "__d", "__t"}
         ):
             return {k: to_plain(x) for k, x in v.items()}
         return {"__d": [[to_plain(k), to_plain(x)] for k, x in v.items()]}
-    if isinstance(v, (list, tuple)):
+    if isinstance(v, tuple):
+        return {"__t": [to_plain(i) for i in v]}
+    if isinstance(v, list):
         return [to_plain(i) for i in v]
     return v
 
@@ -108,6 +111,8 @@ def from_plain(v: Any) -> Any:
                 ) from e
         if "__d" in v:
             return {from_plain(k): from_plain(x) for k, x in v["__d"]}
+        if "__t" in v:
+            return tuple(from_plain(i) for i in v["__t"])
         return {k: from_plain(x) for k, x in v.items()}
     if isinstance(v, list):
         return [from_plain(i) for i in v]

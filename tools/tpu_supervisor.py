@@ -1,0 +1,73 @@
+"""Supervisor for tools/tpu_watcher.py (round-4 VERDICT Next #1).
+
+Round 4's watcher died silently and stayed down for most of the round.
+This supervisor keeps it alive for the whole round: it respawns the
+watcher whenever it exits, logs every spawn/exit with the exit status,
+and backs off briefly between respawns so a crash loop can't spin.
+
+    setsid nohup python tools/tpu_supervisor.py >/dev/null 2>&1 &
+
+It exits on its own at the round deadline, or when the watcher reports
+its queue complete (state file has every queue step done).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG_PATH = os.path.join(REPO, "tools", "tpu_supervisor.log")
+PID_PATH = os.path.join(REPO, "tools", "tpu_supervisor.pid")
+STATE_PATH = os.path.join(REPO, "TPU_WATCHER_STATE.json")
+DEADLINE_S = 11.0 * 3600
+RESPAWN_BACKOFF_S = 20
+QUEUE_STEPS = {"smoke", "bench_row2", "row1_flat", "row4_hnsw", "row3_ivfpq"}
+
+
+def log(msg: str) -> None:
+    with open(LOG_PATH, "a") as f:
+        f.write(f"[{time.strftime('%H:%M:%S')}] {msg}\n")
+
+
+def queue_complete() -> bool:
+    try:
+        with open(STATE_PATH) as f:
+            st = json.load(f)
+    except (OSError, ValueError):
+        return False
+    return QUEUE_STEPS <= set(st.get("done", {}))
+
+
+def main() -> None:
+    with open(PID_PATH, "w") as f:
+        f.write(str(os.getpid()))
+    start = time.time()
+    log(f"supervisor up pid={os.getpid()}")
+    while time.time() - start < DEADLINE_S:
+        if queue_complete():
+            log("watcher queue complete; supervisor exiting")
+            return
+        p = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tools", "tpu_watcher.py")],
+            cwd=REPO,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+        log(f"spawned watcher pid={p.pid}")
+        while p.poll() is None and time.time() - start < DEADLINE_S:
+            time.sleep(30)
+        if p.poll() is None:
+            log("round deadline; leaving watcher to its own deadline exit")
+            return
+        log(f"watcher pid={p.pid} exited rc={p.returncode}; "
+            f"respawn in {RESPAWN_BACKOFF_S}s")
+        time.sleep(RESPAWN_BACKOFF_S)
+    log("supervisor deadline reached")
+
+
+if __name__ == "__main__":
+    main()
