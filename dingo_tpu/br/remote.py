@@ -157,9 +157,33 @@ class RemoteBr:
 
     # -- restore -------------------------------------------------------------
     def _push_region(self, definition, blob: bytes, peers: List[str]) -> int:
-        """Chunked RegionImport to every hosting peer; returns installs."""
-        installed = 0
-        crc = _crc(blob)   # once — not per chunk per peer
+        """Chunked RegionImport into the region's raft LEADER — the install
+        rides the raft log from there, so followers converge through
+        replication (pushing each peer directly would race concurrent raft
+        traffic and fork replicas). NotLeader rotates to the next peer.
+        Returns 1 on success."""
+        if not peers:
+            raise BrError(
+                f"import region {definition.region_id}: no hosting peers")
+        crc = _crc(blob)   # once — not per chunk
+        self._last_push_err = "all peers answered NotLeader"
+        deadline = time.monotonic() + 15.0
+        while True:
+            n = self._push_region_once(definition, blob, crc, peers)
+            if n is not None:
+                return n
+            # freshly created region may still be electing: retry rotation
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.25)
+        raise BrError(
+            f"import region {definition.region_id}: no leader accepted "
+            f"the install (last: {self._last_push_err})")
+
+    def _push_region_once(self, definition, blob: bytes, crc: int,
+                          peers: List[str]):
+        """One rotation over peers; returns 1 on success, None if every
+        peer answered NotLeader (election in progress — caller retries)."""
         for store_id in peers:
             stub = self.client._stub(store_id, "RegionControlService")
             import_id = secrets.randbits(62)   # isolates concurrent pushes
@@ -174,19 +198,22 @@ class RemoteBr:
                     import_id=import_id,
                 )
                 resp = stub.RegionImport(req)
+                if resp.error.errcode == 20001:   # NotLeader: try next peer
+                    self._last_push_err = f"{store_id}: {resp.error.errmsg}"
+                    break
                 if resp.error.errcode:
                     raise BrError(
                         f"import region {definition.region_id} on "
                         f"{store_id}: {resp.error.errmsg}")
                 offset = offset_next
                 if offset >= len(blob):
-                    break
-            installed += 1
-        return installed
+                    return 1
+        return None
 
     def restore(self, wait_s: float = 10.0) -> int:
         """Re-create every backed-up region through the coordinator and
-        push its data to all hosting peers. Returns regions restored."""
+        push its data to each region's raft leader (the install replicates
+        to followers through the log). Returns regions restored."""
         from dingo_tpu.server import convert
 
         with open(os.path.join(self.path, "backupmeta.json")) as f:
@@ -251,8 +278,11 @@ class RemoteBr:
         for schema in manifest.get("schemas", []):
             resp = self.client.meta.CreateSchema(
                 pb.CreateSchemaRequest(schema_name=schema))
-            if resp.error.errcode:   # built-in / already present
+            if resp.error.errcode == 40002:   # built-in / already present
                 continue
+            if resp.error.errcode:
+                raise BrError(
+                    f"restore schema {schema!r}: {resp.error.errmsg}")
         for t in manifest.get("tables", []):
             d = pb.TableDef()
             d.ParseFromString(bytes.fromhex(t["definition_pb"]))
@@ -260,10 +290,13 @@ class RemoteBr:
                 p.region_id = region_id_map.get(p.region_id, p.region_id)
             resp = self.client.meta.ImportTable(
                 pb.ImportTableRequest(definition=d))
-            if resp.error.errcode:
-                # name collision in the target cluster: skip, like the
-                # local restore path
+            if resp.error.errcode == 40002:
+                # genuine name collision in the target cluster: skip, like
+                # the local restore path — any OTHER error is a failed
+                # restore and must not be silently dropped
                 continue
+            if resp.error.errcode:
+                raise BrError(f"restore table {d.name!r}: {resp.error.errmsg}")
         watermark = manifest.get("tso_watermark")
         if watermark:
             resp = self.client.coordinator.TsoAdvance(

@@ -45,6 +45,12 @@ class MetaError(RuntimeError):
     pass
 
 
+class MetaExistsError(MetaError):
+    """Name collision (schema/table already registered). Distinct class so
+    idempotent callers (BR restore re-runs) can skip collisions without
+    string-matching — every other MetaError stays fatal for them."""
+
+
 @persist.register
 @dataclasses.dataclass
 class ColumnDefinition:
@@ -142,7 +148,7 @@ class MetaControl:
             raise MetaError("empty schema name")
         with self._lock:
             if name in self.schemas:
-                raise MetaError(f"schema {name!r} exists")
+                raise MetaExistsError(f"schema {name!r} exists")
             self._put_schema(name)
             self._emit("create_schema", name)
 
@@ -186,7 +192,7 @@ class MetaControl:
             if schema_name not in self.schemas:
                 raise MetaError(f"schema {schema_name!r} not found")
             if key in self.tables or key in self._creating:
-                raise MetaError(f"table {key} exists")
+                raise MetaExistsError(f"table {key} exists")
             if not partitions:
                 raise MetaError("table needs >= 1 partition")
             # reserve the name: region creation below runs outside the lock
@@ -249,7 +255,7 @@ class MetaControl:
             if t.schema_name not in self.schemas:
                 self._put_schema(t.schema_name)
             if key in self.tables or key in self._creating:
-                raise MetaError(f"table {key} exists")
+                raise MetaExistsError(f"table {key} exists")
             t.table_id = self._next_table_id
             self._next_table_id += 1
             self.engine.put(CF_META, _KEY_TABLE_ID,

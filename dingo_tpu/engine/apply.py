@@ -62,6 +62,13 @@ def apply_write(
             raise NotImplementedError("region merge needs a StoreNode context")
         context.handle_merge(region, data, log_id)
         return None
+    if isinstance(data, wd.RegionInstallData):
+        _apply_region_install(engine, region, data)
+        # rebuild derived in-memory indexes on THIS replica (each replica's
+        # apply runs with its own node context)
+        if context is not None and hasattr(context, "after_region_install"):
+            context.after_region_install(region)
+        return None
     if isinstance(data, wd.KvPutData):
         _apply_kv_put(engine, data)
     elif isinstance(data, wd.KvDeleteData):
@@ -81,6 +88,17 @@ def apply_write(
     else:
         raise TypeError(f"unknown write payload {type(data)}")
     return None
+
+
+def _apply_region_install(
+    engine: RawEngine, region: Region, data: wd.RegionInstallData
+) -> None:
+    """Wipe + restore the region's range — delegates to the one
+    region_install implementation (function-level import: raft_engine
+    imports this module at top level)."""
+    from dingo_tpu.engine.raft_engine import region_install
+
+    region_install(engine, region, dict(data.cfs))
 
 
 def _apply_kv_put(engine: RawEngine, data: wd.KvPutData) -> None:

@@ -116,6 +116,18 @@ class MergeRegionData:
 
 
 @dataclasses.dataclass
+class RegionInstallData:
+    """Whole-region wipe + restore (RegionImport) routed through the raft
+    log: every replica applies the install at the same log position, so
+    concurrent raft writes order strictly before or after it and replicas
+    can never diverge (the off-log `region_install` push this replaces
+    left any replica that applied a concurrent write mid-push permanently
+    forked)."""
+
+    cfs: List[Tuple[str, List[Tuple[bytes, bytes]]]]
+
+
+@dataclasses.dataclass
 class TxnRaftData:
     """TxnHandler payload (raft_apply_handler_txn.cc): pre-encoded CF writes
     produced by the Percolator helper (engine/txn.py)."""
@@ -131,7 +143,8 @@ _PAYLOAD_TYPES = {
     for cls in (
         KvPutData, KvDeleteData, KvDeleteRangeData, VectorAddData,
         VectorDeleteData, RebuildVectorIndexData, SplitRegionData,
-        DocumentAddData, DocumentDeleteData, MergeRegionData, TxnRaftData,
+        DocumentAddData, DocumentDeleteData, MergeRegionData,
+        RegionInstallData, TxnRaftData,
     )
 }
 

@@ -1301,11 +1301,13 @@ class MetaService:
             )
 
     def CreateSchema(self, req: pb.CreateSchemaRequest):
-        from dingo_tpu.coordinator.meta import MetaError
+        from dingo_tpu.coordinator.meta import MetaError, MetaExistsError
 
         resp = pb.CreateSchemaResponse()
         try:
             self.meta.create_schema(req.schema_name)
+        except MetaExistsError as e:
+            return _err(resp, 40002, str(e))
         except MetaError as e:
             return _err(resp, 40001, str(e))
         return resp
@@ -1370,6 +1372,7 @@ class MetaService:
         from dingo_tpu.coordinator.meta import (
             ColumnDefinition,
             MetaError,
+            MetaExistsError,
             PartitionDefinition,
             TableDefinition,
         )
@@ -1403,6 +1406,8 @@ class MetaService:
         )
         try:
             registered = self.meta.import_table(t)
+        except MetaExistsError as e:
+            return _err(resp, 40002, str(e))
         except (MetaError, RuntimeError) as e:
             return _err(resp, 40001, str(e))
         self._table_to_pb(registered, resp.definition)
@@ -1525,6 +1530,9 @@ class RegionControlService:
 
     _EXPORT_CHUNK = 1 << 20
     _TRANSFER_TTL_S = 300.0   # abandoned transfer sessions die after this
+    #: once the final chunk was served, the (multi-MB) export blob is only
+    #: kept long enough for a lost-response re-pull — not the full TTL
+    _EOF_GRACE_S = 20.0
 
     def __init__(self, node: StoreNode):
         self.node = node
@@ -1541,9 +1549,13 @@ class RegionControlService:
     def _gc_transfers_locked(self) -> None:
         now = time.monotonic()
         for d in (self._exports, self._imports):
-            dead = [k for k, v in d.items()
-                    if now - v[1] > self._TRANSFER_TTL_S]
-            for k in dead:   # crashed client: drop its multi-MB buffer
+            dead = []
+            for k, v in d.items():
+                eof_served = len(v) > 2 and v[2]
+                ttl = self._EOF_GRACE_S if eof_served else self._TRANSFER_TTL_S
+                if now - v[1] > ttl:
+                    dead.append(k)
+            for k in dead:   # crashed/finished client: drop the buffer
                 del d[k]
 
     def RegionExport(self, req: pb.RegionExportRequest):
@@ -1561,20 +1573,23 @@ class RegionControlService:
         if raft is not None and not raft.is_leader():
             hint = getattr(raft, "leader_id", None) or ""
             return _err(resp, 20001, f"not leader: {hint}")
+        if req.export_id == 0 and req.offset != 0:
+            return _err(resp, 70004, "offset > 0 requires an export_id")
+        blob = None
+        if req.export_id == 0:
+            # build the (multi-MB) snapshot OUTSIDE the transfer lock: a
+            # slow export must not block unrelated concurrent transfers
+            try:
+                blob = wire.encode(region_snapshot(self.node.raw, region))
+            except OSError as e:
+                return _err(resp, 70003, f"export snapshot failed: {e}")
         with self._transfer_lock:
             self._gc_transfers_locked()
             if req.export_id == 0:
-                if req.offset != 0:
-                    return _err(resp, 70004,
-                                "offset > 0 requires an export_id")
-                try:
-                    blob = wire.encode(
-                        region_snapshot(self.node.raw, region))
-                except OSError as e:
-                    return _err(resp, 70003, f"export snapshot failed: {e}")
                 export_id = self._next_export_id
                 self._next_export_id += 1
-                self._exports[export_id] = [blob, time.monotonic()]
+                # [blob, last_access, eof_served]
+                self._exports[export_id] = [blob, time.monotonic(), False]
             else:
                 export_id = int(req.export_id)
                 ses = self._exports.get(export_id)
@@ -1592,8 +1607,11 @@ class RegionControlService:
             resp.export_id = export_id
             resp.eof = req.offset + len(resp.data) >= len(blob)
             if resp.eof:
+                # keep the session briefly (eof-grace TTL): if this
+                # response is lost in transit the client can re-pull the
+                # final chunk, without pinning the blob for the full TTL
                 resp.checksum = wire.blob_checksum(blob)
-                self._exports.pop(export_id, None)
+                self._exports[export_id][2] = True
         return resp
 
     def RegionImport(self, req: pb.RegionImportRequest):
@@ -1603,6 +1621,13 @@ class RegionControlService:
         region = self.node.get_region(req.region_id)
         if region is None:
             return _err(resp, 10001, f"region {req.region_id} not found")
+        # raft-hosted region: reject on the FIRST chunk if this store
+        # isn't the leader — the client would otherwise upload the whole
+        # multi-MB blob to a peer that can only refuse it at commit time
+        raft = self.node.engine.get_node(req.region_id)
+        if raft is not None and not raft.is_leader():
+            hint = getattr(raft, "leader_id", None) or ""
+            return _err(resp, 20001, f"not leader: {hint}")
         key = (int(req.region_id), int(req.import_id))
         with self._transfer_lock:
             self._gc_transfers_locked()
@@ -1625,13 +1650,32 @@ class RegionControlService:
             return _err(resp, 70006,
                         "import blob size/checksum mismatch (torn upload)")
         try:
-            region_install(self.node.raw, region, wire.decode(blob))
+            state = wire.decode(blob)
+        except (ValueError, wire.WireError) as e:
+            return _err(resp, 70007, f"install failed: {e}")
+        if raft is not None:
+            # raft-replicated region: the install MUST ride the log — a
+            # direct engine write on one replica would fork it from peers
+            # applying concurrent raft traffic (the apply handler also
+            # rebuilds derived indexes on every replica)
+            from dingo_tpu.engine import write_data as wd
+
+            install = wd.RegionInstallData(
+                cfs=[(cf, list(pairs)) for cf, pairs in state.items()])
+            try:
+                self.node.engine.write(region, install, timeout=60.0)
+            except NotLeader as e:
+                # election raced the upload: 20001 so the client rotates
+                # to the new leader instead of aborting the restore
+                return _err(resp, 20001, f"not leader: {e}")
+            except (TimeoutError, RuntimeError) as e:
+                return _err(resp, 70007, f"install propose failed: {e}")
+            return resp
+        try:
+            region_install(self.node.raw, region, state)
         except (ValueError, OSError) as e:
             return _err(resp, 70007, f"install failed: {e}")
-        if region.vector_index_wrapper is not None:
-            self.node.index_manager.rebuild(region)
-        if region.document_index is not None:
-            self.node.rebuild_document_index(region)
+        self.node.after_region_install(region)
         return resp
 
     def RegionSnapshot(self, req: pb.RegionSnapshotRequest):

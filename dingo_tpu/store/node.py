@@ -234,6 +234,16 @@ class StoreNode:
         self.index_manager.rebuild(target)
         target.vector_index_wrapper.set_sibling(None)
 
+    def after_region_install(self, region: Region) -> None:
+        """Post-install (RegionImport) rebuild of derived in-memory indexes
+        on this replica. Called from the RegionInstallData apply handler so
+        EVERY replica — not just the one that served the import RPC —
+        rebuilds from its freshly installed engine state."""
+        if region.vector_index_wrapper is not None:
+            self.index_manager.rebuild(region)
+        if region.document_index is not None:
+            self.rebuild_document_index(region)
+
     def rebuild_document_index(self, region: Region) -> int:
         """Repopulate a DOCUMENT region's full-text index from the engine
         (dual-write recovery contract, same as the vector index)."""

@@ -108,12 +108,14 @@ bool write_all(FILE* f, const void* p, size_t n) {
 // Make a rename/unlink durable: fsync the containing directory. Without
 // this, power loss can persist a later WAL truncation while losing the
 // SST rename it depends on (the acknowledged writes would vanish).
-void fsync_dir(const std::string& dir) {
+// Returns false on open/fsync failure — callers that are about to
+// truncate the WAL MUST treat that as a failed flush, not a no-op.
+bool fsync_dir(const std::string& dir) {
   int fd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
-  if (fd >= 0) {
-    fsync(fd);
-    close(fd);
-  }
+  if (fd < 0) return false;
+  bool ok = fsync(fd) == 0;
+  close(fd);
+  return ok;
 }
 
 uint32_t fnv1a(const char* p, size_t n) {
@@ -435,8 +437,10 @@ struct SstWriter {
     }
     // sync_writes promises power-loss durability: the rename (and the idx
     // unlink) must hit disk before flush_locked truncates the WAL, or the
-    // fsync'd commits could vanish with the lost rename
-    if (db->sync_writes) fsync_dir(db->dir);
+    // fsync'd commits could vanish with the lost rename. A failed dir
+    // fsync therefore fails the whole flush — the WAL stays, replay
+    // re-covers the data (the orphan SST is newest-wins-safe on reopen).
+    if (db->sync_writes && !fsync_dir(db->dir)) return nullptr;
     auto sst = std::make_unique<Sst>();
     sst->id = id;
     sst->f = fopen(final_path.c_str(), "rb");
@@ -526,7 +530,9 @@ int merge_run_locked(Db* db, size_t lo, size_t hi) {
     unlink(db->sst_path(id).c_str());
     unlink(db->idx_path(id).c_str());
   }
-  if (db->sync_writes) fsync_dir(db->dir);
+  // in-memory state already matches the directory contents; a failed dir
+  // fsync only leaves durability unknown, so surface it to the caller
+  if (db->sync_writes && !fsync_dir(db->dir)) return -1;
   return 0;
 }
 

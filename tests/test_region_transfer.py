@@ -1,0 +1,120 @@
+"""Region export/import transfer sessions + BR meta-restore error handling
+(round-3 advisor findings: eof used to destroy the export session, and
+_restore_meta swallowed every meta error as a name collision)."""
+
+import json
+import time
+import types
+
+import pytest
+
+from dingo_tpu.server import pb
+
+
+def test_region_export_final_chunk_refetchable(tmp_path, capsys):
+    """A lost final-chunk response must not kill the whole pull: the export
+    session survives eof and the client can re-request the last chunk."""
+    from dingo_tpu.client.cli import main
+    from dingo_tpu.server.services import RegionControlService
+    from tests.test_document_br_cli import _mk_grpc_cluster
+
+    base, nodes, servers = _mk_grpc_cluster(
+        seed=11, snapdir=str(tmp_path / "snap"))
+    try:
+        assert main(base + ["region", "create-index", "--dim", "8"]) == 0
+        rid = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])["region_id"]
+        time.sleep(0.8)
+        assert main(base + ["vector", "add-random", "--dim", "8",
+                            "--count", "40"]) == 0
+        capsys.readouterr()
+
+        # drive the service on whichever store leads the region
+        deadline = time.monotonic() + 5.0
+        leader_node = None
+        while time.monotonic() < deadline and leader_node is None:
+            for n in nodes.values():
+                raft = n.engine.get_node(rid)
+                if raft is not None and raft.is_leader():
+                    leader_node = n
+                    break
+            time.sleep(0.05)
+        assert leader_node is not None, "no leader for exported region"
+        svc = RegionControlService(leader_node)
+
+        chunk = 512
+        resp = svc.RegionExport(pb.RegionExportRequest(
+            region_id=rid, offset=0, export_id=0, max_bytes=chunk))
+        assert resp.error.errcode == 0, resp.error.errmsg
+        export_id, total = resp.export_id, resp.total_bytes
+        assert total > chunk, "need a multi-chunk export for this test"
+        offset = len(resp.data)
+        last = resp
+        while not last.eof:
+            last = svc.RegionExport(pb.RegionExportRequest(
+                region_id=rid, offset=offset, export_id=export_id,
+                max_bytes=chunk))
+            assert last.error.errcode == 0, last.error.errmsg
+            offset += len(last.data)
+        assert last.eof and last.checksum
+
+        # the eof response "was lost": re-pull the final chunk
+        again = svc.RegionExport(pb.RegionExportRequest(
+            region_id=rid, offset=offset - len(last.data),
+            export_id=export_id, max_bytes=chunk))
+        assert again.error.errcode == 0, (
+            "export session died on eof; final chunk unrecoverable: "
+            + again.error.errmsg
+        )
+        assert again.eof
+        assert again.data == last.data
+        assert again.checksum == last.checksum
+    finally:
+        for s in servers:
+            s.stop()
+        for n in nodes.values():
+            n.stop()
+
+
+def _resp_with(resp, code, msg=""):
+    resp.error.errcode = code
+    resp.error.errmsg = msg
+    return resp
+
+
+def test_restore_meta_propagates_real_errors():
+    """_restore_meta skips genuine name collisions (errcode 40002) but any
+    other meta error fails the restore loudly."""
+    from dingo_tpu.br.remote import BrError, RemoteBr
+
+    br = RemoteBr.__new__(RemoteBr)
+
+    class _MetaBoom:
+        def CreateSchema(self, req):
+            return _resp_with(pb.CreateSchemaResponse(), 40001, "boom")
+
+    br.client = types.SimpleNamespace(meta=_MetaBoom())
+    with pytest.raises(BrError, match="boom"):
+        br._restore_meta({"schemas": ["s1"], "tables": []}, {})
+
+    class _MetaCollide:
+        def CreateSchema(self, req):
+            return _resp_with(pb.CreateSchemaResponse(), 40002, "exists")
+
+        def ImportTable(self, req):
+            return _resp_with(pb.ImportTableResponse(), 40002, "exists")
+
+    br.client = types.SimpleNamespace(meta=_MetaCollide())
+    br._restore_meta({"schemas": ["s1"], "tables": []}, {})  # no raise
+
+    class _MetaTableBoom(_MetaCollide):
+        def ImportTable(self, req):
+            return _resp_with(pb.ImportTableResponse(), 40001, "table boom")
+
+    br.client = types.SimpleNamespace(meta=_MetaTableBoom())
+    d = pb.TableDef()
+    d.name = "t1"
+    manifest = {"schemas": [],
+                "tables": [{"definition_pb": d.SerializeToString().hex()}]}
+    with pytest.raises(BrError, match="table boom"):
+        br._restore_meta(manifest, {})
