@@ -81,6 +81,25 @@ def build_parser() -> argparse.ArgumentParser:
     kget = kv.add_parser("get")
     kget.add_argument("key")
 
+    txn = sub.add_parser("txn").add_subparsers(dest="cmd")
+    tput = txn.add_parser("put")          # one-shot transactional put
+    tput.add_argument("key")
+    tput.add_argument("value")
+    tput.add_argument("--pessimistic", action="store_true")
+    tget = txn.add_parser("get")
+    tget.add_argument("key")
+    tlocks = txn.add_parser("scan-locks")
+    tlocks.add_argument("--max-ts", type=int, default=0)
+    tlocks.add_argument("--limit", type=int, default=100)
+    tres = txn.add_parser("resolve")
+    tres.add_argument("--start-ts", type=int, required=True)
+    tres.add_argument("--commit-ts", type=int, default=0)
+    tgc = txn.add_parser("gc")
+    tgc.add_argument("--safe-ts", type=int, required=True)
+    tdump = txn.add_parser("dump")
+    tdump.add_argument("--region", type=int, required=True)
+    tdump.add_argument("--limit", type=int, default=100)
+
     dbg = sub.add_parser("debug").add_subparsers(dest="cmd")
     met = dbg.add_parser("metrics")
     met.add_argument("--store", dest="target_store", required=True)
@@ -209,6 +228,39 @@ def run_command(client: DingoClient, args) -> int:
     elif g == "kv" and c == "get":
         v = client.kv_get(args.key.encode())
         print(v.decode() if v is not None else "(nil)")
+    elif g == "txn" and c == "put":
+        t = client.begin_txn(pessimistic=args.pessimistic)
+        key = args.key.encode()
+        if args.pessimistic:
+            t.lock([key])
+        t.put(key, args.value.encode())
+        commit_ts = t.commit()
+        print(json.dumps({"start_ts": t.start_ts, "commit_ts": commit_ts}))
+    elif g == "txn" and c == "get":
+        t = client.begin_txn()
+        v = t.get(args.key.encode())
+        print(v.decode() if v is not None else "(nil)")
+    elif g == "txn" and c == "scan-locks":
+        locks = client.txn_scan_lock(max_ts=args.max_ts, limit=args.limit)
+        for li in locks:
+            print(json.dumps({
+                "key": li.key.hex(), "lock_ts": li.lock_ts,
+                "primary": li.primary_lock.hex(), "op": li.op,
+                "ttl_ms": li.ttl_ms,
+            }))
+        print(json.dumps({"locks": len(locks)}))
+    elif g == "txn" and c == "resolve":
+        n = client.txn_resolve_lock(args.start_ts, args.commit_ts)
+        print(json.dumps({"resolved": n}))
+    elif g == "txn" and c == "gc":
+        n = client.txn_gc(args.safe_ts)
+        print(json.dumps({"deleted": n}))
+    elif g == "txn" and c == "dump":
+        d = client.txn_dump(args.region, limit=args.limit)
+        print(json.dumps({
+            "locks": len(d.locks), "writes": len(d.writes),
+            "datas": len(d.datas),
+        }))
     elif g == "debug" and c == "metrics":
         stub = client._stub(args.target_store, "DebugService")
         print(stub.MetricsDump(pb.MetricsDumpRequest()).json)

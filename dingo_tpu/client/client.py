@@ -32,6 +32,7 @@ class DingoClient:
     def __init__(self, coordinator_addr: str,
                  store_addrs: Dict[str, str]):
         """store_addrs: store_id -> grpc address."""
+        self._coordinator_addr = coordinator_addr
         self._coord_channel = grpc.insecure_channel(coordinator_addr)
         self.coordinator = ServiceStub(self._coord_channel, "CoordinatorService")
         self.version = ServiceStub(self._coord_channel, "VersionService")
@@ -95,6 +96,11 @@ class DingoClient:
                     if "/" in hint:
                         self._leader_hint[definition.region_id] = \
                             hint.split("/")[0]
+                if code not in (20001, 10001):
+                    # application error from the node that actually served
+                    # the request (lock conflict, validation, ...): rotating
+                    # peers can't change the answer — fail fast
+                    raise ClientError(f"{method}: {resp.error.errmsg}")
             time.sleep(0.1)
         raise ClientError(f"no leader accepted {method}: {last_err}")
 
@@ -393,6 +399,105 @@ class DingoClient:
             if d.start_key <= key < d.end_key:
                 return d
         raise ClientError(f"no region covers key {key!r}")
+
+    def _group_keys_by_region(self, keys):
+        """[(region_definition, [keys])] — one group per hosting region."""
+        groups = {}
+        for key in keys:
+            d = self._region_for_key(key)
+            groups.setdefault(d.region_id, (d, []))[1].append(key)
+        return list(groups.values())
+
+    # ---------------- transactions (reference Java SDK txn API) ----------
+    def begin_txn(self, pessimistic: bool = False,
+                  lock_ttl_ms: int = 3000):
+        """Start a Percolator transaction (client/txn.py drives the 2PC)."""
+        from dingo_tpu.client.txn import Transaction
+
+        return Transaction(self, self.tso(1), pessimistic=pessimistic,
+                           lock_ttl_ms=lock_ttl_ms)
+
+    def txn_scan_lock(self, start_key: bytes = b"", end_key: bytes = b"",
+                      max_ts: int = 0, limit: int = 0):
+        """Leftover locks across every region intersecting the range."""
+        self.refresh_region_map()
+        out = []
+        for d in self._regions:
+            req = pb.TxnScanLockRequest()
+            req.context.region_id = d.region_id
+            req.range.start_key = start_key
+            req.range.end_key = end_key
+            req.max_ts = max_ts
+            req.limit = limit
+            resp = self._call_leader(d, "StoreService", "TxnScanLock", req)
+            out.extend(resp.locks)
+            if limit and len(out) >= limit:
+                return out[:limit]
+        return out
+
+    def txn_check_status(self, primary: bytes, lock_ts: int) -> dict:
+        d = self._region_for_key(primary)
+        req = pb.TxnCheckStatusRequest()
+        req.context.region_id = d.region_id
+        req.primary_key = primary
+        req.lock_ts = lock_ts
+        req.caller_start_ts = self.tso(1)
+        resp = self._call_leader(d, "StoreService", "TxnCheckStatus", req)
+        return {"action": resp.action, "commit_ts": resp.commit_ts}
+
+    def txn_resolve_lock(self, start_ts: int, commit_ts: int = 0,
+                         keys: Optional[Sequence[bytes]] = None) -> int:
+        """Commit (commit_ts > 0) or roll back leftover locks of a txn on
+        every region (or just the regions hosting `keys`)."""
+        resolved = 0
+        if keys:
+            groups = self._group_keys_by_region(keys)
+        else:
+            self.refresh_region_map()
+            groups = [(d, []) for d in self._regions]
+        for d, group in groups:
+            req = pb.TxnResolveLockRequest()
+            req.context.region_id = d.region_id
+            req.start_ts = start_ts
+            req.commit_ts = commit_ts
+            req.keys.extend(group)
+            resp = self._call_leader(d, "StoreService", "TxnResolveLock", req)
+            resolved += resp.resolved
+        return resolved
+
+    def txn_resolve_leftovers(self, lock) -> int:
+        """Crash recovery around one leftover lock (pb.TxnLockInfo): ask
+        the primary's region for the txn's fate, then resolve accordingly
+        on every region. Returns locks resolved."""
+        st = self.txn_check_status(lock.primary_lock, lock.lock_ts)
+        commit_ts = st["commit_ts"] if st["action"] == "committed" else 0
+        if st["action"] == "locked":
+            return 0   # still alive — nothing to resolve
+        return self.txn_resolve_lock(lock.lock_ts, commit_ts)
+
+    def txn_gc(self, safe_point_ts: int) -> int:
+        """MVCC garbage collection below the safe point, all regions."""
+        self.refresh_region_map()
+        deleted = 0
+        for d in self._regions:
+            req = pb.TxnGcRequest()
+            req.context.region_id = d.region_id
+            req.safe_point_ts = safe_point_ts
+            resp = self._call_leader(d, "StoreService", "TxnGc", req)
+            deleted += resp.deleted
+        return deleted
+
+    def txn_dump(self, region_id: int, limit: int = 0):
+        """Debug dump of a region's txn CFs (TxnDump)."""
+        self.refresh_region_map()
+        d = next((r for r in self._regions if r.region_id == region_id),
+                 None)
+        if d is None:
+            raise ClientError(f"region {region_id} not found")
+        req = pb.TxnDumpRequest()
+        req.context.region_id = region_id
+        req.limit = limit
+        return self._call_leader(d, "StoreService", "TxnDump", req)
 
     def kv_put(self, key: bytes, value: bytes) -> None:
         d = self._region_for_key(key)

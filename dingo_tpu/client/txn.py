@@ -1,0 +1,228 @@
+"""Client-side Percolator transactions over the Txn RPC surface.
+
+Reference: the Java SDK's transaction API over store_service.h's 16 Txn
+RPCs (TxnPrewrite/Commit/PessimisticLock/ResolveLock/HeartBeat/...).
+The client drives the 2PC protocol:
+
+  optimistic:   buffer writes -> prewrite (primary first, then the rest,
+                grouped per region) -> commit primary -> commit secondaries
+  pessimistic:  begin_pessimistic() -> lock(keys) before writing them ->
+                same prewrite/commit epilogue (prewrite carries
+                for_update_ts so the store upgrades the pessimistic locks)
+
+Crash recovery: a reader hitting a leftover lock calls
+TxnCheckStatus on the lock's primary (expired -> rolled back there), then
+TxnResolveLock on the lock's region to commit/abort the leftovers — see
+DingoClient.txn_resolve_leftovers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from dingo_tpu.server import pb
+
+
+class TxnClientError(RuntimeError):
+    pass
+
+
+class Transaction:
+    """One transaction; NOT thread-safe (like the reference SDK txn)."""
+
+    def __init__(self, client, start_ts: int, pessimistic: bool = False,
+                 lock_ttl_ms: int = 3000):
+        self._c = client
+        self.start_ts = start_ts
+        self.pessimistic = pessimistic
+        self.lock_ttl_ms = lock_ttl_ms
+        self.for_update_ts = 0
+        #: key -> value (None = delete); insertion order fixes the primary
+        self._writes: Dict[bytes, Optional[bytes]] = {}
+        self._locked: List[bytes] = []
+        self._state = "active"
+
+    # -- buffered writes -----------------------------------------------------
+    def put(self, key: bytes, value: bytes) -> None:
+        self._check_active()
+        self._writes[key] = value
+
+    def delete(self, key: bytes) -> None:
+        self._check_active()
+        self._writes[key] = None
+
+    # -- snapshot reads (own writes win) -------------------------------------
+    def get(self, key: bytes) -> Optional[bytes]:
+        self._check_active()
+        if key in self._writes:
+            return self._writes[key]
+        d = self._c._region_for_key(key)
+        req = pb.TxnGetRequest()
+        req.context.region_id = d.region_id
+        req.key = key
+        req.start_ts = self.start_ts
+        resp = self._c._call_leader(d, "StoreService", "TxnGet", req)
+        return resp.value if resp.found else None
+
+    def batch_get(self, keys: Sequence[bytes]) -> Dict[bytes, bytes]:
+        self._check_active()
+        out: Dict[bytes, bytes] = {}
+        remote: List[bytes] = []
+        for key in keys:
+            if key in self._writes:
+                if self._writes[key] is not None:
+                    out[key] = self._writes[key]
+            else:
+                remote.append(key)
+        for d, group in self._c._group_keys_by_region(remote):
+            req = pb.TxnBatchGetRequest()
+            req.context.region_id = d.region_id
+            req.keys.extend(group)
+            req.start_ts = self.start_ts
+            resp = self._c._call_leader(d, "StoreService", "TxnBatchGet", req)
+            for kv in resp.kvs:
+                out[kv.key] = kv.value
+        return out
+
+    # -- pessimistic locks ---------------------------------------------------
+    def lock(self, keys: Sequence[bytes]) -> None:
+        """Acquire pessimistic locks (TxnPessimisticLock) before writing —
+        SELECT ... FOR UPDATE. for_update_ts is a fresh TSO ts so the
+        store detects writes that committed after our snapshot."""
+        self._check_active()
+        if not self.pessimistic:
+            raise TxnClientError("optimistic txn: lock() not available")
+        self.for_update_ts = self._c.tso(1)
+        primary = self._primary_for(keys)
+        for d, group in self._c._group_keys_by_region(keys):
+            req = pb.TxnPessimisticLockRequest()
+            req.context.region_id = d.region_id
+            req.keys.extend(group)
+            req.primary_lock = primary
+            req.start_ts = self.start_ts
+            req.for_update_ts = self.for_update_ts
+            req.lock_ttl_ms = self.lock_ttl_ms
+            self._c._call_leader(
+                d, "StoreService", "TxnPessimisticLock", req)
+        self._locked.extend(k for k in keys if k not in self._locked)
+
+    def heart_beat(self, advise_ttl_ms: int = 10000) -> int:
+        """Extend the primary lock's TTL (long-running txn keep-alive)."""
+        primary = self._primary()
+        d = self._c._region_for_key(primary)
+        req = pb.TxnHeartBeatRequest()
+        req.context.region_id = d.region_id
+        req.primary_lock = primary
+        req.start_ts = self.start_ts
+        req.advise_lock_ttl_ms = advise_ttl_ms
+        resp = self._c._call_leader(d, "StoreService", "TxnHeartBeat", req)
+        return resp.lock_ttl_ms
+
+    # -- 2PC -----------------------------------------------------------------
+    def commit(self) -> int:
+        """Prewrite all buffered writes then commit; returns commit_ts.
+        Primary key's region commits first — once it commits, the txn is
+        logically committed and secondaries are resolvable by anyone."""
+        self._check_active()
+        # pessimistic locks on keys we never wrote must not linger until
+        # TTL expiry — release them as part of commit
+        unwritten = [k for k in self._locked if k not in self._writes]
+        if unwritten:
+            self._pessimistic_release(unwritten)
+        if not self._writes:
+            self._state = "committed"
+            return self.start_ts
+        primary = self._primary()
+        groups = self._c._group_keys_by_region(list(self._writes))
+        # prewrite the primary's region first (reference prewrites primary
+        # before secondaries so CheckTxnStatus has an authority)
+        ordered = sorted(groups, key=lambda kv: primary not in kv[1])
+        for d, group in ordered:
+            req = pb.TxnPrewriteRequest()
+            req.context.region_id = d.region_id
+            for key in group:
+                m = req.mutations.add()
+                value = self._writes[key]
+                m.op = "put" if value is not None else "delete"
+                m.key = key
+                if value is not None:
+                    m.value = value
+            req.primary_lock = primary
+            req.start_ts = self.start_ts
+            req.lock_ttl_ms = self.lock_ttl_ms
+            req.for_update_ts = self.for_update_ts
+            try:
+                self._c._call_leader(d, "StoreService", "TxnPrewrite", req)
+            except Exception:
+                self._try_rollback()
+                raise
+        commit_ts = self._c.tso(1)
+        for d, group in ordered:   # primary region first
+            req = pb.TxnCommitRequest()
+            req.context.region_id = d.region_id
+            req.keys.extend(group)
+            req.start_ts = self.start_ts
+            req.commit_ts = commit_ts
+            self._c._call_leader(d, "StoreService", "TxnCommit", req)
+        self._state = "committed"
+        return commit_ts
+
+    def rollback(self) -> None:
+        self._check_active()
+        self._try_rollback()
+        self._state = "rolled_back"
+
+    # -- internals -----------------------------------------------------------
+    def _primary(self) -> bytes:
+        if self._writes:
+            return next(iter(self._writes))
+        if self._locked:
+            return self._locked[0]
+        raise TxnClientError("empty txn has no primary key")
+
+    def _primary_for(self, keys: Sequence[bytes]) -> bytes:
+        try:
+            return self._primary()
+        except TxnClientError:
+            return keys[0]
+
+    def _pessimistic_release(self, keys: Sequence[bytes]) -> None:
+        for d, group in self._c._group_keys_by_region(keys):
+            req = pb.TxnPessimisticRollbackRequest()
+            req.context.region_id = d.region_id
+            req.keys.extend(group)
+            req.start_ts = self.start_ts
+            req.for_update_ts = self.for_update_ts
+            try:
+                self._c._call_leader(
+                    d, "StoreService", "TxnPessimisticRollback", req)
+            except Exception:  # noqa: BLE001 — best-effort; locks expire
+                pass
+
+    def _try_rollback(self) -> None:
+        keys = list(dict.fromkeys(list(self._writes) + self._locked))
+        for d, group in self._c._group_keys_by_region(keys):
+            req = pb.TxnBatchRollbackRequest()
+            req.context.region_id = d.region_id
+            req.keys.extend(group)
+            req.start_ts = self.start_ts
+            try:
+                self._c._call_leader(
+                    d, "StoreService", "TxnBatchRollback", req)
+            except Exception:  # noqa: BLE001 — best-effort; locks expire
+                pass
+            if self._locked:
+                req2 = pb.TxnPessimisticRollbackRequest()
+                req2.context.region_id = d.region_id
+                req2.keys.extend(group)
+                req2.start_ts = self.start_ts
+                req2.for_update_ts = self.for_update_ts
+                try:
+                    self._c._call_leader(
+                        d, "StoreService", "TxnPessimisticRollback", req2)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _check_active(self) -> None:
+        if self._state != "active":
+            raise TxnClientError(f"txn is {self._state}")

@@ -158,6 +158,25 @@ class TxnEngine:
     def _apply(self, puts, deletes) -> None:
         self.engine.write(self.region, TxnRaftData(puts=puts, deletes=deletes))
 
+    def _region_range(
+        self, start_key: bytes = b"", end_key: bytes = b""
+    ) -> Tuple[bytes, Optional[bytes]]:
+        """Encoded scan bounds = request range clamped to the REGION's
+        range. The txn CFs are shared by every region on the store, so an
+        unclamped scan would leak other regions' records into per-region
+        RPCs (duplicate ScanLock results, cross-region GC)."""
+        rstart = self.region.definition.start_key
+        rend = self.region.definition.end_key
+        start = max(start_key, rstart) if start_key else rstart
+        if end_key and rend:
+            end = min(end_key, rend)
+        else:
+            end = end_key or rend
+        return (
+            Codec.encode_bytes(start),
+            Codec.encode_bytes(end) if end else None,
+        )
+
     # -- Percolator ops ------------------------------------------------------
     def prewrite(
         self,
@@ -338,7 +357,7 @@ class TxnEngine:
         roll back (== 0) leftover locks of txn start_ts."""
         if keys is None:
             keys = []
-            for k, blob in self.raw.scan(CF_TXN_LOCK):
+            for k, blob in self.raw.scan(CF_TXN_LOCK, *self._region_range()):
                 lock: LockRecord = _dec_lock(blob)
                 if lock.lock_ts == start_ts:
                     keys.append(Codec.decode_bytes(k)[0])
@@ -426,6 +445,105 @@ class TxnEngine:
             # ROLLBACK: continue scanning older versions of this key
         return out
 
+    def scan_lock(
+        self,
+        start_key: bytes = b"",
+        end_key: bytes = b"",
+        max_ts: int = MAX_TS,
+        limit: int = 0,
+    ) -> List[Tuple[bytes, "LockRecord"]]:
+        """TxnEngineHelper::ScanLockInfo (store_service.h TxnScanLock):
+        leftover locks in [start_key, end_key) with lock_ts <= max_ts —
+        the orphan-lock discovery primitive ResolveLock clients use."""
+        out: List[Tuple[bytes, LockRecord]] = []
+        enc_start, enc_end = self._region_range(start_key, end_key)
+        for k, blob in self.raw.scan(CF_TXN_LOCK, enc_start, enc_end):
+            lock = _dec_lock(blob)
+            if lock.lock_ts > max_ts:
+                continue
+            out.append((Codec.decode_bytes(k)[0], lock))
+            if limit and len(out) >= limit:
+                break
+        return out
+
+    def batch_get(
+        self, keys: Sequence[bytes], read_ts: int
+    ) -> List[Tuple[bytes, Optional[bytes]]]:
+        """TxnBatchGet: snapshot point reads; raises KeyIsLocked like get."""
+        return [(key, self.get(key, read_ts)) for key in keys]
+
+    def check_secondary_locks(
+        self, keys: Sequence[bytes], start_ts: int
+    ) -> Dict:
+        """TxnCheckSecondaryLocks (store_service.h): async-commit support —
+        report the state of txn start_ts's secondary keys on this region.
+        Returns {"locks": [(key, LockRecord)...], "commit_ts": N} where
+        commit_ts > 0 means some key already committed at that ts, and a
+        key with neither lock nor write means the txn was rolled back
+        (reported in "missing")."""
+        locks: List[Tuple[bytes, LockRecord]] = []
+        missing: List[bytes] = []
+        commit_ts = 0
+        for key in keys:
+            lock = self.get_lock(key)
+            if lock is not None and lock.lock_ts == start_ts:
+                locks.append((key, lock))
+                continue
+            found = False
+            for cts, rec in self._writes_desc(key, MAX_TS):
+                if rec.start_ts == start_ts:
+                    found = True
+                    if rec.op is not Op.ROLLBACK:
+                        commit_ts = max(commit_ts, cts)
+                    break
+            if not found:
+                missing.append(key)
+        return {"locks": locks, "commit_ts": commit_ts, "missing": missing}
+
+    def delete_range(self, start_key: bytes, end_key: bytes) -> None:
+        """TxnDeleteRange (admin op): physically drop [start_key, end_key)
+        from all three txn CFs — bypasses MVCC, replicated like any write."""
+        enc_start, enc_end = self._region_range(start_key, end_key)
+        deletes = []
+        for cf in (CF_TXN_DATA, CF_TXN_LOCK, CF_TXN_WRITE):
+            for k, _ in self.raw.scan(cf, enc_start, enc_end):
+                deletes.append((cf, k))
+        if deletes:
+            self._apply([], deletes)
+
+    def dump(
+        self, start_key: bytes = b"", end_key: bytes = b"", limit: int = 0
+    ) -> Dict:
+        """TxnDump (debug): raw contents of the three txn CFs in a range."""
+        enc_start, enc_end = self._region_range(start_key, end_key)
+        out: Dict = {"locks": [], "writes": [], "datas": []}
+        for k, blob in self.raw.scan(CF_TXN_LOCK, enc_start, enc_end):
+            lock = _dec_lock(blob)
+            out["locks"].append({
+                "key": Codec.decode_bytes(k)[0], "lock_ts": lock.lock_ts,
+                "primary": lock.primary, "op": lock.op.value,
+                "ttl_ms": lock.ttl_ms, "for_update_ts": lock.for_update_ts,
+            })
+            if limit and len(out["locks"]) >= limit:
+                break
+        for k, v in self.raw.scan(CF_TXN_WRITE, enc_start, enc_end):
+            key, commit_ts = Codec.decode_key(k)
+            rec = _dec_write(v)
+            out["writes"].append({
+                "key": key, "commit_ts": commit_ts,
+                "start_ts": rec.start_ts, "op": rec.op.value,
+            })
+            if limit and len(out["writes"]) >= limit:
+                break
+        for k, v in self.raw.scan(CF_TXN_DATA, enc_start, enc_end):
+            key, start_ts = Codec.decode_key(k)
+            out["datas"].append({
+                "key": key, "start_ts": start_ts, "value": v,
+            })
+            if limit and len(out["datas"]) >= limit:
+                break
+        return out
+
     # -- GC -------------------------------------------------------------------
     def gc(self, safe_ts: int) -> int:
         """TxnEngineHelper::Gc / DoGcCoreTxn (:243-280): for each key keep
@@ -435,7 +553,7 @@ class TxnEngine:
         doomed_data: List[bytes] = []
         current: Optional[bytes] = None
         kept_newest = False
-        for k, v in self.raw.scan(CF_TXN_WRITE):
+        for k, v in self.raw.scan(CF_TXN_WRITE, *self._region_range()):
             key, commit_ts = Codec.decode_key(k)
             if key != current:
                 current = key

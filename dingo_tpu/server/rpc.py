@@ -71,6 +71,22 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "TxnScan": (pb.TxnScanRequest, pb.TxnScanResponse),
         "TxnBatchRollback": (pb.TxnBatchRollbackRequest, pb.TxnBatchRollbackResponse),
         "TxnCheckStatus": (pb.TxnCheckStatusRequest, pb.TxnCheckStatusResponse),
+        "TxnPessimisticLock": (
+            pb.TxnPessimisticLockRequest, pb.TxnPessimisticLockResponse,
+        ),
+        "TxnPessimisticRollback": (
+            pb.TxnPessimisticRollbackRequest, pb.TxnPessimisticRollbackResponse,
+        ),
+        "TxnResolveLock": (pb.TxnResolveLockRequest, pb.TxnResolveLockResponse),
+        "TxnHeartBeat": (pb.TxnHeartBeatRequest, pb.TxnHeartBeatResponse),
+        "TxnGc": (pb.TxnGcRequest, pb.TxnGcResponse),
+        "TxnScanLock": (pb.TxnScanLockRequest, pb.TxnScanLockResponse),
+        "TxnBatchGet": (pb.TxnBatchGetRequest, pb.TxnBatchGetResponse),
+        "TxnCheckSecondaryLocks": (
+            pb.TxnCheckSecondaryLocksRequest, pb.TxnCheckSecondaryLocksResponse,
+        ),
+        "TxnDeleteRange": (pb.TxnDeleteRangeRequest, pb.TxnDeleteRangeResponse),
+        "TxnDump": (pb.TxnDumpRequest, pb.TxnDumpResponse),
         "KvScanBegin": (pb.KvScanBeginRequest, pb.KvScanBeginResponse),
         "KvScanContinue": (pb.KvScanContinueRequest, pb.KvScanContinueResponse),
         "KvScanRelease": (pb.KvScanReleaseRequest, pb.KvScanReleaseResponse),

@@ -549,9 +549,22 @@ class StoreService:
 
     def __init__(self, node: StoreNode):
         self.node = node
+        # one TxnEngine per region, NOT per request: the engine's
+        # ConcurrencyManager (per-key latches) only serializes concurrent
+        # check-then-write sections if every request for a region shares it
+        # — a per-request manager would let two pessimistic locks for
+        # different txns both "win" the same key
+        self._txn_engines: Dict[int, TxnEngine] = {}
+        self._txn_engines_lock = threading.Lock()
 
     def _txn(self, region: Region) -> TxnEngine:
-        return TxnEngine(self.node.engine, region)
+        with self._txn_engines_lock:
+            eng = self._txn_engines.get(region.id)
+            if eng is None or eng.region is not region:
+                # new region object (create/epoch change): fresh engine
+                eng = TxnEngine(self.node.engine, region)
+                self._txn_engines[region.id] = eng
+            return eng
 
     def KvGet(self, req: pb.KvGetRequest) -> pb.KvGetResponse:
         resp = pb.KvGetResponse()
@@ -754,9 +767,23 @@ class StoreService:
         return resp
 
     # ---- txn ----
+    def _txn_region_or_err(self, context_pb, resp):
+        """Txn RPCs are leader-gated — reads included: a follower lagging
+        raft apply would serve snapshots missing already-committed writes
+        (the reference serves the whole txn surface through the leader)."""
+        region = _region_or_err(self.node, context_pb, resp)
+        if region is None:
+            return None
+        raft = self.node.engine.get_node(region.id)
+        if raft is not None and not raft.is_leader():
+            hint = getattr(raft, "leader_id", None) or ""
+            _err(resp, 20001, f"not leader: {hint}")
+            return None
+        return region
+
     def TxnPrewrite(self, req: pb.TxnPrewriteRequest):
         resp = pb.TxnPrewriteResponse()
-        region = _region_or_err(self.node, req.context, resp)
+        region = self._txn_region_or_err(req.context, resp)
         if region is None:
             return resp
         muts = [
@@ -774,7 +801,7 @@ class StoreService:
 
     def TxnCommit(self, req: pb.TxnCommitRequest):
         resp = pb.TxnCommitResponse()
-        region = _region_or_err(self.node, req.context, resp)
+        region = self._txn_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -785,7 +812,7 @@ class StoreService:
 
     def TxnGet(self, req: pb.TxnGetRequest):
         resp = pb.TxnGetResponse()
-        region = _region_or_err(self.node, req.context, resp)
+        region = self._txn_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -798,7 +825,7 @@ class StoreService:
 
     def TxnScan(self, req: pb.TxnScanRequest):
         resp = pb.TxnScanResponse()
-        region = _region_or_err(self.node, req.context, resp)
+        region = self._txn_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -827,7 +854,7 @@ class StoreService:
 
     def TxnBatchRollback(self, req: pb.TxnBatchRollbackRequest):
         resp = pb.TxnBatchRollbackResponse()
-        region = _region_or_err(self.node, req.context, resp)
+        region = self._txn_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -838,7 +865,7 @@ class StoreService:
 
     def TxnCheckStatus(self, req: pb.TxnCheckStatusRequest):
         resp = pb.TxnCheckStatusResponse()
-        region = _region_or_err(self.node, req.context, resp)
+        region = self._txn_region_or_err(req.context, resp)
         if region is None:
             return resp
         st = self._txn(region).check_txn_status(
@@ -846,6 +873,160 @@ class StoreService:
         )
         resp.action = st["action"]
         resp.commit_ts = st["commit_ts"]
+        return resp
+
+    # -- pessimistic / maintenance txn surface (store_service.h exposes 16
+    # Txn RPCs; engine semantics live in engine/txn.py) ----------------------
+    def TxnPessimisticLock(self, req: pb.TxnPessimisticLockRequest):
+        resp = pb.TxnPessimisticLockResponse()
+        region = self._txn_region_or_err(req.context, resp)
+        if region is None:
+            return resp
+        try:
+            self._txn(region).pessimistic_lock(
+                list(req.keys), req.primary_lock, req.start_ts,
+                req.for_update_ts, ttl_ms=req.lock_ttl_ms or 3000,
+            )
+        except TxnError as e:
+            return _err(resp, 40001, str(e))
+        return resp
+
+    def TxnPessimisticRollback(self, req: pb.TxnPessimisticRollbackRequest):
+        resp = pb.TxnPessimisticRollbackResponse()
+        region = self._txn_region_or_err(req.context, resp)
+        if region is None:
+            return resp
+        try:
+            self._txn(region).pessimistic_rollback(
+                list(req.keys), req.start_ts)
+        except TxnError as e:
+            return _err(resp, 40001, str(e))
+        return resp
+
+    def TxnResolveLock(self, req: pb.TxnResolveLockRequest):
+        resp = pb.TxnResolveLockResponse()
+        region = self._txn_region_or_err(req.context, resp)
+        if region is None:
+            return resp
+        try:
+            resp.resolved = self._txn(region).resolve_lock(
+                req.start_ts, req.commit_ts,
+                keys=list(req.keys) or None,
+            )
+        except TxnError as e:
+            return _err(resp, 40001, str(e))
+        return resp
+
+    def TxnHeartBeat(self, req: pb.TxnHeartBeatRequest):
+        resp = pb.TxnHeartBeatResponse()
+        region = self._txn_region_or_err(req.context, resp)
+        if region is None:
+            return resp
+        try:
+            resp.lock_ttl_ms = self._txn(region).heart_beat(
+                req.primary_lock, req.start_ts, req.advise_lock_ttl_ms)
+        except TxnError as e:
+            return _err(resp, 40001, str(e))
+        return resp
+
+    def TxnGc(self, req: pb.TxnGcRequest):
+        resp = pb.TxnGcResponse()
+        region = self._txn_region_or_err(req.context, resp)
+        if region is None:
+            return resp
+        try:
+            resp.deleted = self._txn(region).gc(req.safe_point_ts)
+        except TxnError as e:
+            return _err(resp, 40001, str(e))
+        return resp
+
+    @staticmethod
+    def _lock_to_pb(dst, key: bytes, lock) -> None:
+        dst.key = key
+        dst.lock_ts = lock.lock_ts
+        dst.primary_lock = lock.primary
+        dst.op = lock.op.value
+        dst.ttl_ms = lock.ttl_ms
+        dst.for_update_ts = lock.for_update_ts
+
+    def TxnScanLock(self, req: pb.TxnScanLockRequest):
+        resp = pb.TxnScanLockResponse()
+        region = self._txn_region_or_err(req.context, resp)
+        if region is None:
+            return resp
+        from dingo_tpu.mvcc.codec import MAX_TS as _MAX_TS
+
+        locks = self._txn(region).scan_lock(
+            req.range.start_key, req.range.end_key,
+            max_ts=req.max_ts or _MAX_TS, limit=req.limit,
+        )
+        for key, lock in locks:
+            self._lock_to_pb(resp.locks.add(), key, lock)
+        return resp
+
+    def TxnBatchGet(self, req: pb.TxnBatchGetRequest):
+        resp = pb.TxnBatchGetResponse()
+        region = self._txn_region_or_err(req.context, resp)
+        if region is None:
+            return resp
+        try:
+            pairs = self._txn(region).batch_get(list(req.keys), req.start_ts)
+        except TxnError as e:
+            return _err(resp, 40001, str(e))
+        for key, value in pairs:
+            if value is None:
+                continue
+            kv = resp.kvs.add()
+            kv.key = key
+            kv.value = value
+        return resp
+
+    def TxnCheckSecondaryLocks(self, req: pb.TxnCheckSecondaryLocksRequest):
+        resp = pb.TxnCheckSecondaryLocksResponse()
+        region = self._txn_region_or_err(req.context, resp)
+        if region is None:
+            return resp
+        st = self._txn(region).check_secondary_locks(
+            list(req.keys), req.start_ts)
+        for key, lock in st["locks"]:
+            self._lock_to_pb(resp.locks.add(), key, lock)
+        resp.commit_ts = st["commit_ts"]
+        resp.missing_keys.extend(st["missing"])
+        return resp
+
+    def TxnDeleteRange(self, req: pb.TxnDeleteRangeRequest):
+        resp = pb.TxnDeleteRangeResponse()
+        region = self._txn_region_or_err(req.context, resp)
+        if region is None:
+            return resp
+        try:
+            self._txn(region).delete_range(
+                req.range.start_key, req.range.end_key)
+        except TxnError as e:
+            return _err(resp, 40001, str(e))
+        return resp
+
+    def TxnDump(self, req: pb.TxnDumpRequest):
+        resp = pb.TxnDumpResponse()
+        region = self._txn_region_or_err(req.context, resp)
+        if region is None:
+            return resp
+        d = self._txn(region).dump(
+            req.range.start_key, req.range.end_key, limit=req.limit)
+        for e in d["locks"]:
+            li = resp.locks.add()
+            li.key, li.lock_ts, li.primary_lock = (
+                e["key"], e["lock_ts"], e["primary"])
+            li.op, li.ttl_ms, li.for_update_ts = (
+                e["op"], e["ttl_ms"], e["for_update_ts"])
+        for e in d["writes"]:
+            wi = resp.writes.add()
+            wi.key, wi.commit_ts = e["key"], e["commit_ts"]
+            wi.start_ts, wi.op = e["start_ts"], e["op"]
+        for e in d["datas"]:
+            di = resp.datas.add()
+            di.key, di.start_ts, di.value = (
+                e["key"], e["start_ts"], e["value"])
         return resp
 
 

@@ -1,0 +1,262 @@
+"""Full txn wire surface over gRPC: pessimistic flow, cross-region 2PC,
+orphan-lock recovery, maintenance RPCs (reference store_service.h exposes
+16 Txn RPCs; engine semantics in engine/txn.py, client 2PC in client/txn.py)."""
+
+import time
+
+import pytest
+
+from dingo_tpu.client.client import ClientError, DingoClient
+from dingo_tpu.client.txn import TxnClientError
+from dingo_tpu.coordinator.control import CoordinatorControl
+from dingo_tpu.coordinator.kv_control import KvControl
+from dingo_tpu.coordinator.tso import TsoControl
+from dingo_tpu.engine.raw_engine import MemEngine
+from dingo_tpu.raft import LocalTransport
+from dingo_tpu.server import pb
+from dingo_tpu.server.rpc import DingoServer
+from dingo_tpu.store.node import StoreNode
+
+
+@pytest.fixture()
+def cluster():
+    transport = LocalTransport()
+    me = MemEngine()
+    control = CoordinatorControl(me, replication=3)
+    coord_server = DingoServer()
+    coord_server.host_coordinator_role(control, TsoControl(me), KvControl(me))
+    coord_port = coord_server.start()
+
+    nodes, servers, addrs = {}, [], {}
+    for i, sid in enumerate(["s0", "s1", "s2"]):
+        node = StoreNode(sid, transport, control, raft_kw={"seed": i})
+        server = DingoServer()
+        server.host_store_role(node)
+        port = server.start()
+        node.start_heartbeat(0.1)
+        nodes[sid] = node
+        servers.append(server)
+        addrs[sid] = f"127.0.0.1:{port}"
+
+    client = DingoClient(f"127.0.0.1:{coord_port}", addrs)
+    # two KV regions so 2PC crosses a region boundary: [a, m) and [m, z)
+    for start, end in ((b"a", b"m"), (b"m", b"z")):
+        req = pb.CreateRegionRequest()
+        req.range.start_key = start
+        req.range.end_key = end
+        resp = client.coordinator.CreateRegion(req)
+        assert resp.error.errcode == 0, resp.error.errmsg
+    time.sleep(1.2)   # heartbeats create + elect
+    yield client, nodes
+    client.close()
+    for s in servers:
+        s.stop()
+    coord_server.stop()
+    for n in nodes.values():
+        n.stop()
+
+
+def test_pessimistic_flow_end_to_end(cluster):
+    """lock -> put -> commit, plus for-update conflict detection."""
+    client, nodes = cluster
+    t = client.begin_txn(pessimistic=True)
+    t.lock([b"acct1", b"acct2"])
+    t.put(b"acct1", b"90")
+    t.put(b"acct2", b"110")
+    commit_ts = t.commit()
+    assert commit_ts > t.start_ts
+
+    r = client.begin_txn()
+    assert r.get(b"acct1") == b"90"
+    assert r.get(b"acct2") == b"110"
+
+    # a second pessimistic txn must block on the same keys while locked
+    t1 = client.begin_txn(pessimistic=True)
+    t1.lock([b"acct1"])
+    t2 = client.begin_txn(pessimistic=True)
+    with pytest.raises(ClientError):
+        t2.lock([b"acct1"])
+    t1.rollback()
+    # after rollback the key is lockable again
+    t3 = client.begin_txn(pessimistic=True)
+    t3.lock([b"acct1"])
+    t3.put(b"acct1", b"42")
+    t3.commit()
+    r2 = client.begin_txn()
+    assert r2.get(b"acct1") == b"42"
+
+
+def test_cross_region_commit_and_batch_get(cluster):
+    """One txn spanning both regions commits atomically; TxnBatchGet sees
+    the committed snapshot."""
+    client, nodes = cluster
+    t = client.begin_txn()
+    t.put(b"bob", b"1")      # region [a, m)
+    t.put(b"sue", b"2")      # region [m, z)
+    t.commit()
+
+    r = client.begin_txn()
+    got = r.batch_get([b"bob", b"sue", b"nope"])
+    assert got == {b"bob": b"1", b"sue": b"2"}
+
+
+def test_orphan_lock_discovery_and_resolve(cluster):
+    """Client 'crashes' between prewrite and commit: another client finds
+    the leftover locks (TxnScanLock), checks the primary's fate
+    (TxnCheckStatus -> rolled back after TTL), resolves every region
+    (TxnResolveLock), and the keys become writable again."""
+    client, nodes = cluster
+    dead = client.begin_txn(pessimistic=True, lock_ttl_ms=150)
+    dead.lock([b"crash1", b"mcrash2"])   # spans both regions
+    dead.put(b"crash1", b"x")
+    dead.put(b"mcrash2", b"y")
+    # prewrite WITHOUT commit = the crash window
+    primary = dead._primary()
+    for d, group in client._group_keys_by_region([b"crash1", b"mcrash2"]):
+        req = pb.TxnPrewriteRequest()
+        req.context.region_id = d.region_id
+        for key in group:
+            m = req.mutations.add()
+            m.op = "put"
+            m.key = key
+            m.value = b"zz"
+        req.primary_lock = primary
+        req.start_ts = dead.start_ts
+        req.lock_ttl_ms = 150
+        req.for_update_ts = dead.for_update_ts
+        client._call_leader(d, "StoreService", "TxnPrewrite", req)
+
+    # discovery: the leftover locks are visible
+    locks = client.txn_scan_lock()
+    assert {li.key for li in locks} >= {b"crash1", b"mcrash2"}
+
+    time.sleep(0.25)   # let the TTL expire
+
+    # recovery around any discovered lock
+    lock = next(li for li in locks if li.key == b"mcrash2")
+    resolved = client.txn_resolve_leftovers(lock)
+    assert resolved >= 1
+    st = client.txn_check_status(primary, dead.start_ts)
+    assert st["action"] in ("rolled_back", "lock_not_exist_rollback")
+    assert client.txn_scan_lock() == []
+
+    # the keys are free again
+    t = client.begin_txn(pessimistic=True)
+    t.lock([b"crash1"])
+    t.put(b"crash1", b"alive")
+    t.commit()
+    assert client.begin_txn().get(b"crash1") == b"alive"
+
+
+def test_heart_beat_extends_ttl(cluster):
+    client, nodes = cluster
+    t = client.begin_txn(pessimistic=True, lock_ttl_ms=200)
+    t.lock([b"hb1"])
+    ttl = t.heart_beat(advise_ttl_ms=60000)
+    assert ttl >= 60000
+    time.sleep(0.3)   # would have expired without the heartbeat
+    st = client.txn_check_status(b"hb1", t.start_ts)
+    assert st["action"] == "locked"
+    t.rollback()
+
+
+def test_check_secondary_locks_and_dump_and_gc(cluster):
+    client, nodes = cluster
+    # committed txn with history to GC
+    t = client.begin_txn()
+    t.put(b"gckey", b"v1")
+    t.commit()
+    t2 = client.begin_txn()
+    t2.put(b"gckey", b"v2")
+    commit2 = t2.commit()
+
+    # a txn mid-prewrite: secondaries report its locks
+    t3 = client.begin_txn()
+    d, group = client._group_keys_by_region([b"sec1"])[0]
+    req = pb.TxnPrewriteRequest()
+    req.context.region_id = d.region_id
+    m = req.mutations.add()
+    m.op = "put"
+    m.key = b"sec1"
+    m.value = b"s"
+    req.primary_lock = b"sec1"
+    req.start_ts = t3.start_ts
+    req.lock_ttl_ms = 5000
+    client._call_leader(d, "StoreService", "TxnPrewrite", req)
+
+    creq = pb.TxnCheckSecondaryLocksRequest()
+    creq.context.region_id = d.region_id
+    creq.keys.extend([b"sec1", b"sec_absent"])
+    creq.start_ts = t3.start_ts
+    cresp = client._call_leader(
+        d, "StoreService", "TxnCheckSecondaryLocks", creq)
+    assert [li.key for li in cresp.locks] == [b"sec1"]
+    assert list(cresp.missing_keys) == [b"sec_absent"]
+    client.txn_resolve_lock(t3.start_ts, 0)
+
+    # dump shows writes; gc below a safe point past commit2 drops v1
+    gk = client._region_for_key(b"gckey")
+    dump = client.txn_dump(gk.region_id)
+    assert any(w.key == b"gckey" for w in dump.writes)
+    deleted = client.txn_gc(commit2 + 1)
+    assert deleted >= 1
+    # newest version survives GC
+    assert client.begin_txn().get(b"gckey") == b"v2"
+
+
+def test_cli_txn_verbs(cluster, capsys):
+    """Operator CLI: txn put/get/scan-locks/resolve/gc/dump verbs."""
+    import json as _json
+
+    from dingo_tpu.client.cli import main
+
+    client, nodes = cluster
+    base = ["--coordinator", client._coordinator_addr]
+    for sid, addr in client._store_addrs.items():
+        base += ["--store", f"{sid}={addr}"]
+
+    assert main(base + ["txn", "put", "k1", "v1"]) == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert out["commit_ts"] > out["start_ts"]
+    assert main(base + ["txn", "put", "k2", "v2", "--pessimistic"]) == 0
+    capsys.readouterr()
+    assert main(base + ["txn", "get", "k2"]) == 0
+    assert capsys.readouterr().out.strip() == "v2"
+    assert main(base + ["txn", "scan-locks"]) == 0
+    assert _json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])["locks"] == 0
+    assert main(base + ["txn", "resolve", "--start-ts", "1"]) == 0
+    capsys.readouterr()
+    assert main(base + ["txn", "gc", "--safe-ts", "1"]) == 0
+    capsys.readouterr()
+    rid = client._region_for_key(b"k1").region_id
+    assert main(base + ["txn", "dump", "--region", str(rid)]) == 0
+    d = _json.loads(capsys.readouterr().out)
+    assert d["writes"] >= 1
+
+
+def test_concurrent_pessimistic_lock_single_winner(cluster):
+    """Two txns racing TxnPessimisticLock on one key: exactly one wins
+    (the per-region TxnEngine's key latches serialize check-then-write;
+    a per-request engine would let both 'succeed')."""
+    import threading
+
+    client, nodes = cluster
+    results = []
+
+    def worker():
+        t = client.begin_txn(pessimistic=True)
+        try:
+            t.lock([b"contested"])
+            results.append(("ok", t))
+        except ClientError as e:
+            results.append(("err", str(e)))
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    winners = [r for r in results if r[0] == "ok"]
+    assert len(winners) == 1, results
+    winners[0][1].rollback()
