@@ -320,8 +320,11 @@ class DingoClient:
     # ---------------- vectors ----------------
     def vector_add(self, partition_id: int, ids: Sequence[int],
                    vectors: np.ndarray,
-                   scalars: Optional[List[Dict[str, Any]]] = None) -> None:
-        """Batch add routed per owning region."""
+                   scalars: Optional[List[Dict[str, Any]]] = None,
+                   table_values: Optional[Sequence[bytes]] = None) -> None:
+        """Batch add routed per owning region. `table_values[i]` is an
+        optional serial-encoded table row per vector (the TABLE
+        coprocessor filter's data source)."""
         groups: Dict[int, List[int]] = {}
         regions = self._regions_for_vector_ids(partition_id)  # ONE refresh
         for i, vid in enumerate(ids):
@@ -341,6 +344,9 @@ class DingoClient:
                         e = v.scalar_data.add()
                         e.key = k
                         e.value = wire.encode_obj(val)
+                if table_values is not None and table_values[i] is not None:
+                    # explicit b"" clears the row (optional-field presence)
+                    v.table_data = table_values[i]
             self._call_leader(d, "IndexService", "VectorAdd", req)
 
     def vector_search(
@@ -373,6 +379,12 @@ class DingoClient:
                 req.parameter.nprobe = params["nprobe"]
             if "ef_search" in params:
                 req.parameter.ef_search = params["ef_search"]
+            if "filter" in params:
+                req.parameter.filter = params["filter"]
+            if "filter_type" in params:
+                req.parameter.filter_type = params["filter_type"]
+            if "coprocessor" in params:   # pb.Coprocessor (TABLE filter)
+                req.parameter.coprocessor.CopyFrom(params["coprocessor"])
             resp = self._call_leader(d, "IndexService", "VectorSearch", req)
             for qi, row in enumerate(resp.batch_results):
                 for item in row.results:

@@ -76,3 +76,8 @@ class ScalarFilter:
 
     def is_empty(self) -> bool:
         return not self.predicates
+
+    def fields(self) -> set:
+        """Scalar fields this filter reads — used to decide whether the
+        narrow speed-up CF covers it (vector_index_utils.h split-keys)."""
+        return {p.field for p in self.predicates}

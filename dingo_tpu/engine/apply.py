@@ -21,6 +21,8 @@ import numpy as np
 from dingo_tpu.engine.raw_engine import (
     CF_DEFAULT,
     CF_VECTOR_SCALAR,
+    CF_VECTOR_SCALAR_SPEEDUP,
+    CF_VECTOR_TABLE,
     RawEngine,
     WriteBatch,
 )
@@ -163,6 +165,9 @@ def _apply_vector_add(
     """VectorAddHandler (raft_apply_handler.cc:1115): write data CF + scalar
     CF (+ speed-up/table CFs when schemas exist), then update the index."""
     part = region.definition.partition_id
+    param = region.definition.index_parameter
+    speedup_keys = tuple(
+        getattr(param, "scalar_speedup_keys", ()) or ()) if param else ()
     batch = WriteBatch()
     flag = ValueFlag.PUT_TTL if data.ttl_ms else ValueFlag.PUT
     for i, vid in enumerate(data.ids):
@@ -183,6 +188,48 @@ def _apply_vector_add(
                     serialize_scalar(data.scalars[i]), flag, data.ttl_ms
                 ),
             )
+            if speedup_keys:
+                # SplitVectorScalarData (vector_index_utils.h, written at
+                # raft_apply_handler.cc:1115): the flagged subset lands in
+                # a narrow CF so covered pre-filter scans skip the wide
+                # one. The narrow CF is a DERIVED view of the wide row, so
+                # every wide write gets a narrow twin — a tombstone when
+                # the upsert dropped all flagged fields, or the previous
+                # narrow version would stay visible and covered filters
+                # would diverge from the wide path.
+                subset = {
+                    k: data.scalars[i][k]
+                    for k in speedup_keys if k in data.scalars[i]
+                }
+                if subset:
+                    batch.put(
+                        CF_VECTOR_SCALAR_SPEEDUP,
+                        ekey,
+                        Codec.package_value(
+                            serialize_scalar(subset), flag, data.ttl_ms
+                        ),
+                    )
+                else:
+                    batch.put(
+                        CF_VECTOR_SCALAR_SPEEDUP, ekey,
+                        Codec.package_value(b"", ValueFlag.DELETE),
+                    )
+        if data.table_values is not None:
+            # table rows are an independent attribute, per entry:
+            # None = leave this vector's row untouched, b"" = clear it,
+            # bytes = replace it
+            tv = data.table_values[i]
+            if tv:
+                batch.put(
+                    CF_VECTOR_TABLE,
+                    ekey,
+                    Codec.package_value(tv, flag, data.ttl_ms),
+                )
+            elif tv is not None:
+                batch.put(
+                    CF_VECTOR_TABLE, ekey,
+                    Codec.package_value(b"", ValueFlag.DELETE),
+                )
     engine.write(batch)
 
     wrapper = region.vector_index_wrapper
@@ -204,6 +251,13 @@ def _apply_vector_delete(
         batch.put(CF_DEFAULT, ekey, Codec.package_value(b"", ValueFlag.DELETE))
         batch.put(
             CF_VECTOR_SCALAR, ekey, Codec.package_value(b"", ValueFlag.DELETE)
+        )
+        batch.put(
+            CF_VECTOR_SCALAR_SPEEDUP, ekey,
+            Codec.package_value(b"", ValueFlag.DELETE),
+        )
+        batch.put(
+            CF_VECTOR_TABLE, ekey, Codec.package_value(b"", ValueFlag.DELETE)
         )
     engine.write(batch)
     wrapper = region.vector_index_wrapper

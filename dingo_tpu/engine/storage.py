@@ -178,6 +178,7 @@ class Storage:
         scalars: Optional[List[Dict[str, Any]]] = None,
         is_update: bool = True,
         ttl_ms: int = 0,
+        table_values: Optional[List[bytes]] = None,
     ) -> int:
         """Storage::VectorAdd (storage.cc:458-482): stamp TSO ts, build write
         payload, hand to the engine (raft propose or mono apply)."""
@@ -198,6 +199,7 @@ class Storage:
             wd.VectorAddData(
                 ts=ts, ids=ids, vectors=vectors, scalars=scalars,
                 is_update=is_update, ttl_ms=ttl_ms,
+                table_values=table_values,
             ),
         )
         return ts

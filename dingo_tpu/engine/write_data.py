@@ -62,6 +62,11 @@ class VectorAddData:
     scalars: Optional[List[Dict[str, Any]]] = None
     is_update: bool = True                # upsert vs add
     ttl_ms: int = 0
+    #: per-vector serial-encoded table row -> vector_table CF (the TABLE
+    #: coprocessor filter's data source, vector_reader.cc:169-232).
+    #: Per entry: None = leave this vector's row untouched, b"" = clear
+    #: it, bytes = replace it.
+    table_values: Optional[List[Optional[bytes]]] = None
 
 
 @dataclasses.dataclass

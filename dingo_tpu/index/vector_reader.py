@@ -4,7 +4,12 @@ Reference: src/vector/vector_reader.{h,cc} (2,429 LoC) — VectorBatchSearch
 (vector_reader.cc:439) -> SearchVector (:104) dispatches on filter mode:
   SCALAR post-filter  — over-fetch topk*10, then compare scalar data (:120-215)
   VECTOR_ID pre-filter — explicit candidate ids (:216-222, impl :830)
-  SCALAR pre-filter   — scan scalar CF for candidates -> id filter (:853)
+  SCALAR pre-filter   — scan scalar CF for candidates -> id filter (:853);
+                        reads the narrow speed-up CF when it covers the
+                        filter's fields (SplitVectorScalarData contract)
+  TABLE filter        — coprocessor over the vector_table CF (:169-232),
+                        pre (scan -> candidate ids) and post (over-fetch
+                        then filter rows) variants
 plus SearchAndRangeSearchWrapper (:1781) choosing index search vs
 BruteForceSearch (:1873: scan region KVs in 2,048-vector batches —
 FLAGS_vector_index_bruteforce_batch_count :61 — build temp flat index,
@@ -24,6 +29,8 @@ from dingo_tpu.coprocessor.scalar_filter import ScalarFilter
 from dingo_tpu.engine.raw_engine import (
     CF_DEFAULT,
     CF_VECTOR_SCALAR,
+    CF_VECTOR_SCALAR_SPEEDUP,
+    CF_VECTOR_TABLE,
     RawEngine,
 )
 from dingo_tpu.index import codec as vcodec
@@ -129,7 +136,30 @@ class VectorReader:
         self.ctx = ctx
         self._data = MvccReader(ctx.engine, CF_DEFAULT)
         self._scalar = MvccReader(ctx.engine, CF_VECTOR_SCALAR)
+        self._speedup = MvccReader(ctx.engine, CF_VECTOR_SCALAR_SPEEDUP)
+        self._table = MvccReader(ctx.engine, CF_VECTOR_TABLE)
         self._binary = is_binary_dim_param(ctx.parameter)
+
+    def _scalar_source(
+        self, scalar_filter: Optional[ScalarFilter]
+    ) -> MvccReader:
+        """The narrow speed-up CF when it covers every field the filter
+        reads (apply writes the flagged subset there —
+        raft_apply_handler.cc:1115 via SplitVectorScalarData); the wide
+        scalar CF otherwise. Match semantics are identical: a vector
+        without any flagged field has no narrow row, and a filter on a
+        missing field never matches."""
+        keys = tuple(
+            getattr(self.ctx.parameter, "scalar_speedup_keys", ()) or ()
+        ) if self.ctx.parameter else ()
+        if (
+            keys
+            and scalar_filter is not None
+            and not scalar_filter.is_empty()
+            and scalar_filter.fields() <= set(keys)
+        ):
+            return self._speedup
+        return self._scalar
 
     def _deser(self, blob: bytes) -> np.ndarray:
         return deserialize_vector(
@@ -146,6 +176,7 @@ class VectorReader:
         filter_type: VectorFilterType = VectorFilterType.QUERY_POST,
         scalar_filter: Optional[ScalarFilter] = None,
         vector_ids: Optional[Sequence[int]] = None,
+        coprocessor=None,
         with_vector_data: bool = False,
         with_scalar_data: bool = False,
         stage_us: Optional[dict] = None,
@@ -188,6 +219,27 @@ class VectorReader:
             t0 = _time.perf_counter_ns()
             results = [
                 self._post_filter_scalar(r, scalar_filter, topk) for r in over
+            ]
+            postfilter_ns = _time.perf_counter_ns() - t0
+        elif filter_mode is VectorFilterMode.TABLE and (
+            filter_type is VectorFilterType.QUERY_PRE
+        ):
+            # coprocessor over the table CF -> candidate ids
+            # (vector_reader.cc:169-232 TABLE dispatch, pre variant)
+            t0 = _time.perf_counter_ns()
+            cand = self._scan_table_candidates(coprocessor)
+            spec = FilterSpec(ranges=base.ranges, include_ids=cand)
+            prefilter_ns = _time.perf_counter_ns() - t0
+            results = self._search_with_fallback(queries, topk, spec, **search_kw)
+        elif filter_mode is VectorFilterMode.TABLE:
+            # post variant: over-fetch then coprocessor-filter each
+            # candidate's table row (same x10 contract as SCALAR post)
+            over = self._search_with_fallback(
+                queries, topk * POST_FILTER_OVERFETCH, base, **search_kw
+            )
+            t0 = _time.perf_counter_ns()
+            results = [
+                self._post_filter_table(r, coprocessor, topk) for r in over
             ]
             postfilter_ns = _time.perf_counter_ns() - t0
         else:
@@ -373,22 +425,70 @@ class VectorReader:
     def _visible_ids(self) -> List[int]:
         return [vid for vid, _ in self._scan_data(*self.ctx.id_window())]
 
-    def _scan_scalar_candidates(
-        self, scalar_filter: Optional[ScalarFilter]
-    ) -> np.ndarray:
+    # shared skeletons for the SCALAR and TABLE filter paths: pre-filter =
+    # scan a CF into a candidate id set, post-filter = keep over-fetched
+    # hits whose CF row matches, stopping at topk
+    def _scan_candidates(self, src: MvccReader, match) -> np.ndarray:
         lo, hi = self.ctx.id_window()
         start = vcodec.encode_vector_key(self.ctx.partition_id, lo)
         end = vcodec.encode_vector_key(self.ctx.partition_id, hi)
         out = []
-        for key, blob in self._scalar.iter_visible(start, end, self.ctx.read_ts):
+        for key, blob in src.iter_visible(start, end, self.ctx.read_ts):
             _, vid, _ = vcodec.decode_vector_key(key)
             if vid is None:
                 continue
-            if scalar_filter is None or scalar_filter.matches(
-                deserialize_scalar(blob)
-            ):
+            if match(blob):
                 out.append(vid)
         return np.asarray(out, np.int64)
+
+    def _post_filter(
+        self, result: SearchResult, topk: int, src: MvccReader, match
+    ) -> SearchResult:
+        keep_ids, keep_d = [], []
+        for vid, dist in zip(result.ids, result.distances):
+            key = vcodec.encode_vector_key(self.ctx.partition_id, int(vid))
+            blob = src.kv_get(key, self.ctx.read_ts)
+            if match(blob):
+                keep_ids.append(vid)
+                keep_d.append(dist)
+                if len(keep_ids) >= topk:
+                    break
+        return SearchResult(
+            np.asarray(keep_ids, np.int64), np.asarray(keep_d, np.float32)
+        )
+
+    def _scan_scalar_candidates(
+        self, scalar_filter: Optional[ScalarFilter]
+    ) -> np.ndarray:
+        src = self._scalar_source(scalar_filter)
+        if scalar_filter is None:
+            return self._scan_candidates(src, lambda blob: True)
+        return self._scan_candidates(
+            src, lambda blob: scalar_filter.matches(deserialize_scalar(blob))
+        )
+
+    def _scan_table_candidates(self, coprocessor) -> np.ndarray:
+        """TABLE pre-filter: run the coprocessor's filter over every table
+        row in the region (vector_reader.cc TABLE dispatch). A vector
+        without a table row is never a candidate — same contract as the
+        scalar pre-filter on a missing field."""
+        if coprocessor is None:
+            raise ValueError("TABLE filter requires a coprocessor")
+        return self._scan_candidates(
+            self._table,
+            lambda blob: coprocessor.filter_row(coprocessor.decode(blob)),
+        )
+
+    def _post_filter_table(
+        self, result: SearchResult, coprocessor, topk: int
+    ) -> SearchResult:
+        if coprocessor is None:
+            raise ValueError("TABLE filter requires a coprocessor")
+        return self._post_filter(
+            result, topk, self._table,
+            lambda blob: blob is not None
+            and coprocessor.filter_row(coprocessor.decode(blob)),
+        )
 
     def _post_filter_scalar(
         self,
@@ -398,18 +498,11 @@ class VectorReader:
     ) -> SearchResult:
         if scalar_filter is None or scalar_filter.is_empty():
             return SearchResult(result.ids[:topk], result.distances[:topk])
-        keep_ids, keep_d = [], []
-        for vid, dist in zip(result.ids, result.distances):
-            key = vcodec.encode_vector_key(self.ctx.partition_id, int(vid))
-            sb = self._scalar.kv_get(key, self.ctx.read_ts)
-            scalar = deserialize_scalar(sb) if sb else {}
-            if scalar_filter.matches(scalar):
-                keep_ids.append(vid)
-                keep_d.append(dist)
-                if len(keep_ids) >= topk:
-                    break
-        return SearchResult(
-            np.asarray(keep_ids, np.int64), np.asarray(keep_d, np.float32)
+        return self._post_filter(
+            result, topk, self._scalar_source(scalar_filter),
+            lambda blob: scalar_filter.matches(
+                deserialize_scalar(blob) if blob else {}
+            ),
         )
 
     def _backfill(

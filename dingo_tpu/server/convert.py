@@ -56,6 +56,7 @@ def index_parameter_to_pb(p: Optional[IndexParameter]) -> pb.VectorIndexParamete
     out.efconstruction = p.efconstruction
     out.nlinks = p.nlinks
     out.host_vectors = p.host_vectors
+    out.scalar_speedup_keys.extend(p.scalar_speedup_keys)
     return out
 
 
@@ -73,6 +74,7 @@ def index_parameter_from_pb(m: pb.VectorIndexParameter) -> Optional[IndexParamet
         efconstruction=m.efconstruction or 200,
         nlinks=m.nlinks or 32,
         host_vectors=m.host_vectors,
+        scalar_speedup_keys=tuple(m.scalar_speedup_keys),
     )
 
 
@@ -140,6 +142,9 @@ def search_kwargs_from_pb(param: pb.VectorSearchParameter) -> dict:
     sf = predicates_from_pb(param.predicates)
     if sf is not None:
         kw["scalar_filter"] = sf
+    cop = coprocessor_from_pb(param.coprocessor)
+    if cop is not None:
+        kw["coprocessor"] = cop
     return kw
 
 

@@ -293,9 +293,16 @@ class IndexService:
                     [list(v.vector.values) for v in req.vectors], np.float32
                 )
             scalars = [convert.scalar_from_pb(v.scalar_data) for v in req.vectors]
+            table_values = None
+            if any(v.HasField("table_data") for v in req.vectors):
+                table_values = [
+                    v.table_data if v.HasField("table_data") else None
+                    for v in req.vectors
+                ]
             ts = self.node.storage.vector_add(
                 region, ids, vectors, scalars,
                 is_update=req.is_update, ttl_ms=req.ttl_ms,
+                table_values=table_values,
             )
         except NotLeader as e:
             return _err(resp, 20001, f"not leader: {e.leader_hint}")

@@ -425,3 +425,50 @@ def test_kv_batch_get_and_delete_range(cluster):
     creq.kv.value = b"x"
     with pytest.raises(ClientError, match="outside region"):
         client._call_leader(d, "StoreService", "KvCompareAndSet", creq)
+
+
+def test_table_filter_over_grpc(cluster):
+    """TABLE coprocessor filter end-to-end over the wire: table rows ride
+    VectorAdd (VectorWithScalar.table_data), the search parameter carries
+    a pb.Coprocessor, and the reader dispatches it (reference
+    vector_reader.cc:169-232)."""
+    from dingo_tpu.coprocessor.coprocessor_v2 import encode_row
+    from dingo_tpu.raft import wire
+
+    client, control, nodes = cluster
+    param = pb.VectorIndexParameter(
+        index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=16,
+        metric_type=pb.METRIC_TYPE_L2,
+    )
+    client.create_index_region(3, 0, 1 << 40, param)
+    time.sleep(1.0)
+
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((120, 16)).astype(np.float32)
+    rows = [["eng" if i % 4 == 0 else "ops", float(i)] for i in range(120)]
+    client.vector_add(3, list(range(120)), x,
+                      table_values=[encode_row(r) for r in rows])
+
+    cop = pb.Coprocessor()
+    for i, (name, t) in enumerate((("dept", "VARCHAR"), ("rank", "DOUBLE"))):
+        col = cop.original_schema.add()
+        col.name, col.sql_type, col.index = name, t, i
+    cop.filter_expr = wire.encode(
+        ["eq", ["field", "dept"], ["const", "eng"]])
+
+    res = client.vector_search(
+        3, x[:4], topk=8, filter=pb.TABLE_FILTER,
+        filter_type=pb.QUERY_PRE, coprocessor=cop,
+    )
+    for row in res:
+        assert row, "TABLE pre-filter returned nothing over grpc"
+        assert all(vid % 4 == 0 for vid, _ in row), row
+    assert res[0][0][0] == 0   # query 0 is vector 0 (dept=eng)
+
+    # post variant
+    res_post = client.vector_search(
+        3, x[4:6], topk=5, filter=pb.TABLE_FILTER,
+        filter_type=pb.QUERY_POST, coprocessor=cop,
+    )
+    for row in res_post:
+        assert all(vid % 4 == 0 for vid, _ in row), row

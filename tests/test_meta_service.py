@@ -94,11 +94,19 @@ def test_create_vector_table_end_to_end(cluster):
 
 
 def test_drop_table_drops_regions(cluster):
+    import time as _t
+
     client, control, meta, nodes = cluster
     table = client.get_table("dingo", "emb")
     rids = [p.region_id for p in table.partitions]
     client.drop_table("dingo", "emb")
     assert client.get_table("dingo", "emb") is None
+    # region teardown can lag the RPC under suite load — bounded wait
+    deadline = _t.monotonic() + 5.0
+    while _t.monotonic() < deadline and any(
+        rid in control.regions for rid in rids
+    ):
+        _t.sleep(0.05)
     for rid in rids:
         assert rid not in control.regions
 

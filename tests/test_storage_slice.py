@@ -266,3 +266,275 @@ def test_meta_manager_recovery(tmp_path):
     r = mm2.get_region(77)
     assert r is not None and r.definition.partition_id == 1
     raw2.close()
+
+
+class _CfSpyEngine:
+    """RawEngine proxy recording which CFs reads touch."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.read_cfs = []
+
+    def get(self, cf, key):
+        self.read_cfs.append(cf)
+        return self._inner.get(cf, key)
+
+    def scan(self, cf, start=b"", end=None):
+        self.read_cfs.append(cf)
+        return self._inner.scan(cf, start, end)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_scalar_speedup_cf_pre_filter():
+    """Scalar speed-up CF end-to-end (raft_apply_handler.cc:1115 via
+    SplitVectorScalarData + constant.h kVectorScalarKeySpeedUpCF): with
+    scalar_speedup_keys flagged, apply writes the flagged subset to the
+    narrow CF, a covered pre-filter search reads ONLY the narrow CF, and
+    results are identical to the wide-CF path."""
+    from dingo_tpu.engine.raw_engine import (
+        CF_VECTOR_SCALAR,
+        CF_VECTOR_SCALAR_SPEEDUP,
+    )
+    from dingo_tpu.index.vector_reader import deserialize_scalar
+
+    def build(speedup_keys):
+        raw = MemEngine()
+        engine = MonoStoreEngine(raw)
+        storage = Storage(engine)
+        definition = RegionDefinition(
+            region_id=88,
+            start_key=vcodec.encode_vector_key(1, 0),
+            end_key=vcodec.encode_vector_key(1, 1 << 40),
+            partition_id=1,
+            region_type=RegionType.INDEX,
+            index_parameter=IndexParameter(
+                index_type=IndexType.FLAT, dimension=DIM,
+                scalar_speedup_keys=speedup_keys,
+            ),
+        )
+        region = Region(definition)
+        w = region.vector_index_wrapper
+        w.build_own()
+        w.set_own(w.own_index)
+        x = rand(200, seed=7)
+        ids = np.arange(200, dtype=np.int64)
+        # WIDE scalars: many fields, only "color" is flagged
+        scalars = [
+            {"color": "red" if i % 5 == 0 else "blue", "size": i,
+             "shape": "s" + str(i % 7), "w0": i * 2, "w1": i * 3,
+             "w2": "x" * 50}
+            for i in range(200)
+        ]
+        storage.vector_add(region, ids, x, scalars)
+        return raw, engine, storage, region, x
+
+    # flagged region: narrow CF holds ONLY the flagged subset
+    raw, engine, storage, region, x = build(("color",))
+    narrow_rows = list(raw.scan(CF_VECTOR_SCALAR_SPEEDUP, b"", None))
+    assert len(narrow_rows) == 200
+    from dingo_tpu.mvcc.codec import Codec as _C
+
+    _flag, payload, _ttl = _C.unpackage_value(narrow_rows[0][1])
+    assert set(deserialize_scalar(payload)) == {"color"}
+
+    # covered pre-filter search reads only the narrow CF
+    spy = _CfSpyEngine(raw)
+    reader = engine.new_vector_reader(region)
+    reader.ctx = dataclasses_replace_engine(reader.ctx, spy)
+    reader._scalar.engine = spy
+    reader._speedup.engine = spy
+    reader._data.engine = spy
+    res_narrow = reader.vector_batch_search(
+        x[:8], 10, filter_mode=VectorFilterMode.SCALAR,
+        filter_type=VectorFilterType.QUERY_PRE,
+        scalar_filter=ScalarFilter.equals({"color": "red"}),
+    )
+    assert CF_VECTOR_SCALAR_SPEEDUP in spy.read_cfs
+    assert CF_VECTOR_SCALAR not in spy.read_cfs, (
+        "covered pre-filter touched the wide scalar CF")
+
+    # identical results to a region WITHOUT the speed-up CF
+    raw2, engine2, storage2, region2, x2 = build(())
+    res_wide = storage2.vector_batch_search(
+        region2, x2[:8], 10, filter_mode=VectorFilterMode.SCALAR,
+        filter_type=VectorFilterType.QUERY_PRE,
+        scalar_filter=ScalarFilter.equals({"color": "red"}),
+    )
+    for a, b in zip(res_narrow, res_wide):
+        assert [v.id for v in a] == [v.id for v in b]
+
+    # an UNCOVERED filter (field not flagged) falls back to the wide CF
+    spy.read_cfs.clear()
+    reader.vector_batch_search(
+        x[:2], 5, filter_mode=VectorFilterMode.SCALAR,
+        filter_type=VectorFilterType.QUERY_PRE,
+        scalar_filter=ScalarFilter.equals({"size": 5}),
+    )
+    assert CF_VECTOR_SCALAR in spy.read_cfs
+
+    # deletes tombstone the narrow CF too
+    storage.vector_delete(region, [0, 5])
+    reader2 = engine.new_vector_reader(region)
+    res_after = reader2.vector_batch_search(
+        x[:1], 5, filter_mode=VectorFilterMode.SCALAR,
+        filter_type=VectorFilterType.QUERY_PRE,
+        scalar_filter=ScalarFilter.equals({"color": "red"}),
+    )
+    got_after = [v.id for v in res_after[0]]
+    assert 0 not in got_after and 5 not in got_after
+
+
+def dataclasses_replace_engine(ctx, engine):
+    import dataclasses as _dc
+
+    return _dc.replace(ctx, engine=engine)
+
+
+def test_table_coprocessor_filter_pre_and_post():
+    """VECTOR_FILTER=TABLE (vector_reader.cc:169-232): table rows ride
+    VectorAdd into the vector_table CF; search dispatches the coprocessor
+    filter over them — pre variant scans the table CF into a candidate id
+    set, post variant over-fetches x10 then filters candidates' rows."""
+    from dingo_tpu.coprocessor.coprocessor_v2 import (
+        CoprocessorDef,
+        CoprocessorV2,
+        SchemaColumn,
+        encode_row,
+    )
+
+    raw = MemEngine()
+    engine = MonoStoreEngine(raw)
+    storage = Storage(engine)
+    region = make_region(region_id=99)
+    x = rand(200, seed=3)
+    ids = np.arange(200, dtype=np.int64)
+    schema = [
+        SchemaColumn("dept", "VARCHAR", 0),
+        SchemaColumn("salary", "DOUBLE", 1),
+    ]
+    rows = [
+        ["eng" if i % 3 == 0 else "ops", float(50 + i)] for i in range(200)
+    ]
+    storage.vector_add(region, ids, x,
+                       table_values=[encode_row(r) for r in rows])
+
+    cop = CoprocessorV2(CoprocessorDef(
+        original_schema=schema,
+        filter_expr=["and", ["eq", ["field", "dept"], ["const", "eng"]],
+                     ["ge", ["field", "salary"], ["const", 100.0]]],
+    ))
+    want = {i for i in range(200) if i % 3 == 0 and 50 + i >= 100}
+
+    reader = engine.new_vector_reader(region)
+    res_pre = reader.vector_batch_search(
+        x[:4], 20, filter_mode=VectorFilterMode.TABLE,
+        filter_type=VectorFilterType.QUERY_PRE, coprocessor=cop,
+    )
+    for qi, row in enumerate(res_pre):
+        assert row, "pre-filter returned nothing"
+        assert all(v.id in want for v in row), [v.id for v in row]
+
+    res_post = reader.vector_batch_search(
+        x[60:62], 5, filter_mode=VectorFilterMode.TABLE,
+        filter_type=VectorFilterType.QUERY_POST, coprocessor=cop,
+    )
+    for row in res_post:
+        assert all(v.id in want for v in row)
+    # query 60: 60 % 3 == 0 and salary 110 -> its own id must lead
+    assert res_post[0][0].id == 60
+
+    # missing coprocessor is a hard error, not a silent no-filter
+    with pytest.raises(ValueError):
+        reader.vector_batch_search(
+            x[:1], 5, filter_mode=VectorFilterMode.TABLE,
+            filter_type=VectorFilterType.QUERY_PRE,
+        )
+
+    # deletes tombstone the table CF: deleted ids drop out of pre-filter
+    storage.vector_delete(region, [60])
+    reader2 = engine.new_vector_reader(region)
+    res2 = reader2.vector_batch_search(
+        x[60:61], 10, filter_mode=VectorFilterMode.TABLE,
+        filter_type=VectorFilterType.QUERY_PRE, coprocessor=cop,
+    )
+    assert all(v.id != 60 for v in res2[0])
+
+
+def test_speedup_cf_upsert_drops_flagged_field():
+    """Regression: an upsert that drops every flagged field must tombstone
+    the narrow CF — otherwise the stale narrow row stays visible and a
+    covered filter diverges from the wide path."""
+    raw = MemEngine()
+    engine = MonoStoreEngine(raw)
+    storage = Storage(engine)
+    definition = RegionDefinition(
+        region_id=91,
+        start_key=vcodec.encode_vector_key(1, 0),
+        end_key=vcodec.encode_vector_key(1, 1 << 40),
+        partition_id=1,
+        region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(
+            index_type=IndexType.FLAT, dimension=DIM,
+            scalar_speedup_keys=("color",),
+        ),
+    )
+    region = Region(definition)
+    w = region.vector_index_wrapper
+    w.build_own()
+    w.set_own(w.own_index)
+    x = rand(4, seed=9)
+    ids = np.arange(4, dtype=np.int64)
+    storage.vector_add(region, ids, x,
+                       [{"color": "red", "n": int(i)} for i in ids])
+    # upsert id 0 WITHOUT the flagged field
+    storage.vector_add(region, ids[:1], x[:1], [{"n": 100}])
+    reader = engine.new_vector_reader(region)
+    res = reader.vector_batch_search(
+        x[:1], 4, filter_mode=VectorFilterMode.SCALAR,
+        filter_type=VectorFilterType.QUERY_PRE,
+        scalar_filter=ScalarFilter.equals({"color": "red"}),
+    )
+    got = [v.id for v in res[0]]
+    assert 0 not in got, (
+        "stale narrow-CF row survived an upsert that dropped the field")
+    assert set(got) == {1, 2, 3}
+
+
+def test_table_row_clear_with_empty_bytes():
+    """Per-entry table semantics: None leaves the row, b'' clears it."""
+    from dingo_tpu.coprocessor.coprocessor_v2 import (
+        CoprocessorDef,
+        CoprocessorV2,
+        SchemaColumn,
+        encode_row,
+    )
+
+    raw = MemEngine()
+    engine = MonoStoreEngine(raw)
+    storage = Storage(engine)
+    region = make_region(region_id=92)
+    x = rand(3, seed=4)
+    ids = np.arange(3, dtype=np.int64)
+    storage.vector_add(
+        region, ids, x,
+        table_values=[encode_row(["eng"]) for _ in range(3)])
+    cop = CoprocessorV2(CoprocessorDef(
+        original_schema=[SchemaColumn("dept", "VARCHAR", 0)],
+        filter_expr=["eq", ["field", "dept"], ["const", "eng"]],
+    ))
+    reader = engine.new_vector_reader(region)
+    res = reader.vector_batch_search(
+        x[:1], 3, filter_mode=VectorFilterMode.TABLE,
+        filter_type=VectorFilterType.QUERY_PRE, coprocessor=cop)
+    assert {v.id for v in res[0]} == {0, 1, 2}
+
+    # upsert id 1 clearing its table row, id 2 untouched (None)
+    storage.vector_add(region, ids[1:3], x[1:3],
+                       table_values=[b"", None])
+    reader = engine.new_vector_reader(region)
+    res = reader.vector_batch_search(
+        x[:1], 3, filter_mode=VectorFilterMode.TABLE,
+        filter_type=VectorFilterType.QUERY_PRE, coprocessor=cop)
+    assert {v.id for v in res[0]} == {0, 2}
