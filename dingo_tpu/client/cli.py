@@ -56,6 +56,16 @@ def build_parser() -> argparse.ArgumentParser:
     create.add_argument("--id-hi", type=int, default=1 << 40)
     create.add_argument("--type", choices=sorted(_ITYPES), default="flat")
     create.add_argument("--dim", type=int, required=True)
+    merge = region.add_parser("merge")
+    merge.add_argument("--target", type=int, required=True)
+    merge.add_argument("--source", type=int, required=True)
+    cpeers = region.add_parser("change-peers")
+    cpeers.add_argument("--region", type=int, required=True)
+    cpeers.add_argument("--peers", required=True,
+                        help="comma-separated store ids")
+    tleader = region.add_parser("transfer-leader")
+    tleader.add_argument("--region", type=int, required=True)
+    tleader.add_argument("--store", required=True)
     split = region.add_parser("split")
     split.add_argument("--region", type=int, required=True)
     split.add_argument("--at", type=int, required=True)
@@ -209,6 +219,16 @@ def run_command(client: DingoClient, args) -> int:
     elif g == "region" and c == "split":
         child = client.split_region(args.region, args.at, args.partition)
         print(json.dumps({"child_region_id": child}))
+    elif g == "region" and c == "merge":
+        client.merge_region(args.target, args.source)
+        print(json.dumps({"merged_into": args.target}))
+    elif g == "region" and c == "change-peers":
+        peers = [p.strip() for p in args.peers.split(",") if p.strip()]
+        client.change_peer_region(args.region, peers)
+        print(json.dumps({"region": args.region, "peers": peers}))
+    elif g == "region" and c == "transfer-leader":
+        client.transfer_leader_region(args.region, args.store)
+        print(json.dumps({"region": args.region, "leader": args.store}))
     elif g == "vector" and c == "add-random":
         rng = np.random.default_rng(0)
         x = rng.standard_normal((args.count, args.dim)).astype(np.float32)

@@ -130,6 +130,78 @@ class DingoClient:
             raise ClientError(resp.error.errmsg)
         return resp.child_region_id
 
+    def merge_region(self, target_region_id: int,
+                     source_region_id: int) -> None:
+        """Operator region op: target absorbs the adjacent source."""
+        resp = self.coordinator.MergeRegion(pb.MergeRegionRequest(
+            target_region_id=target_region_id,
+            source_region_id=source_region_id,
+        ))
+        if resp.error.errcode:
+            raise ClientError(resp.error.errmsg)
+
+    def change_peer_region(self, region_id: int,
+                           new_peers: Sequence[str]) -> None:
+        """Operator region op: replace the region's peer set."""
+        req = pb.ChangePeerRegionRequest(region_id=region_id)
+        req.new_peers.extend(new_peers)
+        resp = self.coordinator.ChangePeerRegion(req)
+        if resp.error.errcode:
+            raise ClientError(resp.error.errmsg)
+
+    def transfer_leader_region(self, region_id: int,
+                               target_store: str) -> None:
+        """Operator region op: hand region leadership to target_store."""
+        resp = self.coordinator.TransferLeaderRegion(
+            pb.TransferLeaderRegionRequest(
+                region_id=region_id, target_store=target_store,
+            ))
+        if resp.error.errcode:
+            raise ClientError(resp.error.errmsg)
+
+    def vector_import(self, partition_id: int,
+                      ids: Optional[Sequence[int]] = None,
+                      vectors: Optional[np.ndarray] = None,
+                      scalars: Optional[List[Dict[str, Any]]] = None,
+                      delete_ids: Optional[Sequence[int]] = None,
+                      ttl_ms: int = 0) -> dict:
+        """Bulk import (VectorImport RPC): upserts and/or deletes routed
+        per owning region. Returns {"added": n, "deleted": n}."""
+        if ids is not None and vectors is None:
+            raise ClientError("vector_import: ids given without vectors")
+        regions = self._regions_for_vector_ids(partition_id)
+        added = deleted = 0
+        groups: Dict[int, dict] = {}
+        for i, vid in enumerate(ids if ids is not None else []):
+            d = self._region_for_id(partition_id, int(vid), regions)
+            groups.setdefault(d.region_id, {"add": [], "del": []})[
+                "add"].append(i)
+        for vid in (delete_ids if delete_ids is not None else []):
+            d = self._region_for_id(partition_id, int(vid), regions)
+            groups.setdefault(d.region_id, {"add": [], "del": []})[
+                "del"].append(int(vid))
+        by_region = {d.region_id: d for d in self._regions}
+        for rid, g in groups.items():
+            req = pb.VectorImportRequest()
+            req.context.region_id = rid
+            for i in g["add"]:
+                v = req.vectors.add()
+                v.vector.id = int(ids[i])
+                v.vector.values.extend(
+                    np.asarray(vectors[i], np.float32).tolist())
+                if scalars is not None:
+                    for k, val in scalars[i].items():
+                        e = v.scalar_data.add()
+                        e.key = k
+                        e.value = wire.encode_obj(val)
+            req.delete_ids.extend(g["del"])
+            req.ttl_ms = ttl_ms
+            resp = self._call_leader(
+                by_region[rid], "IndexService", "VectorImport", req)
+            added += resp.added
+            deleted += resp.deleted
+        return {"added": added, "deleted": deleted}
+
     # ---------------- table meta API (reference Java SDK table ops) -------
     def create_schema(self, name: str) -> None:
         resp = self.meta.CreateSchema(pb.CreateSchemaRequest(schema_name=name))

@@ -457,6 +457,17 @@ class CoordinatorControl:
 
     def transfer_leader(self, region_id: int, target_store: str) -> None:
         with self._lock:
+            definition = self.regions.get(region_id)
+            if definition is None:
+                raise KeyError(f"region {region_id}")
+            if target_store not in definition.peers:
+                # the raft core silently refuses a non-peer target
+                # (core.py transfer_leadership) — fail the RPC instead of
+                # letting the operator believe leadership moved
+                raise ValueError(
+                    f"{target_store!r} is not a peer of region {region_id} "
+                    f"(peers: {definition.peers})"
+                )
             leader = self.region_leaders.get(region_id)
             if leader is None:
                 raise KeyError(f"no leader known for region {region_id}")
@@ -472,6 +483,12 @@ class CoordinatorControl:
             definition = self.regions.get(region_id)
             if definition is None:
                 raise KeyError(f"region {region_id}")
+            unknown = [p for p in new_peers if p not in self.stores]
+            if unknown:
+                # a typo'd store id would persist into the definition and
+                # queue a CREATE no store ever drains — reject up front
+                # (balancer call sites always pass registered stores)
+                raise ValueError(f"unknown stores in peer set: {unknown}")
             old = set(definition.peers)
             new = set(new_peers)
             definition.peers = list(new_peers)

@@ -34,6 +34,7 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
             pb.VectorSearchDebugRequest, pb.VectorSearchDebugResponse,
         ),
         "VectorAdd": (pb.VectorAddRequest, pb.VectorAddResponse),
+        "VectorImport": (pb.VectorImportRequest, pb.VectorImportResponse),
         "VectorDelete": (pb.VectorDeleteRequest, pb.VectorDeleteResponse),
         "VectorBatchQuery": (pb.VectorBatchQueryRequest, pb.VectorBatchQueryResponse),
         "VectorGetBorderId": (pb.VectorGetBorderIdRequest, pb.VectorGetBorderIdResponse),
@@ -146,6 +147,13 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "StoreHeartbeat": (pb.StoreHeartbeatRequest, pb.StoreHeartbeatResponse),
         "CreateRegion": (pb.CreateRegionRequest, pb.CreateRegionResponse),
         "SplitRegion": (pb.SplitRegionRequest, pb.SplitRegionResponse),
+        "MergeRegion": (pb.MergeRegionRequest, pb.MergeRegionResponse),
+        "ChangePeerRegion": (
+            pb.ChangePeerRegionRequest, pb.ChangePeerRegionResponse,
+        ),
+        "TransferLeaderRegion": (
+            pb.TransferLeaderRegionRequest, pb.TransferLeaderRegionResponse,
+        ),
         "GetRegionMap": (pb.GetRegionMapRequest, pb.GetRegionMapResponse),
         "Tso": (pb.TsoRequest, pb.TsoResponse),
         "TsoAdvance": (pb.TsoAdvanceRequest, pb.TsoAdvanceResponse),

@@ -276,29 +276,38 @@ class IndexService:
             setattr(resp, field, stage_us.get(field, 0))
         return resp
 
+    @staticmethod
+    def _vector_batch_from_pb(region, req_vectors):
+        """Decode a repeated VectorWithScalar into the storage call shape:
+        (ids, vectors, scalars, table_values) — shared by VectorAdd and
+        VectorImport so the two RPCs cannot diverge."""
+        ids = np.asarray([v.vector.id for v in req_vectors], np.int64)
+        if convert.is_binary_parameter(region.definition.index_parameter):
+            vectors = np.stack([
+                np.frombuffer(v.vector.binary_values, np.uint8)
+                for v in req_vectors
+            ])
+        else:
+            vectors = np.asarray(
+                [list(v.vector.values) for v in req_vectors], np.float32
+            )
+        scalars = [convert.scalar_from_pb(v.scalar_data) for v in req_vectors]
+        table_values = None
+        if any(v.HasField("table_data") for v in req_vectors):
+            table_values = [
+                v.table_data if v.HasField("table_data") else None
+                for v in req_vectors
+            ]
+        return ids, vectors, scalars, table_values
+
     def VectorAdd(self, req: pb.VectorAddRequest) -> pb.VectorAddResponse:
         resp = pb.VectorAddResponse()
         region = _region_or_err(self.node, req.context, resp)
         if region is None:
             return resp
         try:
-            ids = np.asarray([v.vector.id for v in req.vectors], np.int64)
-            if convert.is_binary_parameter(region.definition.index_parameter):
-                vectors = np.stack([
-                    np.frombuffer(v.vector.binary_values, np.uint8)
-                    for v in req.vectors
-                ])
-            else:
-                vectors = np.asarray(
-                    [list(v.vector.values) for v in req.vectors], np.float32
-                )
-            scalars = [convert.scalar_from_pb(v.scalar_data) for v in req.vectors]
-            table_values = None
-            if any(v.HasField("table_data") for v in req.vectors):
-                table_values = [
-                    v.table_data if v.HasField("table_data") else None
-                    for v in req.vectors
-                ]
+            ids, vectors, scalars, table_values = self._vector_batch_from_pb(
+                region, req.vectors)
             ts = self.node.storage.vector_add(
                 region, ids, vectors, scalars,
                 is_update=req.is_update, ttl_ms=req.ttl_ms,
@@ -311,6 +320,37 @@ class IndexService:
         resp.ts = ts
         resp.key_states.extend([True] * len(req.vectors))
         METRICS.counter("vector_add", region.id).add(len(req.vectors))
+        return resp
+
+    def VectorImport(self, req: pb.VectorImportRequest):
+        """Bulk import (index_service.h:57 VectorImport): upserts + deletes
+        in one call, sharing VectorAdd's validation and write path."""
+        resp = pb.VectorImportResponse()
+        region = _region_or_err(self.node, req.context, resp)
+        if region is None:
+            return resp
+        try:
+            ts = 0
+            if req.vectors:
+                ids, vectors, scalars, table_values = (
+                    self._vector_batch_from_pb(region, req.vectors))
+                ts = self.node.storage.vector_add(
+                    region, ids, vectors, scalars,
+                    is_update=True, ttl_ms=req.ttl_ms,
+                    table_values=table_values,
+                )
+                resp.added = len(req.vectors)
+            if req.delete_ids:
+                ts = self.node.storage.vector_delete(
+                    region, list(req.delete_ids))
+                resp.deleted = len(req.delete_ids)
+        except NotLeader as e:
+            return _err(resp, 20001, f"not leader: {e.leader_hint}")
+        except (VectorIndexError, ValueError) as e:
+            return _err(resp, 30001, str(e))
+        resp.ts = ts
+        METRICS.counter("vector_import", region.id).add(
+            len(req.vectors) + len(req.delete_ids))
         return resp
 
     def VectorDelete(self, req: pb.VectorDeleteRequest) -> pb.VectorDeleteResponse:
@@ -1306,6 +1346,38 @@ class CoordinatorService:
             resp.child_region_id = self.control.split_region(
                 req.region_id, req.split_key
             )
+        except (KeyError, ValueError) as e:
+            return _err(resp, 60002, str(e))
+        return resp
+
+    def MergeRegion(self, req: pb.MergeRegionRequest):
+        """Operator region op (coordinator_service.cc MergeRegion): queue
+        MERGE to the target's leader; adjacency/co-location validated."""
+        resp = pb.MergeRegionResponse()
+        try:
+            self.control.merge_region(
+                req.target_region_id, req.source_region_id)
+        except (KeyError, ValueError) as e:
+            return _err(resp, 60002, str(e))
+        return resp
+
+    def ChangePeerRegion(self, req: pb.ChangePeerRegionRequest):
+        """Operator region op: replace the region's peer set (additions
+        get CREATE, survivors CHANGE_PEER, removals DELETE)."""
+        resp = pb.ChangePeerRegionResponse()
+        if not req.new_peers:
+            return _err(resp, 60002, "empty peer set")
+        try:
+            self.control.change_peer(req.region_id, list(req.new_peers))
+        except (KeyError, ValueError) as e:
+            return _err(resp, 60002, str(e))
+        return resp
+
+    def TransferLeaderRegion(self, req: pb.TransferLeaderRegionRequest):
+        """Operator region op: ask the current leader to hand off."""
+        resp = pb.TransferLeaderRegionResponse()
+        try:
+            self.control.transfer_leader(req.region_id, req.target_store)
         except (KeyError, ValueError) as e:
             return _err(resp, 60002, str(e))
         return resp

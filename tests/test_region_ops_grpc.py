@@ -1,0 +1,236 @@
+"""Operator region-op RPCs (MergeRegion / ChangePeerRegion /
+TransferLeaderRegion) + VectorImport, driven over gRPC and the CLI on a
+live 3-store cluster (reference: src/server/coordinator_service.cc region
+ops; index_service.h:57 VectorImport)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from dingo_tpu.client.client import DingoClient
+from dingo_tpu.coordinator.control import CoordinatorControl
+from dingo_tpu.coordinator.kv_control import KvControl
+from dingo_tpu.coordinator.tso import TsoControl
+from dingo_tpu.engine.raw_engine import MemEngine
+from dingo_tpu.raft import LocalTransport
+from dingo_tpu.server import pb
+from dingo_tpu.server.rpc import DingoServer
+from dingo_tpu.store.node import StoreNode
+
+
+@pytest.fixture()
+def cluster():
+    transport = LocalTransport()
+    me = MemEngine()
+    control = CoordinatorControl(me, replication=3)
+    coord_server = DingoServer()
+    coord_server.host_coordinator_role(control, TsoControl(me), KvControl(me))
+    coord_port = coord_server.start()
+
+    nodes, servers, addrs = {}, [], {}
+    for i, sid in enumerate(["s0", "s1", "s2"]):
+        node = StoreNode(sid, transport, control, raft_kw={"seed": i})
+        server = DingoServer()
+        server.host_store_role(node)
+        port = server.start()
+        node.start_heartbeat(0.1)
+        nodes[sid] = node
+        servers.append(server)
+        addrs[sid] = f"127.0.0.1:{port}"
+
+    client = DingoClient(f"127.0.0.1:{coord_port}", addrs)
+    yield client, control, nodes, addrs, coord_port
+    client.close()
+    for s in servers:
+        s.stop()
+    coord_server.stop()
+    for n in nodes.values():
+        n.stop()
+
+
+def _cli_base(client, addrs, coord_port):
+    base = ["--coordinator", f"127.0.0.1:{coord_port}"]
+    for sid, addr in addrs.items():
+        base += ["--store", f"{sid}={addr}"]
+    return base
+
+
+def _region_leader(nodes, rid, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for sid, n in nodes.items():
+            raft = n.engine.get_node(rid)
+            if raft is not None and raft.is_leader():
+                return sid
+        time.sleep(0.05)
+    raise AssertionError(f"no leader for region {rid}")
+
+
+def test_cli_split_merge_roundtrip(cluster, capsys):
+    """CLI: split an index region, then merge the child back — data
+    survives, the region map returns to one region."""
+    from dingo_tpu.client.cli import main
+
+    client, control, nodes, addrs, coord_port = cluster
+    base = _cli_base(client, addrs, coord_port)
+
+    param = pb.VectorIndexParameter(
+        index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=8,
+        metric_type=pb.METRIC_TYPE_L2,
+    )
+    client.create_index_region(0, 0, 1 << 40, param)
+    time.sleep(1.0)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((120, 8)).astype(np.float32)
+    client.vector_add(0, list(range(120)), x)
+
+    client.refresh_region_map()
+    parent = next(d for d in client._regions
+                  if d.index_parameter is not None)
+    assert main(base + ["region", "split", "--region",
+                        str(parent.region_id), "--at", "60"]) == 0
+    child_id = json.loads(capsys.readouterr().out)["child_region_id"]
+    time.sleep(1.5)   # split applies + child elects
+    assert client.vector_count(0) == 120
+
+    # CLI merge: parent absorbs the child back
+    assert main(base + ["region", "merge", "--target",
+                        str(parent.region_id), "--source",
+                        str(child_id)]) == 0
+    capsys.readouterr()
+    deadline = time.monotonic() + 8.0
+    while time.monotonic() < deadline:
+        client.refresh_region_map()
+        live = [d for d in client._regions if d.index_parameter is not None]
+        if len(live) == 1 and live[0].region_id == parent.region_id:
+            break
+        time.sleep(0.1)
+    client.refresh_region_map()
+    live = [d for d in client._regions if d.index_parameter is not None]
+    assert len(live) == 1 and live[0].region_id == parent.region_id
+    # all 120 vectors searchable through the merged region
+    assert client.vector_count(0) == 120
+    res = client.vector_search(0, x[[10, 90]], topk=3)
+    assert res[0][0][0] == 10
+    assert res[1][0][0] == 90
+
+
+def test_cli_transfer_leader(cluster, capsys):
+    """CLI: move a region's raft leadership to a chosen store."""
+    from dingo_tpu.client.cli import main
+
+    client, control, nodes, addrs, coord_port = cluster
+    base = _cli_base(client, addrs, coord_port)
+    param = pb.VectorIndexParameter(
+        index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=8,
+        metric_type=pb.METRIC_TYPE_L2,
+    )
+    d = client.create_index_region(1, 0, 1 << 40, param)
+    time.sleep(1.2)
+    rid = d.region_id
+    leader = _region_leader(nodes, rid)
+    target = next(s for s in nodes if s != leader)
+
+    assert main(base + ["region", "transfer-leader", "--region",
+                        str(rid), "--store", target]) == 0
+    capsys.readouterr()
+    deadline = time.monotonic() + 8.0
+    while time.monotonic() < deadline:
+        if _region_leader(nodes, rid) == target:
+            break
+        time.sleep(0.1)
+    assert _region_leader(nodes, rid) == target
+
+
+def test_change_peer_region(cluster):
+    """ChangePeerRegion with replication=2: move a replica to the spare
+    store; the new peer catches up and serves the data."""
+    client, control, nodes, addrs, coord_port = cluster
+    param = pb.VectorIndexParameter(
+        index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=8,
+        metric_type=pb.METRIC_TYPE_L2,
+    )
+    d = client.create_index_region(2, 0, 1 << 40, param, replication=2)
+    time.sleep(1.2)
+    rid = d.region_id
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((40, 8)).astype(np.float32)
+    client.vector_add(2, list(range(40)), x)
+
+    old_peers = set(d.peers)
+    spare = next(s for s in nodes if s not in old_peers)
+    victim = sorted(old_peers)[0]
+    new_peers = sorted((old_peers - {victim}) | {spare})
+    client.change_peer_region(rid, new_peers)
+
+    deadline = time.monotonic() + 10.0
+    ok = False
+    while time.monotonic() < deadline and not ok:
+        n = nodes[spare]
+        raft = n.engine.get_node(rid)
+        reg = n.engine._regions.get(rid) if raft is not None else None
+        if reg is not None:
+            from dingo_tpu.engine.storage import Storage
+
+            try:
+                if Storage(n.engine).vector_count(reg) == 40:
+                    ok = True
+                    break
+            except Exception:
+                pass
+        time.sleep(0.2)
+    assert ok, f"spare store {spare} never caught up"
+    client.refresh_region_map()
+    d2 = next(r for r in client._regions if r.region_id == rid)
+    assert set(d2.peers) == set(new_peers)
+
+
+def test_vector_import_bulk(cluster):
+    """VectorImport: bulk upserts + deletes in one RPC."""
+    client, control, nodes, addrs, coord_port = cluster
+    param = pb.VectorIndexParameter(
+        index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=8,
+        metric_type=pb.METRIC_TYPE_L2,
+    )
+    client.create_index_region(3, 0, 1 << 40, param)
+    time.sleep(1.0)
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((100, 8)).astype(np.float32)
+    out = client.vector_import(
+        3, ids=list(range(100)), vectors=x,
+        scalars=[{"i": i} for i in range(100)])
+    assert out == {"added": 100, "deleted": 0}
+    assert client.vector_count(3) == 100
+
+    out = client.vector_import(3, delete_ids=[0, 1, 2])
+    assert out["deleted"] == 3
+    assert client.vector_count(3) == 97
+
+    # import = upsert: re-import id 5 with a new vector
+    x5 = rng.standard_normal((1, 8)).astype(np.float32)
+    client.vector_import(3, ids=[5], vectors=x5)
+    res = client.vector_search(3, x5, topk=1)
+    assert res[0][0][0] == 5
+
+
+def test_region_op_validation(cluster):
+    """Operator typos fail loudly: unknown store in change-peers, non-peer
+    target in transfer-leader."""
+    from dingo_tpu.client.client import ClientError
+
+    client, control, nodes, addrs, coord_port = cluster
+    param = pb.VectorIndexParameter(
+        index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=8,
+        metric_type=pb.METRIC_TYPE_L2,
+    )
+    d = client.create_index_region(4, 0, 1 << 40, param, replication=2)
+    time.sleep(1.0)
+    with pytest.raises(ClientError, match="unknown stores"):
+        client.change_peer_region(d.region_id, ["s0", "stroe2"])
+    non_peer = next(s for s in nodes if s not in d.peers)
+    with pytest.raises(ClientError, match="not a peer"):
+        client.transfer_leader_region(d.region_id, non_peer)
+    with pytest.raises(ClientError, match="not a peer"):
+        client.transfer_leader_region(d.region_id, "ghost")
