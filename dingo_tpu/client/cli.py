@@ -38,7 +38,9 @@ _ITYPES = {
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dingo-cli")
-    p.add_argument("--coordinator", default="127.0.0.1:20001")
+    p.add_argument("--coordinator", default="127.0.0.1:20001",
+                   help="coordinator endpoint, or comma-separated list of "
+                        "the replicated group (client rotates on failover)")
     p.add_argument("--store", action="append", default=[],
                    help="store_id=host:port (repeatable)")
     sub = p.add_subparsers(dest="group")
@@ -351,9 +353,7 @@ def run_command(client: DingoClient, args) -> int:
         client.drop_table(args.schema, args.name)
         print("OK")
     elif g == "cluster" and c == "stat":
-        from dingo_tpu.server.rpc import ServiceStub
-
-        stub = ServiceStub(client._coord_channel, "ClusterStatService")
+        stub = client.coordinator_service("ClusterStatService")
         r = stub.GetClusterStat(pb.GetClusterStatRequest())
         print(json.dumps({
             "stores": r.store_count, "alive": r.alive_store_count,
@@ -365,9 +365,7 @@ def run_command(client: DingoClient, args) -> int:
             ],
         }))
     elif g == "cluster" and c == "jobs":
-        from dingo_tpu.server.rpc import ServiceStub
-
-        stub = ServiceStub(client._coord_channel, "JobService")
+        stub = client.coordinator_service("JobService")
         r = stub.ListJobs(pb.ListJobsRequest(include_done=args.include_done))
         for j in r.jobs:
             print(json.dumps({

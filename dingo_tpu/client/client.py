@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import grpc
 import numpy as np
 
+from dingo_tpu.common.coord_channel import RotatingCoordinatorChannel
 from dingo_tpu.index import codec as vcodec
 from dingo_tpu.server import pb
 from dingo_tpu.server.convert import region_def_from_pb, scalar_from_pb
@@ -28,15 +29,32 @@ class ClientError(RuntimeError):
     pass
 
 
+class _CoordServiceFacade:
+    """Duck-types ServiceStub for one coordinator-side service over the
+    failover-aware group channel (common/coord_channel.py)."""
+
+    def __init__(self, chan: "RotatingCoordinatorChannel", service: str):
+        self._chan = chan
+        self._service = service
+
+    def __getattr__(self, method: str):
+        return lambda req: self._chan.call(self._service, method, req)
+
+
 class DingoClient:
     def __init__(self, coordinator_addr: str,
                  store_addrs: Dict[str, str]):
-        """store_addrs: store_id -> grpc address."""
+        """store_addrs: store_id -> grpc address. `coordinator_addr` may
+        be a comma-separated list of the replicated coordinator group's
+        endpoints; the client rotates on NotLeader/connect failure."""
         self._coordinator_addr = coordinator_addr
-        self._coord_channel = grpc.insecure_channel(coordinator_addr)
-        self.coordinator = ServiceStub(self._coord_channel, "CoordinatorService")
-        self.version = ServiceStub(self._coord_channel, "VersionService")
-        self.meta = ServiceStub(self._coord_channel, "MetaService")
+        self._coord_channel = RotatingCoordinatorChannel(
+            coordinator_addr, ClientError)
+        self.coordinator = _CoordServiceFacade(
+            self._coord_channel, "CoordinatorService")
+        self.version = _CoordServiceFacade(
+            self._coord_channel, "VersionService")
+        self.meta = _CoordServiceFacade(self._coord_channel, "MetaService")
         self._store_addrs = dict(store_addrs)
         self._channels: Dict[str, grpc.Channel] = {}
         self._regions: List = []           # RegionDefinition list
@@ -45,6 +63,11 @@ class DingoClient:
         self._cache_gen = 0   # bumped by every watcher invalidation
         self._meta_watch_thread = None
         self._meta_watch_stop = None
+
+    def coordinator_service(self, service: str) -> "_CoordServiceFacade":
+        """Failover-aware stub for any coordinator-side service (used by
+        the CLI for JobService / ClusterStatService)."""
+        return _CoordServiceFacade(self._coord_channel, service)
 
     # ---------------- plumbing ----------------
     def _stub(self, store_id: str, service: str) -> ServiceStub:

@@ -1,0 +1,118 @@
+"""Failover-aware client channel to the replicated coordinator group.
+
+One rotation protocol shared by the SDK (client/client.py) and the store's
+remote heartbeat (server/remote_heartbeat.py): hold the raft group's
+endpoint list, rotate on NotLeader (errcode 20001) or connection-level
+grpc failure, pause briefly between full rotations to ride out an
+election.
+
+Retry semantics: UNAVAILABLE / CANCELLED (request never served) and
+DEADLINE_EXCEEDED (hung endpoint — rotating is the whole point of the
+group) rotate and re-send; every other RpcError and every in-band
+application error surfaces to the caller. Caveat a client cannot remove:
+a re-sent call whose first attempt committed before the deadline makes
+mutations at-least-once — idempotent coordinator ops (create returns
+"exists", acks dedupe by cmd_id) absorb this; callers doing
+non-idempotent mutations should treat an "exists" answer after a retry
+as success.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Type
+
+import grpc
+
+from dingo_tpu.server.rpc import ServiceStub
+
+_ERR_NOT_LEADER = 20001
+
+#: grpc codes that mean "never served here" — safe to rotate + retry
+_ROTATE_CODES = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.CANCELLED)
+
+
+class RotatingCoordinatorChannel:
+    """Thread-safe; one instance backs every coordinator-side service stub
+    so a failover discovered by one call benefits the rest."""
+
+    def __init__(self, addrs: str, error_cls: Type[Exception],
+                 timeout_s: float = 10.0, rounds: int = 3):
+        self._addrs = [a.strip() for a in addrs.split(",") if a.strip()]
+        if not self._addrs:
+            raise error_cls("empty coordinator address list")
+        self._error_cls = error_cls
+        self._timeout_s = timeout_s
+        self._rounds = rounds
+        self._active = 0
+        self._lock = threading.Lock()
+        self._channel: Optional[grpc.Channel] = None
+        self._stubs: Dict[str, ServiceStub] = {}
+        self._connect(0)
+
+    @property
+    def addrs(self):
+        return list(self._addrs)
+
+    def _connect(self, idx: int) -> None:
+        if self._channel is not None:
+            self._channel.close()
+        self._active = idx % len(self._addrs)
+        self._channel = grpc.insecure_channel(self._addrs[self._active])
+        self._stubs = {}
+
+    def _stub_for(self, service: str):
+        stub = self._stubs.get(service)
+        if stub is None:
+            stub = self._stubs[service] = ServiceStub(self._channel, service)
+        return stub
+
+    def _rotate_from(self, seen_active: int) -> None:
+        """Advance past `seen_active` unless another thread already did —
+        two threads failing on the same endpoint rotate once, not twice."""
+        with self._lock:
+            if self._active == seen_active:
+                self._connect(seen_active + 1)
+
+    def call(self, service: str, method: str, req,
+             timeout_s: Optional[float] = None):
+        """Invoke on the active endpoint with a deadline (a hung leader
+        must not disable rotation). Application errors return in-band for
+        the caller to interpret; exhaustion raises error_cls. The lock
+        guards only channel state — a long-poll must not serialize other
+        calls."""
+        deadline = timeout_s if timeout_s is not None else self._timeout_s
+        last_err = "no coordinator reachable"
+        for round_i in range(self._rounds):
+            for _ in range(len(self._addrs)):
+                with self._lock:
+                    stub = self._stub_for(service)
+                    active = self._active
+                try:
+                    resp = getattr(stub, method)(req, timeout=deadline)
+                except grpc.RpcError as e:
+                    code = e.code() if hasattr(e, "code") else None
+                    if code not in _ROTATE_CODES and \
+                            code is not grpc.StatusCode.DEADLINE_EXCEEDED:
+                        raise   # unknown failure: not safe to re-send
+                    last_err = f"{self._addrs[active]}: {code}"
+                    self._rotate_from(active)
+                    continue
+                err = getattr(resp, "error", None)
+                if err is not None and err.errcode == _ERR_NOT_LEADER:
+                    last_err = f"{self._addrs[active]}: {err.errmsg}"
+                    self._rotate_from(active)
+                    continue
+                return resp
+            if round_i < self._rounds - 1:
+                time.sleep(0.2)   # election in progress
+        raise self._error_cls(
+            f"coordinator group: {method}: {last_err}")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._channel is not None:
+                self._channel.close()
+                self._channel = None
+                self._stubs = {}

@@ -14,14 +14,7 @@ and acked back via done_cmd_ids so the coordinator prunes its queues.
 
 from __future__ import annotations
 
-from typing import List
-
-import grpc
-
 from dingo_tpu.server import convert, pb
-from dingo_tpu.server.rpc import ServiceStub
-
-_ERR_NOT_LEADER = 20001
 
 
 class HeartbeatError(RuntimeError):
@@ -30,47 +23,23 @@ class HeartbeatError(RuntimeError):
 
 class RemoteHeartbeat:
     def __init__(self, node, coordinator_addr: str):
-        self.node = node
-        self._addrs: List[str] = [
-            a.strip() for a in coordinator_addr.split(",") if a.strip()
-        ]
-        self._active = 0
-        self._channel = None
-        self._stub = None
-        self._connect(self._active)
+        from dingo_tpu.common.coord_channel import RotatingCoordinatorChannel
 
-    def _connect(self, idx: int) -> None:
-        if self._channel is not None:
-            self._channel.close()
-        self._active = idx % len(self._addrs)
-        self._channel = grpc.insecure_channel(self._addrs[self._active])
-        self._stub = ServiceStub(self._channel, "CoordinatorService")
+        self.node = node
+        # shared failover protocol (common/coord_channel.py) — the SDK's
+        # coordinator channel is the same class, so the rotation contract
+        # cannot drift between the two clients
+        self._chan = RotatingCoordinatorChannel(
+            coordinator_addr, HeartbeatError, rounds=1)
 
     def _call(self, method: str, req):
-        """Invoke on the active coordinator; on NotLeader/connect failure
-        rotate through the remaining endpoints once before giving up."""
-        last = None
-        for _attempt in range(len(self._addrs)):
-            try:
-                resp = getattr(self._stub, method)(req)
-            except grpc.RpcError as e:
-                last = HeartbeatError(
-                    f"{method} via {self._addrs[self._active]}: {e.code()}"
-                )
-                self._connect(self._active + 1)
-                continue
-            err = getattr(resp, "error", None)
-            if err is not None and err.errcode == _ERR_NOT_LEADER:
-                last = HeartbeatError(
-                    f"{method}: {self._addrs[self._active]} is not leader "
-                    f"({err.errmsg})"
-                )
-                self._connect(self._active + 1)
-                continue
-            if err is not None and err.errcode:
-                raise HeartbeatError(f"{method}: {err.errmsg}")
-            return resp
-        raise last or HeartbeatError(f"{method}: no coordinator reachable")
+        """Invoke on the group; in-band application errors (other than the
+        NotLeader the channel already handles) become HeartbeatError."""
+        resp = self._chan.call("CoordinatorService", method, req)
+        err = getattr(resp, "error", None)
+        if err is not None and err.errcode:
+            raise HeartbeatError(f"{method}: {err.errmsg}")
+        return resp
 
     def beat(self) -> int:
         node = self.node

@@ -187,16 +187,14 @@ def test_introspection_services(cluster):
     client.create_vector_table("dingo", "intros", param,
                                partitions=[(41, 0, 100)])
     time.sleep(1.2)
-    from dingo_tpu.server.rpc import ServiceStub
-
-    cs = ServiceStub(client._coord_channel, "ClusterStatService")
+    cs = client.coordinator_service("ClusterStatService")
     resp = cs.GetClusterStat(pb.GetClusterStatRequest())
     assert resp.store_count == 3
     assert resp.alive_store_count == 3
     assert resp.region_count >= 1
     assert len(resp.stores) == 3
 
-    js = ServiceStub(client._coord_channel, "JobService")
+    js = client.coordinator_service("JobService")
     jobs = js.ListJobs(pb.ListJobsRequest(include_done=True))
     assert len(jobs.jobs) >= 1  # region creates flowed through the queue
     assert all(j.cmd_type for j in jobs.jobs)

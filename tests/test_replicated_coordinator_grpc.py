@@ -121,3 +121,45 @@ def test_command_delivery_survives_coordinator_leader_kill(coor_group):
     # and once more: no duplicate execution on further beats
     assert hb.beat() == 0
     assert new_leader.sm.control.store_ops.get("s1") == []
+
+
+def test_sdk_rotates_on_coordinator_leader_kill(coor_group):
+    """SDK coordinator-group failover (reference SDK + br take coordinator
+    LISTS): the client gets all three endpoints, the leader's server is
+    killed mid-workload, and the client finishes against the new leader."""
+    from dingo_tpu.client.client import ClientError, DingoClient
+
+    coords, servers, addrs = coor_group
+    leader = wait_leader(coords)
+    # endpoint list deliberately starts at the CURRENT leader so the kill
+    # strands the active channel, not a follower
+    ordered = [addrs[leader.node.id]] + [
+        a for cid, a in addrs.items() if cid != leader.node.id
+    ]
+    client = DingoClient(",".join(ordered), {})
+    try:
+        ts1 = client.tso()
+        client.create_schema("failover_schema")
+
+        idx = coords.index(leader)
+        servers[idx].stop()
+        leader.stop()
+
+        # workload continues once a new leader is up; the client must
+        # rotate there on its own
+        ts2 = None
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            try:
+                ts2 = client.tso()
+                break
+            except ClientError:
+                time.sleep(0.3)
+        assert ts2 is not None and ts2 > ts1, "client never recovered"
+        # the pre-kill mutation survived the failover (raft-replicated)
+        assert "failover_schema" in client.get_schemas()
+        # and new mutations land on the new leader
+        client.create_schema("post_failover_schema")
+        assert "post_failover_schema" in client.get_schemas()
+    finally:
+        client.close()
