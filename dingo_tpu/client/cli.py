@@ -124,6 +124,11 @@ def build_parser() -> argparse.ArgumentParser:
     node = sub.add_parser("node").add_subparsers(dest="cmd")
     ninfo = node.add_parser("info")
     ninfo.add_argument("--store", dest="target_store", required=True)
+    nlog = node.add_parser("log-level")
+    nlog.add_argument("--store", dest="target_store", required=True)
+    nlog.add_argument("--module", default="")
+    nlog.add_argument("level", nargs="?", default="",
+                      help="DEBUG/INFO/WARNING/ERROR; omit to list levels")
 
     meta = sub.add_parser("meta").add_subparsers(dest="cmd")
     meta.add_parser("schemas")
@@ -299,6 +304,22 @@ def run_command(client: DingoClient, args) -> int:
             "regions": list(r.region_ids),
             "leader_regions": list(r.leader_region_ids),
         }))
+    elif g == "node" and c == "log-level":
+        stub = client._stub(args.target_store, "NodeService")
+        if args.level:
+            r = stub.SetLogLevel(pb.SetLogLevelRequest(
+                level=args.level, module=args.module))
+            if r.error.errcode:
+                print(json.dumps({"error": r.error.errmsg}))
+                return 1
+            print(json.dumps({"level": args.level.upper(),
+                              "module": args.module or "<all>"}))
+        else:
+            r = stub.GetLogLevel(pb.GetLogLevelRequest())
+            if r.error.errcode:
+                print(json.dumps({"error": r.error.errmsg}))
+                return 1
+            print(json.dumps({e.module: e.level for e in r.levels}))
     elif g == "meta" and c == "schemas":
         print(json.dumps(client.get_schemas()))
     elif g == "meta" and c == "create-schema":

@@ -25,7 +25,10 @@ from typing import Dict, Optional, Type
 
 import grpc
 
+from dingo_tpu.common.log import get_logger
 from dingo_tpu.server.rpc import ServiceStub
+
+_log = get_logger("coord_channel")
 
 _ERR_NOT_LEADER = 20001
 
@@ -74,6 +77,8 @@ class RotatingCoordinatorChannel:
         with self._lock:
             if self._active == seen_active:
                 self._connect(seen_active + 1)
+                _log.info("rotating coordinator endpoint -> %s",
+                          self._addrs[self._active])
 
     def call(self, service: str, method: str, req,
              timeout_s: Optional[float] = None):

@@ -24,6 +24,7 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from dingo_tpu.common import persist
+from dingo_tpu.common.log import get_logger, region_log
 from dingo_tpu.engine.raw_engine import CF_META, RawEngine
 from dingo_tpu.index.base import IndexParameter
 from dingo_tpu.store.region import (
@@ -31,6 +32,8 @@ from dingo_tpu.store.region import (
     RegionEpoch,
     RegionType,
 )
+
+_log = get_logger("coordinator.control")
 
 _PREFIX_STORE = b"COOR_STORE_"
 _PREFIX_REGION = b"COOR_REGION_"
@@ -250,6 +253,9 @@ class CoordinatorControl:
                     info.state = StoreState.OFFLINE
                     newly.append(info.store_id)
                     self._persist(_PREFIX_STORE + info.store_id.encode(), info)
+        for sid in newly:
+            _log.warning("store %s marked OFFLINE (silent > %dms)",
+                         sid, self.OFFLINE_AFTER_MS)
         return newly
 
     def alive_stores(self) -> List[StoreInfo]:
@@ -328,6 +334,8 @@ class CoordinatorControl:
                     cmd_type=RegionCmdType.CREATE,
                     definition=definition,
                 ))
+            region_log(_log, definition.region_id).info(
+                "create type=%s peers=%s", region_type.name, peers)
             return definition
 
     def _place_peers(self, n: int) -> List[str]:
@@ -402,6 +410,8 @@ class CoordinatorControl:
                 cmd_type=RegionCmdType.SPLIT, split_key=split_key,
                 child_region_id=child_id,
             ))
+            region_log(_log, region_id).info(
+                "split queued -> child %d via %s", child_id, leader)
             return child_id
 
     def merge_region(self, target_region_id: int,
@@ -425,6 +435,9 @@ class CoordinatorControl:
                 child_region_id=source_region_id,
             )
             self._queue_cmd(leader, cmd)
+            region_log(_log, target_region_id).info(
+                "merge queued: absorbing region %d via %s",
+                source_region_id, leader)
 
     def on_region_merge_done(self, target_id: int, source_id: int,
                              target_def) -> None:
@@ -476,6 +489,8 @@ class CoordinatorControl:
                 cmd_type=RegionCmdType.TRANSFER_LEADER,
                 target_store_id=target_store,
             ))
+            region_log(_log, region_id).info(
+                "leader transfer queued: %s -> %s", leader, target_store)
 
     def change_peer(self, region_id: int, new_peers: List[str]) -> None:
         """ChangePeerRegionWithJob (:313)."""
@@ -509,6 +524,8 @@ class CoordinatorControl:
                     cmd_id=self._next_cmd(), region_id=region_id,
                     cmd_type=RegionCmdType.DELETE,
                 ))
+            region_log(_log, region_id).info(
+                "peer change: %s -> %s", sorted(old), sorted(new))
 
     #: GC retention window (versions younger than this always survive)
     GC_RETENTION_MS = 3_600_000

@@ -22,6 +22,7 @@ from typing import Optional
 
 import numpy as np
 
+from dingo_tpu.common.log import get_logger, region_log
 from dingo_tpu.engine import write_data as wd
 from dingo_tpu.engine.raw_engine import RawEngine
 from dingo_tpu.index.base import IndexParameter, VectorIndex
@@ -30,6 +31,8 @@ from dingo_tpu.index.vector_reader import ReaderContext, VectorReader
 from dingo_tpu.index.wrapper import VectorIndexWrapper
 from dingo_tpu.raft.log import RaftLog
 from dingo_tpu.store.region import Region
+
+_log = get_logger("index.manager")
 
 #: kBuildVectorIndexBatchSize analog (reference scans in fixed batches)
 BUILD_BATCH = 4096
@@ -132,6 +135,7 @@ class VectorIndexManager:
             self._rebuilding.add(region.id)
             self.rebuild_running += 1
             self.rebuild_total += 1
+        region_log(_log, region.id).info("index rebuild starting")
         try:
             if raft_log is None:
                 # No WAL to replay: hold the wrapper lock across scan+swap so
@@ -191,6 +195,9 @@ class VectorIndexManager:
             wrapper.write_count = 0
         with self._lock:
             self.save_total += 1
+        region_log(_log, region.id).info(
+            "index snapshot saved @log %d -> %s",
+            wrapper.snapshot_log_id, path)
         return path
 
     def load_index(self, region: Region,

@@ -18,8 +18,11 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from dingo_tpu.common.log import get_logger
 from dingo_tpu.raft.log import RaftLog
 from dingo_tpu.raft.transport import Transport
+
+_log = get_logger("raft.core")
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
@@ -286,6 +289,8 @@ class RaftNode:
                 cb = self.on_leader_start
             else:
                 return
+        _log.info("%s became leader (term %d, last_index %d)",
+                  self.id, term, last)
         if cb:
             cb(term)
         self._broadcast_append()
@@ -503,6 +508,8 @@ class RaftNode:
             self._last_leader_contact = time.monotonic()
             if msg["snap_index"] <= self.log.snapshot_index:
                 return {"term": self.current_term, "ok": True}
+        _log.info("%s installing snapshot @%d (term %d) from %s",
+                  self.id, msg["snap_index"], msg["snap_term"], msg["from"])
         with self._apply_mutex:  # no concurrent apply during state install
             if self.snapshot_install_fn:
                 self.snapshot_install_fn(msg["blob"])

@@ -10,9 +10,9 @@ Startup order mirrors §3.3: config -> engine -> (coordinator: controls |
 store: meta recovery -> index manager -> storage -> controllers) ->
 services -> crontab schedule.
 
-Note: multi-process stores need a network raft transport between store
-processes; the in-process LocalTransport serves single-process multi-role
-deployments (the production-grade grpc raft transport is tracked work).
+Raft traffic between processes rides the grpc raft transport
+(raft/grpc_transport.py, wired below for --coor-peers deployments); the
+in-process LocalTransport serves single-process multi-role runs.
 """
 
 from __future__ import annotations

@@ -134,6 +134,8 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
             pb.VectorIndexSnapshotMetaRequest,
             pb.VectorIndexSnapshotMetaResponse,
         ),
+        "SetLogLevel": (pb.SetLogLevelRequest, pb.SetLogLevelResponse),
+        "GetLogLevel": (pb.GetLogLevelRequest, pb.GetLogLevelResponse),
     },
     "FileService": {
         "ReadFileChunk": (pb.FileChunkRequest, pb.FileChunkResponse),
@@ -208,7 +210,7 @@ def _register(server: grpc.Server, service_name: str, impl) -> None:
     for method, (req_t, resp_t) in schema.items():
         fn = getattr(impl, method)
 
-        def make(fn, req_t, resp_t):
+        def make(fn, req_t, resp_t, method):
             def handler(request, context):
                 try:
                     return fn(request)
@@ -224,6 +226,10 @@ def _register(server: grpc.Server, service_name: str, impl) -> None:
                 except Exception as e:  # noqa: BLE001
                     # unexpected failures (incl. injected failpoints) become
                     # in-band errors instead of opaque grpc UNKNOWNs
+                    from dingo_tpu.common.log import get_logger
+
+                    get_logger("rpc").exception(
+                        "%s.%s failed", service_name, method)
                     resp = resp_t()
                     if hasattr(resp, "error"):
                         resp.error.errcode = 99999
@@ -233,7 +239,7 @@ def _register(server: grpc.Server, service_name: str, impl) -> None:
             return handler
 
         handlers[method] = grpc.unary_unary_rpc_method_handler(
-            make(fn, req_t, resp_t),
+            make(fn, req_t, resp_t, method),
             request_deserializer=req_t.FromString,
             response_serializer=resp_t.SerializeToString,
         )

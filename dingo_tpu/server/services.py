@@ -1235,6 +1235,30 @@ class NodeService:
         )
         return resp
 
+    def SetLogLevel(self, req: pb.SetLogLevelRequest):
+        """Runtime log-level flip (node_service.h log-level RPC)."""
+        from dingo_tpu.common import log as dlog
+
+        resp = pb.SetLogLevelResponse()
+        try:
+            dlog.set_level(req.level, module=req.module or None)
+        except ValueError as e:
+            return _err(resp, 90003, str(e))
+        dlog.get_logger("node").info(
+            "log level set to %s (module=%s)", req.level.upper(),
+            req.module or "<all>")
+        return resp
+
+    def GetLogLevel(self, req: pb.GetLogLevelRequest):
+        from dingo_tpu.common import log as dlog
+
+        resp = pb.GetLogLevelResponse()
+        for module, level in sorted(dlog.get_levels().items()):
+            e = resp.levels.add()
+            e.module = module
+            e.level = level
+        return resp
+
 
 class FileService:
     """Chunked snapshot file download (reference file_service.{h,cc}: the

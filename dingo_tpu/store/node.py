@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from dingo_tpu.common.log import get_logger, region_log
 from dingo_tpu.coordinator.control import (
     CoordinatorControl,
     RegionCmd,
@@ -35,6 +36,8 @@ from dingo_tpu.store.region import (
     RegionType,
     StoreMetaManager,
 )
+
+_log = get_logger("store.node")
 
 
 class StoreNode:
@@ -377,6 +380,9 @@ class StoreNode:
                 self._unacked_done.add(cmd.cmd_id)  # failover — re-ack only
                 continue
             try:
+                region_log(_log, cmd.region_id).debug(
+                    "executing cmd %d type=%s", cmd.cmd_id,
+                    cmd.cmd_type.value)
                 self.execute_region_cmd(cmd)
                 cmd.status = "done"
                 self._done_cmd_ids[cmd.cmd_id] = None
@@ -398,6 +404,9 @@ class StoreNode:
                 # after a budget so poison commands don't loop forever
                 cmd.retries += 1
                 cmd.status = "pending" if cmd.retries < 5 else f"error: {e}"
+                region_log(_log, cmd.region_id).warning(
+                    "cmd %d type=%s attempt %d failed: %s", cmd.cmd_id,
+                    cmd.cmd_type.value, cmd.retries, e)
         return cmds
 
     def start_heartbeat(self, interval_s: float = 1.0) -> None:
