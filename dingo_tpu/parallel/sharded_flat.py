@@ -134,16 +134,20 @@ class TpuShardedFlat(VectorIndex):
                 v = jnp.pad(v, ((0, 0), (0, pad)), constant_values=fill)
                 return v.reshape(S * cap)
 
+            # growth cannot donate: the output is LARGER than the input,
+            # so XLA can never alias the buffers (donating only produced
+            # "donated buffers were not usable" warnings); the old arrays
+            # free when the references drop below
             self._store.vecs = jax.jit(
-                grow2d, out_shardings=sharding2d, donate_argnums=0
+                grow2d, out_shardings=sharding2d
             )(self._store.vecs)  # under _device_lock via callers
             self._store.sqnorm = jax.jit(
                 functools.partial(grow1d, fill=0.0),
-                out_shardings=sharding1d, donate_argnums=0,
+                out_shardings=sharding1d,
             )(self._store.sqnorm)
             self._store.valid = jax.jit(
                 functools.partial(grow1d, fill=False),
-                out_shardings=sharding1d, donate_argnums=0,
+                out_shardings=sharding1d,
             )(self._store.valid)
             # host remap: old gslot s*old+o -> s*cap+o. Vectorized — the
             # per-slot Python loops here were O(S*cap) per growth and

@@ -128,8 +128,9 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
             c = jnp.pad(c, ((0, 0), (0, pad), (0, 0)))
             return c.reshape(S * cap, m)
 
+        # growth cannot donate (output larger than input — no aliasing)
         self._codes = jax.jit(
-            grow, out_shardings=sh, donate_argnums=0
+            grow, out_shardings=sh
         )(self._codes)
 
     # -- programs ------------------------------------------------------------
