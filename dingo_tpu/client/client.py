@@ -153,6 +153,28 @@ class DingoClient:
             raise ClientError(resp.error.errmsg)
         return resp.child_region_id
 
+    def create_document_region(self, partition_id: int, id_lo: int,
+                               id_hi: int,
+                               schema: Optional[Dict[str, str]] = None,
+                               replication: int = 0):
+        """DOCUMENT region with an optional typed column schema
+        (name -> text/i64/f64/bytes/bool — validated on add, backs
+        range/eq predicates in query syntax)."""
+        req = pb.CreateRegionRequest()
+        req.range.start_key = vcodec.encode_vector_key(partition_id, id_lo)
+        req.range.end_key = vcodec.encode_vector_key(partition_id, id_hi)
+        req.partition_id = partition_id
+        req.region_type = 2
+        req.replication = replication
+        for name, ftype in (schema or {}).items():
+            col = req.document_schema.add()
+            col.name = name
+            col.sql_type = ftype
+        resp = self.coordinator.CreateRegion(req)
+        if resp.error.errcode:
+            raise ClientError(resp.error.errmsg)
+        return region_def_from_pb(resp.definition)
+
     def merge_region(self, target_region_id: int,
                      source_region_id: int) -> None:
         """Operator region op: target absorbs the adjacent source."""

@@ -288,9 +288,20 @@ class CoordinatorControl:
         region_type: RegionType = RegionType.STORE,
         index_parameter: Optional[IndexParameter] = None,
         replication: Optional[int] = None,
+        document_schema: Optional[Dict[str, str]] = None,
     ) -> RegionDefinition:
         """CreateRegionFinal (coordinator_control.h:263): allocate id, place
         peers on the least-loaded alive stores, queue CREATE commands."""
+        if document_schema:
+            from dingo_tpu.document.index import COLUMN_TYPES
+
+            bad = {f: t for f, t in document_schema.items()
+                   if t not in COLUMN_TYPES}
+            if bad:
+                # an unknown type would fail DocumentIndex construction on
+                # every peer's CREATE cmd with no error ever reaching the
+                # caller — reject at the coordinator instead
+                raise RuntimeError(f"unknown document column types: {bad}")
         with self._lock:
             # Overlapping key ranges of the SAME region type would route
             # two tables'/callers' data into one region (client routing
@@ -322,6 +333,7 @@ class CoordinatorControl:
                 peers=peers,
                 region_type=region_type,
                 index_parameter=index_parameter,
+                document_schema=document_schema,
             )
             self.regions[definition.region_id] = definition
             self._persist(

@@ -89,6 +89,10 @@ def region_def_to_pb(d: RegionDefinition) -> pb.RegionDefinition:
     out.peers.extend(d.peers)
     out.region_type = {"store": 0, "index": 1, "document": 2}[d.region_type.value]
     out.index_parameter.CopyFrom(index_parameter_to_pb(d.index_parameter))
+    for name, ftype in (d.document_schema or {}).items():
+        col = out.document_schema.add()
+        col.name = name
+        col.sql_type = ftype
     return out
 
 
@@ -103,6 +107,10 @@ def region_def_from_pb(m: pb.RegionDefinition) -> RegionDefinition:
         region_type=[RegionType.STORE, RegionType.INDEX,
                      RegionType.DOCUMENT][m.region_type],
         index_parameter=index_parameter_from_pb(m.index_parameter),
+        document_schema=(
+            {c.name: c.sql_type for c in m.document_schema}
+            if m.document_schema else None
+        ),
     )
 
 

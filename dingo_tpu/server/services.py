@@ -1094,6 +1094,16 @@ class DocumentService:
             return _err(resp, 80001, "not a DOCUMENT region")
         ids = [d.id for d in req.documents]
         docs = [convert.scalar_from_pb(d.fields) for d in req.documents]
+        # typed-schema validation BEFORE the raft propose: a doc that can
+        # never apply must not enter the log (apply-time failures would
+        # have to fail identically on every replica forever)
+        from dingo_tpu.document.index import SchemaError
+
+        try:
+            for doc in docs:
+                region.document_index.check_doc(doc)
+        except SchemaError as e:
+            return _err(resp, 80002, str(e))
         try:
             ts = self.node.storage.ts_provider.get_ts()
             self.node.engine.write(region, wd.DocumentAddData(
@@ -1358,6 +1368,10 @@ class CoordinatorService:
                     req.index_parameter
                 ),
                 replication=req.replication or None,
+                document_schema=(
+                    {c.name: c.sql_type for c in req.document_schema}
+                    if req.document_schema else None
+                ),
             )
         except RuntimeError as e:
             return _err(resp, 60001, str(e))

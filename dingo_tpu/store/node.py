@@ -151,6 +151,7 @@ class StoreNode:
                 peers=list(parent.definition.peers),
                 region_type=parent.definition.region_type,
                 index_parameter=parent.definition.index_parameter,
+                document_schema=parent.definition.document_schema,
             )
             child_def.epoch.version = parent.definition.epoch.version + 1
             parent.definition.end_key = data.split_key

@@ -75,6 +75,9 @@ class RegionDefinition:
     epoch: RegionEpoch = dataclasses.field(default_factory=RegionEpoch)
     region_type: RegionType = RegionType.STORE
     index_parameter: Optional[IndexParameter] = None
+    #: DOCUMENT regions: column name -> type ("text"/"i64"/"f64"/"bytes"/
+    #: "bool") — validated on add, backs range/eq predicates
+    document_schema: Optional[Dict[str, str]] = None
 
 
 class Region:
@@ -95,7 +98,8 @@ class Region:
         elif definition.region_type is RegionType.DOCUMENT:
             from dingo_tpu.document import DocumentIndex
 
-            self.document_index = DocumentIndex(definition.region_id)
+            self.document_index = DocumentIndex(
+                definition.region_id, schema=definition.document_schema)
         self.change_log: List[Tuple[float, str]] = []  # RegionChangeRecorder
 
     @property
