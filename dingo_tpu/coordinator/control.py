@@ -150,7 +150,7 @@ class CoordinatorControl:
         self._persist(_KEY_OPS, (self.store_ops, self.region_leaders))
 
     # ---------------- store registry ----------------------------------------
-    def register_store(self, store_id: str, address: str = "",
+    def register_store(self, store_id: str, address: str = "", *,
                        now_ms: Optional[int] = None) -> None:
         """`now_ms` is supplied by the raft-meta harness so the op applies
         identically on every coordinator replica (wall clock is not
@@ -159,7 +159,7 @@ class CoordinatorControl:
             info = self.stores.get(store_id) or StoreInfo(store_id, address)
             info.address = address or info.address
             info.state = StoreState.NORMAL
-            info.last_heartbeat_ms = now_ms or int(time.time() * 1000)
+            info.last_heartbeat_ms = now_ms if now_ms is not None else int(time.time() * 1000)
             self.stores[store_id] = info
             self.store_ops.setdefault(store_id, [])
             self._persist(_PREFIX_STORE + store_id.encode(), info)
@@ -172,8 +172,11 @@ class CoordinatorControl:
         capacity_bytes: int = 0,
         used_bytes: int = 0,
         region_defs: Sequence[RegionDefinition] = (),
+        *,
         now_ms: Optional[int] = None,
         done_cmd_ids: Sequence[int] = (),
+        failed_cmd_ids: Sequence[int] = (),
+        stalled_cmd_ids: Sequence[int] = (),
     ) -> List[RegionCmd]:
         """StoreHeartbeat: record metrics, reconcile region topology from the
         store's reported definitions (splits survive leader crashes this
@@ -192,7 +195,7 @@ class CoordinatorControl:
             if info is None:
                 self.register_store(store_id, now_ms=now_ms)
                 info = self.stores[store_id]
-            info.last_heartbeat_ms = now_ms or int(time.time() * 1000)
+            info.last_heartbeat_ms = now_ms if now_ms is not None else int(time.time() * 1000)
             info.region_ids = list(region_ids)
             info.leader_region_ids = list(leader_region_ids)
             info.capacity_bytes = capacity_bytes
@@ -213,10 +216,44 @@ class CoordinatorControl:
                     # job (reset_sent_cmds) before the store's ack landed
                     if j.cmd_id in done and j.status in ("sent", "pending"):
                         j.status = "done"
+            # nack: the store could not execute these — re-arm for the next
+            # beat, with a retry budget so poison commands don't loop
+            # forever. This is the explicit re-delivery channel (the store
+            # mutates COPIES of the queue objects; direct mutation would
+            # fork an in-process replicated coordinator's leader state).
+            if failed_cmd_ids:
+                failed = set(failed_cmd_ids)
+                doomed = []
+                for c in ops:
+                    if c.cmd_id in failed and c.status == "sent":
+                        c.retries += 1
+                        if c.retries >= 5:
+                            c.status = "error: retry budget exhausted"
+                            doomed.append(c.cmd_id)
+                        else:
+                            c.status = "pending"
+                if doomed:
+                    doomed_set = set(doomed)
+                    ops[:] = [c for c in ops if c.cmd_id not in doomed_set]
+                    for j in self.jobs:
+                        if j.cmd_id in doomed_set:
+                            j.status = "error: retry budget exhausted"
+                            region_log(_log, j.region_id).warning(
+                                "cmd %d type=%s dropped after %d failures",
+                                j.cmd_id, j.cmd_type.value, 5)
+            # stalled: delivery landed somewhere that cannot act YET (e.g.
+            # region mid-election, requeue RPC failed) — re-arm without
+            # charging the poison budget; leadership churn is not a
+            # command defect
+            if stalled_cmd_ids:
+                stalled = set(stalled_cmd_ids)
+                for c in ops:
+                    if c.cmd_id in stalled and c.status == "sent":
+                        c.status = "pending"
             pending = [c for c in ops if c.status == "pending"]
             for c in pending:
                 c.status = "sent"
-            if pending or done_cmd_ids:
+            if pending or done_cmd_ids or failed_cmd_ids or stalled_cmd_ids:
                 self._persist_ops()
             return pending
 
@@ -239,10 +276,10 @@ class CoordinatorControl:
                 self._persist_ops()
             return n
 
-    def update_store_states(self, now_ms: Optional[int] = None) -> List[str]:
+    def update_store_states(self, *, now_ms: Optional[int] = None) -> List[str]:
         """UpdateStoreState crontab: mark silent stores OFFLINE; returns the
         newly-offline store ids (region health checks follow)."""
-        now = now_ms or int(time.time() * 1000)
+        now = now_ms if now_ms is not None else int(time.time() * 1000)
         newly = []
         with self._lock:
             for info in self.stores.values():

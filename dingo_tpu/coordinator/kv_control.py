@@ -73,7 +73,7 @@ class Lease:
     keys: List[bytes] = dataclasses.field(default_factory=list)
 
     def expired(self, now_ms: Optional[int] = None) -> bool:
-        now_ms = now_ms or int(time.time() * 1000)
+        now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
         return now_ms > self.granted_ms + self.ttl_s * 1000
 
 
@@ -159,7 +159,7 @@ class KvControl:
         )
 
     # ---------------- KV ------------------------------------------------------
-    def kv_put(self, key: bytes, value: bytes, lease_id: int = 0,
+    def kv_put(self, key: bytes, value: bytes, lease_id: int = 0, *,
                now_ms: Optional[int] = None) -> int:
         """Returns the new revision (KvPut, kv_control.h:263)."""
         with self._lock:
@@ -295,7 +295,7 @@ class KvControl:
             return removed
 
     # ---------------- leases --------------------------------------------------
-    def lease_grant(self, ttl_s: int, lease_id: int = 0,
+    def lease_grant(self, ttl_s: int, lease_id: int = 0, *,
                     now_ms: Optional[int] = None) -> Lease:
         """`now_ms` comes from the raft-meta harness in replicated mode so
         lease clocks are identical on every coordinator replica."""
@@ -303,18 +303,18 @@ class KvControl:
             lid = lease_id or self._next_lease
             self._next_lease = max(self._next_lease, lid + 1)
             lease = Lease(lease_id=lid, ttl_s=ttl_s,
-                          granted_ms=now_ms or int(time.time() * 1000))
+                          granted_ms=now_ms if now_ms is not None else int(time.time() * 1000))
             self._leases[lid] = lease
             self._persist_lease(lease)
             return lease
 
-    def lease_renew(self, lease_id: int,
+    def lease_renew(self, lease_id: int, *,
                     now_ms: Optional[int] = None) -> Lease:
         with self._lock:
             lease = self._leases.get(lease_id)
             if lease is None or lease.expired(now_ms):
                 raise KeyError(f"lease {lease_id} not found/expired")
-            lease.granted_ms = now_ms or int(time.time() * 1000)
+            lease.granted_ms = now_ms if now_ms is not None else int(time.time() * 1000)
             self._persist_lease(lease)
             return lease
 
@@ -335,7 +335,7 @@ class KvControl:
             if lease.expired(now_ms):
                 self.lease_revoke(lid)
 
-    def lease_gc(self, now_ms: Optional[int] = None) -> None:
+    def lease_gc(self, *, now_ms: Optional[int] = None) -> None:
         """Crontab entry point (lease expiry sweep)."""
         with self._lock:
             self._expire_leases(now_ms)

@@ -30,7 +30,14 @@ iff apply is deterministic, which drives three design rules:
 Reads are served from local in-memory state. The leader's state is
 linearizable with respect to its own applies (propose blocks until local
 apply); services route mutations to the leader and surrender NotLeader with
-a hint, mirroring the store-side raft contract.
+a hint, mirroring the store-side raft contract. Coordinator READS on a
+FOLLOWER can be stale by the follower's apply lag (no read-index/leader-
+lease pass — the reference serves reads through the braft leader): clients
+pointed at a follower may see an old region map or job list. This is
+deliberate: store-side region-epoch checks reject stale routing, and
+SDK/heartbeat clients rotate to the leader on any mutation. Callers
+needing linearizable meta reads should read through the leader (the
+rotating client channel lands there after any write).
 """
 
 from __future__ import annotations
@@ -84,6 +91,9 @@ _STAMP_NOW = frozenset({
     ("kv", "kv_put"), ("kv", "lease_grant"), ("kv", "lease_renew"),
     ("kv", "lease_gc"),
 })
+
+#: sentinel distinguishing "result evicted" from a legitimate None result
+_RESULT_EVICTED = object()
 
 
 class _BatchedEngine:
@@ -217,7 +227,11 @@ class _Proxy:
         target = self._target
         if name in _MUTATIONS[target]:
             def call(*args, **kwargs):
-                if (target, name) in _STAMP_NOW and not kwargs.get("now_ms"):
+                # now_ms is keyword-only on every stamped method, so a
+                # positional timestamp cannot slip past this check; an
+                # explicit now_ms=0 counts as provided (None = unset)
+                if (target, name) in _STAMP_NOW and \
+                        kwargs.get("now_ms") is None:
                     kwargs["now_ms"] = int(time.time() * 1000)
                 return coordinator.propose_op(target, name, args, kwargs)
             return call
@@ -301,7 +315,16 @@ class RaftMetaCoordinator:
         payload = persist.dumps((target, method, list(args), kwargs))
         index = self.node.propose(payload, timeout=timeout)
         with self._results_lock:
-            ok, value = self._results.pop(index, (True, None))
+            entry = self._results.pop(index, _RESULT_EVICTED)
+        if entry is _RESULT_EVICTED:
+            # the bounded buffer evicted this apply's outcome (>4096
+            # concurrent proposals) — the op APPLIED, but its return value
+            # and any exception it raised are gone; surface that instead
+            # of silently returning None/success
+            raise RuntimeError(
+                f"{target}.{method}: apply result evicted under load "
+                f"(op applied at index {index}; outcome unknown)")
+        ok, value = entry
         if not ok:
             raise value
         return value

@@ -51,13 +51,13 @@ class TsoControl:
             self.engine.put(CF_META, _KEY, persist.dumps(target))
             self._persisted_until = target
 
-    def gen_ts(self, count: int = 1,
+    def gen_ts(self, count: int = 1, *,
                now_ms: Optional[int] = None) -> Tuple[int, int]:
         """GenerateTso: a contiguous block [first, first+count). In
         raft-meta mode now_ms is the leader's stamp so the op applies
         identically on every replica."""
         with self._lock:
-            now = now_ms or int(time.time() * 1000)
+            now = now_ms if now_ms is not None else int(time.time() * 1000)
             if now > self._physical:
                 self._physical = now
                 self._logical = 0

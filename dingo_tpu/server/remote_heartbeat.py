@@ -55,6 +55,10 @@ class RemoteHeartbeat:
         req.leader_region_ids.extend(leader_ids)
         acking = list(node._unacked_done)
         req.done_cmd_ids.extend(acking)
+        nacking = list(node._failed_cmds)
+        req.failed_cmd_ids.extend(nacking)
+        stalling = list(node._stalled_cmds)
+        req.stalled_cmd_ids.extend(stalling)
         for r in regions:
             if r.id in leader_ids:
                 req.region_definitions.add().CopyFrom(
@@ -62,6 +66,8 @@ class RemoteHeartbeat:
                 )
         resp = self._call("StoreHeartbeat", req)
         node._unacked_done.difference_update(acking)
+        node._failed_cmds.difference_update(nacking)
+        node._stalled_cmds.difference_update(stalling)
         executed = 0
         for c in resp.commands:
             if c.cmd_id in node._done_cmd_ids:
@@ -88,5 +94,14 @@ class RemoteHeartbeat:
                     try:
                         self._call("RequeueRegionCmd", rq)
                     except HeartbeatError:
-                        pass
+                        # requeue lost: report stalled so the cmd is
+                        # re-armed instead of sitting 'sent' forever
+                        node._stalled_cmds.add(c.cmd_id)
+                elif isinstance(e, NotLeader):
+                    # leaderless (election in progress): stalled, not a
+                    # command defect — no retry budget charged
+                    node._stalled_cmds.add(c.cmd_id)
+                else:
+                    # nack: the coordinator re-arms it next beat
+                    node._failed_cmds.add(c.cmd_id)
         return executed

@@ -1342,6 +1342,8 @@ class CoordinatorService:
                 convert.region_def_from_pb(d) for d in req.region_definitions
             ],
             done_cmd_ids=list(req.done_cmd_ids),
+            failed_cmd_ids=list(req.failed_cmd_ids),
+            stalled_cmd_ids=list(req.stalled_cmd_ids),
         )
         for c in cmds:
             out = resp.commands.add()

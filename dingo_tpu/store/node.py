@@ -69,6 +69,12 @@ class StoreNode:
         #: executed cmd_ids not yet acked to the coordinator; reported in
         #: the next heartbeat so the coordinator prunes its queues
         self._unacked_done: set = set()
+        #: failed cmd_ids not yet nacked — the coordinator re-arms them
+        #: (with its retry budget) on the next heartbeat
+        self._failed_cmds: set = set()
+        #: cmd_ids stalled on leadership churn — re-armed WITHOUT charging
+        #: the retry budget (an election is not a command defect)
+        self._stalled_cmds: set = set()
         if coordinator is not None:
             coordinator.register_store(store_id)
 
@@ -362,6 +368,8 @@ class StoreNode:
             if (n := self.engine.get_node(r.id)) is not None and n.is_leader()
         ]
         acking = list(self._unacked_done)
+        nacking = list(self._failed_cmds)
+        stalling = list(self._stalled_cmds)
         cmds = self.coordinator.store_heartbeat(
             self.store_id,
             region_ids=[r.id for r in regions],
@@ -369,10 +377,19 @@ class StoreNode:
             region_defs=[r.definition for r in regions
                          if r.id in leader_ids],
             done_cmd_ids=acking,
+            failed_cmd_ids=nacking,
+            stalled_cmd_ids=stalling,
         )
         # the call returned, so the coordinator applied the acks (raft-
         # replicated coordinators apply before responding)
         self._unacked_done.difference_update(acking)
+        self._failed_cmds.difference_update(nacking)
+        self._stalled_cmds.difference_update(stalling)
+        # with an in-process replicated coordinator, the returned cmds ARE
+        # the leader state machine's live objects — the status/retries
+        # mutations below must never touch replicated state directly
+        # (leader would transiently fork from followers)
+        cmds = [copy.deepcopy(c) for c in cmds]
         from dingo_tpu.raft.core import NotLeader
 
         for cmd in cmds:
@@ -392,7 +409,8 @@ class StoreNode:
                     self._done_cmd_ids.popitem(last=False)
             except NotLeader as e:
                 # leadership moved: hand the command to the hinted leader
-                # ("<store>/r<region>" address) or back to the queue
+                # ("<store>/r<region>" address) or nack it back to the
+                # coordinator's queue (re-armed on the next beat)
                 if e.leader_hint:
                     hinted_store = e.leader_hint.split("/")[0]
                     self.coordinator.requeue_cmd(
@@ -400,14 +418,17 @@ class StoreNode:
                     )
                 else:
                     cmd.status = "pending"
+                    self._stalled_cmds.add(cmd.cmd_id)
             except Exception as e:  # noqa: BLE001
-                # transient failures retry on later heartbeats; give up
-                # after a budget so poison commands don't loop forever
-                cmd.retries += 1
-                cmd.status = "pending" if cmd.retries < 5 else f"error: {e}"
+                # transient failure: nack so the coordinator re-arms the
+                # cmd next beat (the coordinator owns the retry budget —
+                # the local objects are copies, mutating them cannot reach
+                # its queues)
+                cmd.status = f"failed: {e}"
+                self._failed_cmds.add(cmd.cmd_id)
                 region_log(_log, cmd.region_id).warning(
-                    "cmd %d type=%s attempt %d failed: %s", cmd.cmd_id,
-                    cmd.cmd_type.value, cmd.retries, e)
+                    "cmd %d type=%s failed (nacking): %s", cmd.cmd_id,
+                    cmd.cmd_type.value, e)
         return cmds
 
     def start_heartbeat(self, interval_s: float = 1.0) -> None:

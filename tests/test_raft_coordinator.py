@@ -241,3 +241,52 @@ def test_meta_and_kv_replicate():
         assert rev >= 2
     finally:
         stop_all(coords)
+
+
+def test_nack_rearms_failed_cmds_without_touching_leader_state():
+    """Round-4 advisor: stores mutate COPIES of queue cmds; failures are
+    re-delivered through the explicit nack channel (failed_cmd_ids) with a
+    coordinator-owned retry budget."""
+    import time as _t
+
+    _, coords = make_cluster()
+    try:
+        leader = wait_leader(coords)
+        leader.control.register_store("s1")
+        d = leader.control.create_region(b"a", b"z", replication=1)
+
+        # beat 1: deliver the CREATE; nothing mutates the SM's objects
+        cmds = leader.control.store_heartbeat("s1")
+        assert [c.cmd_id for c in cmds]
+        cmd_id = cmds[0].cmd_id
+        sm_cmd = next(c for c in leader.sm.control.store_ops["s1"]
+                      if c.cmd_id == cmd_id)
+        # the "store" fails execution: it only reports the nack — no
+        # direct status write on the delivered object reaches the SM
+        assert sm_cmd.status == "sent"
+        # a STALLED report (election churn) re-arms without charging the
+        # retry budget
+        leader.control.store_heartbeat("s1", stalled_cmd_ids=[cmd_id])
+        sm_cmd = next(c for c in leader.sm.control.store_ops["s1"]
+                      if c.cmd_id == cmd_id)
+        assert sm_cmd.retries == 0
+        leader.control.store_heartbeat("s1", failed_cmd_ids=[cmd_id])
+        # re-armed and re-delivered (same beat pops it back to sent)
+        sm_cmd = next(c for c in leader.sm.control.store_ops["s1"]
+                      if c.cmd_id == cmd_id)
+        assert sm_cmd.retries == 1
+        # keep failing: budget exhausted -> cmd dropped, job errored
+        for _ in range(5):
+            leader.control.store_heartbeat("s1", failed_cmd_ids=[cmd_id])
+        assert all(c.cmd_id != cmd_id
+                   for c in leader.sm.control.store_ops["s1"])
+        job = next(j for j in leader.sm.control.jobs
+                   if j.cmd_id == cmd_id)
+        assert job.status.startswith("error")
+        # every replica agrees (the nack rode the raft log)
+        _t.sleep(0.5)
+        for c in coords:
+            j = next(j for j in c.sm.control.jobs if j.cmd_id == cmd_id)
+            assert j.status.startswith("error"), c.node.id
+    finally:
+        stop_all(coords)
