@@ -93,6 +93,29 @@ def build_parser() -> argparse.ArgumentParser:
     kget = kv.add_parser("get")
     kget.add_argument("key")
 
+    doc = sub.add_parser("document").add_subparsers(dest="cmd")
+    dcreate = doc.add_parser("create-region")
+    dcreate.add_argument("--partition", type=int, default=0)
+    dcreate.add_argument("--id-lo", type=int, default=0)
+    dcreate.add_argument("--id-hi", type=int, default=1 << 40)
+    dcreate.add_argument("--schema", default="",
+                         help="name:type,... (types: text/i64/f64/bytes/"
+                              "bool); empty = schemaless")
+    dadd = doc.add_parser("add")
+    dadd.add_argument("--region", type=int, required=True)
+    dadd.add_argument("--id", type=int, required=True)
+    dadd.add_argument("fields", nargs="+",
+                      help="name=value pairs (value parsed as JSON when "
+                           "possible, else string)")
+    dsearch = doc.add_parser("search")
+    dsearch.add_argument("--region", type=int, required=True)
+    dsearch.add_argument("--topk", type=int, default=10)
+    dsearch.add_argument("--mode", default="query",
+                         choices=("query", "or", "and", "phrase"))
+    dsearch.add_argument("query")
+    dcount = doc.add_parser("count")
+    dcount.add_argument("--region", type=int, required=True)
+
     txn = sub.add_parser("txn").add_subparsers(dest="cmd")
     tput = txn.add_parser("put")          # one-shot transactional put
     tput.add_argument("key")
@@ -197,6 +220,14 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _document_region(client: DingoClient, region_id: int):
+    client.refresh_region_map()
+    d = next((r for r in client._regions if r.region_id == region_id), None)
+    if d is None:
+        print(f"region {region_id} not found", file=sys.stderr)
+    return d
+
+
 def run_command(client: DingoClient, args) -> int:
     g, c = args.group, getattr(args, "cmd", None)
     if g == "coordinator" and c == "hello":
@@ -255,6 +286,59 @@ def run_command(client: DingoClient, args) -> int:
     elif g == "kv" and c == "get":
         v = client.kv_get(args.key.encode())
         print(v.decode() if v is not None else "(nil)")
+    elif g == "document" and c == "create-region":
+        schema = None
+        if args.schema:
+            schema = {}
+            for part in args.schema.split(","):
+                name, _, ftype = part.strip().partition(":")
+                schema[name] = ftype or "text"
+        d = client.create_document_region(
+            args.partition, args.id_lo, args.id_hi, schema=schema)
+        print(json.dumps({"region_id": d.region_id, "peers": d.peers,
+                          "schema": schema}))
+    elif g == "document" and c == "add":
+        from dingo_tpu.server.convert import scalar_to_pb
+
+        doc_fields = {}
+        for pair in args.fields:
+            name, _, raw = pair.partition("=")
+            try:
+                doc_fields[name] = json.loads(raw)
+            except ValueError:
+                doc_fields[name] = raw
+        d = _document_region(client, args.region)
+        if d is None:
+            return 1
+        req = pb.DocumentAddRequest()
+        req.context.region_id = args.region
+        e = req.documents.add()
+        e.id = args.id
+        scalar_to_pb(e.fields, doc_fields)
+        resp = client._call_leader(d, "DocumentService", "DocumentAdd", req)
+        print(json.dumps({"added": 1, "ts": resp.ts}))
+    elif g == "document" and c == "search":
+        d = _document_region(client, args.region)
+        if d is None:
+            return 1
+        req = pb.DocumentSearchRequest()
+        req.context.region_id = args.region
+        req.query = args.query
+        req.mode = args.mode
+        req.top_n = args.topk
+        resp = client._call_leader(
+            d, "DocumentService", "DocumentSearch", req)
+        print(json.dumps([[doc.id, round(doc.score, 4)]
+                          for doc in resp.documents]))
+    elif g == "document" and c == "count":
+        d = _document_region(client, args.region)
+        if d is None:
+            return 1
+        resp = client._call_leader(
+            d, "DocumentService", "DocumentCount",
+            pb.DocumentCountRequest(
+                context=pb.Context(region_id=args.region)))
+        print(json.dumps({"count": resp.count}))
     elif g == "txn" and c == "put":
         t = client.begin_txn(pessimistic=args.pessimistic)
         key = args.key.encode()

@@ -294,3 +294,57 @@ def test_unknown_schema_type_rejected_at_coordinator():
             b"a", b"z", region_type=RegionType.DOCUMENT,
             document_schema={"c": "decimal"},
         )
+
+
+def test_cli_document_verbs(capsys):
+    """Operator CLI: document create-region/add/search/count with a typed
+    schema and query-language search."""
+    import json as _json
+    import time as _time
+
+    from dingo_tpu.client.cli import main
+    from dingo_tpu.coordinator.control import CoordinatorControl
+    from dingo_tpu.coordinator.kv_control import KvControl
+    from dingo_tpu.coordinator.tso import TsoControl
+    from dingo_tpu.engine.raw_engine import MemEngine
+    from dingo_tpu.raft import LocalTransport
+    from dingo_tpu.server.rpc import DingoServer
+    from dingo_tpu.store.node import StoreNode
+
+    transport = LocalTransport()
+    me = MemEngine()
+    control = CoordinatorControl(me, replication=1)
+    cs = DingoServer()
+    cs.host_coordinator_role(control, TsoControl(me), KvControl(me))
+    cport = cs.start()
+    n = StoreNode("s0", transport, control, raft_kw={"seed": 0})
+    srv = DingoServer()
+    srv.host_store_role(n)
+    port = srv.start()
+    n.start_heartbeat(0.1)
+    base = ["--coordinator", f"127.0.0.1:{cport}",
+            "--store", f"s0=127.0.0.1:{port}"]
+    try:
+        assert main(base + ["document", "create-region",
+                            "--schema", "text:text,price:i64"]) == 0
+        rid = _json.loads(capsys.readouterr().out)["region_id"]
+        _time.sleep(0.8)
+        assert main(base + ["document", "add", "--region", str(rid),
+                            "--id", "1", "text=cheap red shirt",
+                            "price=10"]) == 0
+        capsys.readouterr()
+        assert main(base + ["document", "add", "--region", str(rid),
+                            "--id", "2", "text=pricey red coat",
+                            "price=200"]) == 0
+        capsys.readouterr()
+        assert main(base + ["document", "count", "--region",
+                            str(rid)]) == 0
+        assert _json.loads(capsys.readouterr().out)["count"] == 2
+        assert main(base + ["document", "search", "--region", str(rid),
+                            "red price:[* TO 100]"]) == 0
+        hits = _json.loads(capsys.readouterr().out)
+        assert [h[0] for h in hits] == [1]
+    finally:
+        srv.stop()
+        cs.stop()
+        n.stop()
