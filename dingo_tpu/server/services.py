@@ -615,7 +615,7 @@ class StoreService:
 
     def KvGet(self, req: pb.KvGetRequest) -> pb.KvGetResponse:
         resp = pb.KvGetResponse()
-        region = _region_or_err(self.node, req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         value = self.node.storage.kv_get(region, req.key)
@@ -643,7 +643,7 @@ class StoreService:
 
     def KvBatchGet(self, req: pb.KvBatchGetRequest):
         resp = pb.KvBatchGetResponse()
-        region = _region_or_err(self.node, req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         if not _keys_in_region_or_err(region, list(req.keys), resp):
@@ -730,7 +730,7 @@ class StoreService:
 
     def KvScan(self, req: pb.KvScanRequest) -> pb.KvScanResponse:
         resp = pb.KvScanResponse()
-        region = _region_or_err(self.node, req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -765,20 +765,23 @@ class StoreService:
     # ---- scan sessions (ScanManager v1/v2 + Stream paging) ----
     def KvScanBegin(self, req: pb.KvScanBeginRequest) -> pb.KvScanBeginResponse:
         resp = pb.KvScanBeginResponse()
-        region = _region_or_err(self.node, req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         from dingo_tpu.engine.raw_engine import CF_DEFAULT
         from dingo_tpu.mvcc.codec import MAX_TS
         from dingo_tpu.mvcc.reader import Reader as MvccReader
 
+        clamped = _clamp_range_or_err(
+            region, req.range.start_key, req.range.end_key, resp)
+        if clamped is None:
+            return resp
         reader = MvccReader(self.node.raw, CF_DEFAULT)
         # materialize at open: the session must be a stable snapshot —
         # paging a live iterator would skip/repeat keys under concurrent
         # writes (the reference ScanManager pins a snapshot the same way)
         snapshot = tuple(reader.iter_visible(
-            req.range.start_key, req.range.end_key,
-            req.context.read_ts or MAX_TS,
+            clamped[0], clamped[1], req.context.read_ts or MAX_TS,
         ))
         stream = _SCAN_SESSIONS.streams.open(iter(snapshot),
                                              limit=req.page_size or 100)
@@ -814,10 +817,17 @@ class StoreService:
         return resp
 
     # ---- txn ----
-    def _txn_region_or_err(self, context_pb, resp):
-        """Txn RPCs are leader-gated — reads included: a follower lagging
-        raft apply would serve snapshots missing already-committed writes
-        (the reference serves the whole txn surface through the leader)."""
+    def _leader_region_or_err(self, context_pb, resp):
+        """KV and txn RPCs are leader-gated — reads included: a follower
+        lagging raft apply would serve state missing already-committed
+        writes (the reference serves reads through the raft leader; write
+        RPCs would fail at propose anyway, this just fails them earlier
+        with the routing hint). Caveat: this is a ROLE check, not a
+        read-index/leader-lease pass — a deposed leader that has not yet
+        seen the new term can still serve a bounded-stale read during a
+        partition (closing that window needs read-index or check-quorum
+        in raft/core.py; tracked, matches the coordinator's documented
+        stale-read stance in coordinator/raft_meta.py)."""
         region = _region_or_err(self.node, context_pb, resp)
         if region is None:
             return None
@@ -830,7 +840,7 @@ class StoreService:
 
     def TxnPrewrite(self, req: pb.TxnPrewriteRequest):
         resp = pb.TxnPrewriteResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         muts = [
@@ -848,7 +858,7 @@ class StoreService:
 
     def TxnCommit(self, req: pb.TxnCommitRequest):
         resp = pb.TxnCommitResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -859,7 +869,7 @@ class StoreService:
 
     def TxnGet(self, req: pb.TxnGetRequest):
         resp = pb.TxnGetResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -872,7 +882,7 @@ class StoreService:
 
     def TxnScan(self, req: pb.TxnScanRequest):
         resp = pb.TxnScanResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -901,7 +911,7 @@ class StoreService:
 
     def TxnBatchRollback(self, req: pb.TxnBatchRollbackRequest):
         resp = pb.TxnBatchRollbackResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -912,7 +922,7 @@ class StoreService:
 
     def TxnCheckStatus(self, req: pb.TxnCheckStatusRequest):
         resp = pb.TxnCheckStatusResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         st = self._txn(region).check_txn_status(
@@ -926,7 +936,7 @@ class StoreService:
     # Txn RPCs; engine semantics live in engine/txn.py) ----------------------
     def TxnPessimisticLock(self, req: pb.TxnPessimisticLockRequest):
         resp = pb.TxnPessimisticLockResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -940,7 +950,7 @@ class StoreService:
 
     def TxnPessimisticRollback(self, req: pb.TxnPessimisticRollbackRequest):
         resp = pb.TxnPessimisticRollbackResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -952,7 +962,7 @@ class StoreService:
 
     def TxnResolveLock(self, req: pb.TxnResolveLockRequest):
         resp = pb.TxnResolveLockResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -966,7 +976,7 @@ class StoreService:
 
     def TxnHeartBeat(self, req: pb.TxnHeartBeatRequest):
         resp = pb.TxnHeartBeatResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -978,7 +988,7 @@ class StoreService:
 
     def TxnGc(self, req: pb.TxnGcRequest):
         resp = pb.TxnGcResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -998,7 +1008,7 @@ class StoreService:
 
     def TxnScanLock(self, req: pb.TxnScanLockRequest):
         resp = pb.TxnScanLockResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         from dingo_tpu.mvcc.codec import MAX_TS as _MAX_TS
@@ -1013,7 +1023,7 @@ class StoreService:
 
     def TxnBatchGet(self, req: pb.TxnBatchGetRequest):
         resp = pb.TxnBatchGetResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -1030,7 +1040,7 @@ class StoreService:
 
     def TxnCheckSecondaryLocks(self, req: pb.TxnCheckSecondaryLocksRequest):
         resp = pb.TxnCheckSecondaryLocksResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         st = self._txn(region).check_secondary_locks(
@@ -1043,7 +1053,7 @@ class StoreService:
 
     def TxnDeleteRange(self, req: pb.TxnDeleteRangeRequest):
         resp = pb.TxnDeleteRangeResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         try:
@@ -1055,7 +1065,7 @@ class StoreService:
 
     def TxnDump(self, req: pb.TxnDumpRequest):
         resp = pb.TxnDumpResponse()
-        region = self._txn_region_or_err(req.context, resp)
+        region = self._leader_region_or_err(req.context, resp)
         if region is None:
             return resp
         d = self._txn(region).dump(

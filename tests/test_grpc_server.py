@@ -472,3 +472,29 @@ def test_table_filter_over_grpc(cluster):
     )
     for row in res_post:
         assert all(vid % 4 == 0 for vid, _ in row), row
+
+
+def test_kv_reads_leader_gated(cluster):
+    """A follower must not serve KV reads (its apply can lag committed
+    writes); it answers 20001 with the leader hint so clients re-route —
+    same contract as the txn surface."""
+    client, control, nodes = cluster
+    # reuse the module's KV region over [a, z) (module-scoped cluster)
+    client.refresh_region_map()
+    d = client._region_for_key(b"gate-k")
+    rid = d.region_id
+    client.kv_put(b"gate-k", b"v")
+
+    follower = next(
+        sid for sid, n in nodes.items()
+        if (r := n.engine.get_node(rid)) is not None and not r.is_leader()
+    )
+    stub = client._stub(follower, "StoreService")
+    kreq = pb.KvGetRequest()
+    kreq.context.region_id = rid
+    kreq.key = b"gate-k"
+    resp = stub.KvGet(kreq)
+    assert resp.error.errcode == 20001, resp
+    assert "not leader" in resp.error.errmsg
+    # leader-routed read still works (SDK rotation)
+    assert client.kv_get(b"gate-k") == b"v"
