@@ -86,6 +86,8 @@ class RaftNode:
         #: node's own candidacy resets (that conflation livelocked
         #: failover: survivors mutually refused pre-votes)
         self._last_leader_contact = 0.0
+        #: leader-side: last time each peer answered an RPC (check-quorum)
+        self._peer_last_ack: Dict[str, float] = {}
         self._stop = threading.Event()
         self._appliers_busy = False
 
@@ -117,14 +119,21 @@ class RaftNode:
         up via normal replication / snapshot install."""
         with self._lock:
             new_peers = [p for p in peer_ids if p != self.id]
+            now = time.monotonic()
             for p in new_peers:
                 if p not in self.next_index:
                     self.next_index[p] = self.log.last_index() + 1
                     self.match_index[p] = 0
+                    # full check-quorum grace window, like a fresh leader:
+                    # an epoch ack would count the new peer as
+                    # unreachable-forever and could depose a healthy
+                    # leader on the very tick the membership change applies
+                    self._peer_last_ack[p] = now
             for p in list(self.next_index):
                 if p not in new_peers and p != self.id:
                     self.next_index.pop(p, None)
                     self.match_index.pop(p, None)
+                    self._peer_last_ack.pop(p, None)
             self.peers = new_peers
 
     # ------------- public: leadership transfer -------------
@@ -180,6 +189,7 @@ class RaftNode:
                 role = self.role
             if role == LEADER:
                 self._broadcast_append()
+                self._check_quorum()
                 self._stop.wait(self.heartbeat_interval)
             else:
                 now = time.monotonic()
@@ -286,6 +296,10 @@ class RaftNode:
                 self.next_index = {p: last + 1 for p in self.peers}
                 self.match_index = {p: 0 for p in self.peers}
                 self.match_index[self.id] = last
+                # fresh check-quorum clock: the new leader gets a full
+                # window before reachability is judged
+                now = time.monotonic()
+                self._peer_last_ack = {p: now for p in self.peers}
                 cb = self.on_leader_start
             else:
                 return
@@ -312,6 +326,34 @@ class RaftNode:
             cb(leader, term)
 
     # ------------- replication (leader side) -------------
+    def _check_quorum(self) -> None:
+        """Check-quorum (braft parity): a leader that cannot reach a
+        majority within ~2 election timeouts steps down. Without this, a
+        partitioned-away leader keeps role=LEADER until it SEES a higher
+        term — which the partition prevents — and the leader-gated read
+        paths would serve reads missing the new leader's commits
+        indefinitely. With it, the stale-read window is bounded by the
+        check window."""
+        window = 2.0 * self.election_timeout[1]
+        with self._lock:
+            if self.role != LEADER or not self.peers:
+                return
+            now = time.monotonic()
+            reachable = 1 + sum(
+                1 for p in self.peers
+                if now - self._peer_last_ack.get(p, 0.0) <= window
+            )
+            quorum = (len(self.peers) + 1) // 2 + 1
+            if reachable >= quorum:
+                return
+            self.role = FOLLOWER
+            self.leader_id = None
+            self._deadline = now + self._rand_timeout()
+        _log.warning(
+            "%s stepping down (check-quorum): %d/%d peers reachable in "
+            "%.2fs window", self.id, reachable - 1, len(self.peers), window,
+        )
+
     def _broadcast_append(self) -> None:
         for peer in self.peers:
             self._replicate_to(peer)
@@ -348,6 +390,9 @@ class RaftNode:
         })
         if resp is None:
             return
+        with self._lock:
+            # any response proves reachability (check-quorum input)
+            self._peer_last_ack[peer] = time.monotonic()
         if resp["term"] > term:
             self._step_down(resp["term"])
             return
@@ -385,6 +430,8 @@ class RaftNode:
         })
         if resp is None:
             return
+        with self._lock:
+            self._peer_last_ack[peer] = time.monotonic()
         if resp["term"] > term:
             self._step_down(resp["term"])
             return

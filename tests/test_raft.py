@@ -233,3 +233,47 @@ def test_pre_vote_failover_latency():
         assert time.monotonic() - t0 < 3.0
     finally:
         stop_all(nodes)
+
+
+def test_check_quorum_deposes_partitioned_leader():
+    """A leader cut off from every peer steps down within ~2 election
+    timeouts instead of serving leader-gated reads forever (check-quorum,
+    braft parity). The majority side elects a fresh leader; after heal the
+    old leader rejoins as follower."""
+    transport, nodes, _applied = make_cluster(
+        election_timeout=(0.1, 0.2), heartbeat_interval=0.03,
+    )
+    try:
+        leader = wait_leader(nodes)
+
+        for p in nodes:
+            if p != leader.id:
+                transport.partition(leader.id, p)
+
+        # the old leader must step down on its own (no higher term can
+        # reach it through the partition)
+        deadline = time.monotonic() + 3.0
+        while leader.is_leader() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not leader.is_leader(), (
+            "partitioned leader kept serving as leader (check-quorum)")
+
+        # majority side elected a replacement
+        deadline = time.monotonic() + 3.0
+        new_leader = None
+        while new_leader is None and time.monotonic() < deadline:
+            new_leader = next(
+                (n for n in nodes.values()
+                 if n is not leader and n.is_leader()), None)
+            time.sleep(0.02)
+        assert new_leader is not None
+
+        transport.heal()
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if not leader.is_leader() and leader.leader_id == new_leader.id:
+                break
+            time.sleep(0.02)
+        assert not leader.is_leader()
+    finally:
+        stop_all(nodes)

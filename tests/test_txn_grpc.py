@@ -215,20 +215,26 @@ def test_cli_txn_verbs(cluster, capsys):
     for sid, addr in client._store_addrs.items():
         base += ["--store", f"{sid}={addr}"]
 
-    assert main(base + ["txn", "put", "k1", "v1"]) == 0
-    out = _json.loads(capsys.readouterr().out)
+    def retry_cli(args, attempts=3):
+        # election churn under single-core suite load can outlast the
+        # SDK's built-in retry window; the CLI exits 1 then — retry
+        import time as _t
+
+        for i in range(attempts):
+            if main(args) == 0:
+                return capsys.readouterr().out
+            capsys.readouterr()
+            _t.sleep(0.5)
+        raise AssertionError(f"CLI failed {attempts}x: {args}")
+
+    out = _json.loads(retry_cli(base + ["txn", "put", "k1", "v1"]))
     assert out["commit_ts"] > out["start_ts"]
-    assert main(base + ["txn", "put", "k2", "v2", "--pessimistic"]) == 0
-    capsys.readouterr()
-    assert main(base + ["txn", "get", "k2"]) == 0
-    assert capsys.readouterr().out.strip() == "v2"
-    assert main(base + ["txn", "scan-locks"]) == 0
-    assert _json.loads(
-        capsys.readouterr().out.strip().splitlines()[-1])["locks"] == 0
-    assert main(base + ["txn", "resolve", "--start-ts", "1"]) == 0
-    capsys.readouterr()
-    assert main(base + ["txn", "gc", "--safe-ts", "1"]) == 0
-    capsys.readouterr()
+    retry_cli(base + ["txn", "put", "k2", "v2", "--pessimistic"])
+    assert retry_cli(base + ["txn", "get", "k2"]).strip() == "v2"
+    out = retry_cli(base + ["txn", "scan-locks"])
+    assert _json.loads(out.strip().splitlines()[-1])["locks"] == 0
+    retry_cli(base + ["txn", "resolve", "--start-ts", "1"])
+    retry_cli(base + ["txn", "gc", "--safe-ts", "1"])
     rid = client._region_for_key(b"k1").region_id
     assert main(base + ["txn", "dump", "--region", str(rid)]) == 0
     d = _json.loads(capsys.readouterr().out)
