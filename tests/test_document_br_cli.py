@@ -467,3 +467,31 @@ def test_remote_br_backup_restore_and_dump(tmp_path, capsys):
             s.stop()
         for n in nodes2.values():
             n.stop()
+
+
+def test_cli_repl_smoke(tmp_path, capsys, monkeypatch):
+    """REPL parses group commands, survives bad input, and exits cleanly
+    (client_v2 interactive mode analog)."""
+    from dingo_tpu.client.cli import main
+
+    base, nodes, servers = _mk_grpc_cluster(
+        seed=21, snapdir=str(tmp_path / "snap"), stores=("s0",))
+    try:
+        lines = iter([
+            "coordinator hello",
+            "bogus nonsense here",     # parse error must not kill the loop
+            "coordinator tso",
+            "exit",
+        ])
+        monkeypatch.setattr("builtins.input", lambda *_: next(lines))
+        assert main(base + ["repl"]) == 0
+        out = capsys.readouterr().out
+        assert '"stores": 1' in out       # hello answered
+        assert "error:" not in out        # tso answered too (the REPL's
+        # blanket handler would swallow a failure into an 'error:' line)
+        assert out.count("dingo>") == 0   # prompt goes through input()
+    finally:
+        for s in servers:
+            s.stop()
+        for n in nodes.values():
+            n.stop()
