@@ -23,7 +23,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG_PATH = os.path.join(REPO, "tools", "tpu_supervisor.log")
 PID_PATH = os.path.join(REPO, "tools", "tpu_supervisor.pid")
 STATE_PATH = os.path.join(REPO, "TPU_WATCHER_STATE.json")
-DEADLINE_S = 11.0 * 3600
+DEADLINE_S = 11.75 * 3600
 RESPAWN_BACKOFF_S = 20
 QUEUE_STEPS = {"smoke", "bench_row2", "row1_flat", "row4_hnsw", "row3_ivfpq"}
 

@@ -42,7 +42,7 @@ PID_PATH = os.path.join(REPO, "tools", "tpu_watcher.pid")
 
 PROBE_TIMEOUT_S = 120
 PROBE_INTERVAL_S = 240
-ROUND_DEADLINE_S = 11.0 * 3600  # stop probing near end of round
+ROUND_DEADLINE_S = 11.75 * 3600  # stop probing near end of round
 
 # (name, argv, timeout_s). Ordered by value: the row-2 bench IS the round
 # deliverable; smoke first because it validates the Pallas kernels the bench
