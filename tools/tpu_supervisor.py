@@ -20,10 +20,14 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+from tpu_watcher import ROUND_DEADLINE_S as DEADLINE_S  # noqa: E402 — one
+# constant governs both processes (deadline drift caused a respawn/state-
+# reset loop in review)
+
 LOG_PATH = os.path.join(REPO, "tools", "tpu_supervisor.log")
 PID_PATH = os.path.join(REPO, "tools", "tpu_supervisor.pid")
 STATE_PATH = os.path.join(REPO, "TPU_WATCHER_STATE.json")
-DEADLINE_S = 11.75 * 3600
 RESPAWN_BACKOFF_S = 20
 QUEUE_STEPS = {"smoke", "bench_row2", "row1_flat", "row4_hnsw", "row3_ivfpq"}
 
@@ -42,10 +46,31 @@ def queue_complete() -> bool:
     return QUEUE_STEPS <= set(st.get("done", {}))
 
 
+def _other_supervisor_alive() -> bool:
+    try:
+        with open(PID_PATH) as f:
+            pid = int(f.read().strip())
+        if pid != os.getpid():
+            os.kill(pid, 0)   # raises if dead
+            return True
+    except (OSError, ValueError):
+        pass
+    return False
+
+
 def main() -> None:
+    if _other_supervisor_alive():
+        # two supervisors would race two watchers on the state file and
+        # contend for the single axon lease (which wedges under contention)
+        log(f"another supervisor is alive ({PID_PATH}); refusing to start")
+        return
     with open(PID_PATH, "w") as f:
         f.write(str(os.getpid()))
-    start = time.time()
+    try:
+        with open(STATE_PATH) as f:
+            start = json.load(f).get("started", time.time())
+    except (OSError, ValueError):
+        start = time.time()
     log(f"supervisor up pid={os.getpid()}")
     while time.time() - start < DEADLINE_S:
         if queue_complete():

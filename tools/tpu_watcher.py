@@ -42,6 +42,8 @@ PID_PATH = os.path.join(REPO, "tools", "tpu_watcher.pid")
 
 PROBE_TIMEOUT_S = 120
 PROBE_INTERVAL_S = 240
+# single source of truth for the round deadline (tpu_supervisor.py imports
+# this constant — editing it here adjusts both processes in lockstep)
 ROUND_DEADLINE_S = 11.75 * 3600  # stop probing near end of round
 
 # (name, argv, timeout_s). Ordered by value: the row-2 bench IS the round
@@ -70,9 +72,12 @@ def load_state() -> dict:
     except (OSError, ValueError):
         return fresh
     # a state file left by a PREVIOUS round must not satisfy this one: its
-    # 'done' results came from old code and its 'started' would make the
-    # deadline check exit immediately
-    if time.time() - st.get("started", 0) > ROUND_DEADLINE_S:
+    # 'done' results came from old code. Only discard CLEARLY old state
+    # (two deadlines) — state merely past THIS round's deadline must
+    # survive, or a deadline-exit + supervisor respawn would reset
+    # 'started' and grant a whole new probing window bleeding into the
+    # next round (r5 review finding)
+    if time.time() - st.get("started", 0) > 2 * ROUND_DEADLINE_S:
         log("discarding stale watcher state from a previous round")
         return fresh
     return st
@@ -170,7 +175,7 @@ def main() -> None:
     st = load_state()
     start = st.get("started", time.time())
     log(f"watcher up pid={os.getpid()} done={list(st['done'])}")
-    while time.time() - start < ROUND_DEADLINE_S:
+    while time.time() - st.get("started", start) < ROUND_DEADLINE_S:
         pending = [q for q in QUEUE if q[0] not in st["done"]]
         if not pending:
             log("queue complete; watcher exiting")
