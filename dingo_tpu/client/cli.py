@@ -138,6 +138,12 @@ def build_parser() -> argparse.ArgumentParser:
     dbg = sub.add_parser("debug").add_subparsers(dest="cmd")
     met = dbg.add_parser("metrics")
     met.add_argument("--store", dest="target_store", required=True)
+    tr = dbg.add_parser("trace")
+    tr.add_argument("--store", dest="target_store", required=True)
+    tr.add_argument("--chrome", action="store_true",
+                    help="Chrome trace_event form (chrome://tracing / "
+                         "Perfetto / tools/trace_report.py) instead of "
+                         "the grouped-by-trace JSON")
     fp = dbg.add_parser("failpoint")
     fp.add_argument("--store", dest="target_store", required=True)
     fp.add_argument("name")
@@ -375,6 +381,12 @@ def run_command(client: DingoClient, args) -> int:
     elif g == "debug" and c == "metrics":
         stub = client._stub(args.target_store, "DebugService")
         print(stub.MetricsDump(pb.MetricsDumpRequest()).json)
+    elif g == "debug" and c == "trace":
+        stub = client._stub(args.target_store, "DebugService")
+        if args.chrome:
+            print(stub.TraceChromeDump(pb.MetricsDumpRequest()).json)
+        else:
+            print(stub.TraceDump(pb.MetricsDumpRequest()).json)
     elif g == "debug" and c == "failpoint":
         stub = client._stub(args.target_store, "DebugService")
         r = stub.FailPoint(pb.FailPointRequest(

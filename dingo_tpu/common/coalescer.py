@@ -12,6 +12,12 @@ caller gets its slice back.
 Latency math on the axon tunnel: the D2H hop is ~60-80 ms, so a ~2 ms
 collection window is noise for the requests it helps and a large QPS
 multiplier under concurrency.
+
+Tracing: each submit opens a ``coalesce.wait`` span (queue time) as a
+child of the caller's current span; the batch run opens ``coalesce.run``
+parented to the FIRST sampled waiter and attaches it on the flush thread,
+so device-side spans nest into that caller's trace across the handoff.
+The batch size and co-batched trace ids ride as span attributes.
 """
 
 from __future__ import annotations
@@ -23,13 +29,20 @@ from typing import Any, Callable, Dict, List, Sequence, Tuple
 
 import numpy as np
 
+from dingo_tpu.trace import NOOP_SPAN, TRACER
+
+
+class CoalescerStopped(RuntimeError):
+    """Set on futures whose batch was discarded by stop(drain=False)."""
+
 
 class _PendingBatch:
     __slots__ = ("queries", "futures", "created")
 
     def __init__(self):
         self.queries: List[np.ndarray] = []
-        self.futures: List[Tuple[Future, int]] = []   # (future, n_queries)
+        # (future, n_queries, wait_span) per submit
+        self.futures: List[Tuple[Future, int, Any]] = []
         self.created = time.monotonic()
 
 
@@ -69,11 +82,13 @@ class SearchCoalescer:
         limit each request individually respects."""
         cap = min(self.max_batch, max_batch or self.max_batch)
         fut: Future = Future()
+        wait_span = TRACER.start_span("coalesce.wait")
         flush_now = None
         flush_first = None
         with self._lock:
             if self._stop:
-                raise RuntimeError("coalescer stopped")
+                wait_span.end()
+                raise CoalescerStopped("coalescer stopped")
             batch = self._pending.get(key)
             if batch is not None and (
                 sum(len(q) for q in batch.queries) + len(queries) > cap
@@ -88,7 +103,7 @@ class SearchCoalescer:
             if batch is None:
                 batch = self._pending[key] = _PendingBatch()
             batch.queries.append(np.asarray(queries))
-            batch.futures.append((fut, len(queries)))
+            batch.futures.append((fut, len(queries), wait_span))
             if sum(len(q) for q in batch.queries) >= cap:
                 flush_now = self._pending.pop(key)
         if flush_first is not None:
@@ -106,17 +121,46 @@ class SearchCoalescer:
 
     # -- flushing ------------------------------------------------------------
     def _run(self, key: Any, batch: _PendingBatch) -> None:
+        # queue-wait ends here; the run span parents to the first sampled
+        # waiter so the device work lands in ITS trace, with the rest of
+        # the batch recorded as co-batched trace links
+        run_span = NOOP_SPAN
+        links = []
+        for _, _, ws in batch.futures:
+            ws.end()
+            if ws.sampled:
+                if run_span is NOOP_SPAN:
+                    run_span = TRACER.start_span(
+                        "coalesce.run", parent=ws.context
+                    )
+                else:
+                    links.append(f"{ws.trace_id:016x}")
+        if run_span is not NOOP_SPAN:
+            run_span.set_attr("batch_size",
+                              sum(len(q) for q in batch.queries))
+            run_span.set_attr("requests", len(batch.futures))
+            run_span.set_attr(
+                "queue_wait_us",
+                int((time.monotonic() - batch.created) * 1e6),
+            )
+            if links:
+                run_span.set_attr("cobatched_traces", links)
+        token = run_span.attach()
         try:
             stacked = np.concatenate(batch.queries, axis=0)
             results = self.run_fn(key, stacked)
             off = 0
-            for fut, n in batch.futures:
+            for fut, n, _ in batch.futures:
                 fut.set_result(list(results[off:off + n]))
                 off += n
         except Exception as e:  # noqa: BLE001
-            for fut, _ in batch.futures:
+            run_span.set_error(e)
+            for fut, _, _ in batch.futures:
                 if not fut.done():
                     fut.set_exception(e)
+        finally:
+            run_span.detach(token)
+            run_span.end()
 
     def _flush_loop(self) -> None:
         timeout = None   # nothing pending: sleep until a submit wakes us
@@ -143,12 +187,23 @@ class SearchCoalescer:
             for key, batch in due:
                 self._run(key, batch)
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = True) -> None:
+        """Shut down. drain=True runs pending batches to completion so
+        in-flight callers get results; drain=False fails their futures
+        with CoalescerStopped. Either way every pending future resolves
+        deterministically — nobody is left hung on a dead timer thread."""
         with self._lock:
             self._stop = True
             leftovers = list(self._pending.items())
             self._pending.clear()
         self._wake.set()
         for key, batch in leftovers:
-            self._run(key, batch)
+            if drain:
+                self._run(key, batch)
+            else:
+                exc = CoalescerStopped("coalescer stopped before flush")
+                for fut, _, ws in batch.futures:
+                    ws.end()
+                    if not fut.done():
+                        fut.set_exception(exc)
         self._thread.join(timeout=2)

@@ -119,6 +119,15 @@ FLAGS.define("use_mesh_sharded_ivfpq", False, mutable=True,
 FLAGS.define("mesh_dim_axis", 1, mutable=True,
              help_="size of the mesh 'dim' (tensor-parallel) axis used by "
                    "mesh-sharded indexes; 'data' axis = n_devices // dim")
+FLAGS.define("trace_sampling_rate", 0.0, mutable=True,
+             help_="fraction of ingress requests recording a full span "
+                   "tree into dingo_tpu/trace (0 disables; 1 records "
+                   "everything). Decided once at the trace root; children "
+                   "and remote hops inherit the decision")
+FLAGS.define("slow_query_ms", 500.0, mutable=True,
+             help_="a sampled root span slower than this lands in the "
+                   "slow-query log (retained separately from the span "
+                   "ring so fast-trace churn cannot evict slow evidence)")
 FLAGS.define("use_pallas_ivf_search", "auto", mutable=True,
              help_="route trained IVF_FLAT searches through the Pallas "
                    "list-DMA kernel (streams only probed buckets to VMEM; "

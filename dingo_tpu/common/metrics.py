@@ -83,25 +83,33 @@ class LatencyRecorder:
     def time(self) -> "_Timer":
         return self._Timer(self)
 
+    @staticmethod
+    def _pick(ordered: List[float], p: float) -> float:
+        """Percentile over a pre-sorted window; 0.0 on an empty window
+        (metrics endpoints poll before the first sample — never raise)."""
+        if not ordered:
+            return 0.0
+        i = min(len(ordered) - 1, int(p / 100.0 * len(ordered)))
+        return ordered[i]
+
     def percentile(self, p: float) -> float:
         with self._lock:
-            if not self._samples:
-                return 0.0
-            ordered = sorted(self._samples)
-            i = min(len(ordered) - 1, int(p / 100.0 * len(ordered)))
-            return ordered[i]
+            return self._pick(sorted(self._samples), p)
 
     def stats(self) -> Dict[str, float]:
+        # one snapshot + one sort for every derived figure (p50 and p99
+        # used to re-sort the window under separate lock acquisitions)
         with self._lock:
-            n = len(self._samples)
-            avg = sum(self._samples) / n if n else 0.0
+            ordered = sorted(self._samples)
+            count = self._count
+        n = len(ordered)
         elapsed = max(time.monotonic() - self._t0, 1e-9)
         return {
-            "count": self._count,
-            "qps": self._count / elapsed,
-            "avg_us": avg,
-            "p50_us": self.percentile(50),
-            "p99_us": self.percentile(99),
+            "count": count,
+            "qps": count / elapsed,
+            "avg_us": sum(ordered) / n if n else 0.0,
+            "p50_us": self._pick(ordered, 50),
+            "p99_us": self._pick(ordered, 99),
         }
 
 

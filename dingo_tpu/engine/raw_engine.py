@@ -26,6 +26,8 @@ from dingo_tpu.raft import wire
 import threading
 from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
+from dingo_tpu.trace import TRACER
+
 # Column family names (common/constant.h:43-55)
 CF_DEFAULT = "default"
 CF_META = "meta"
@@ -194,15 +196,17 @@ class MemEngine(RawEngine):
             return self.cf(cf).get(key)
 
     def write(self, batch: WriteBatch) -> None:
-        with self._lock:
-            for op, cf, a, b in batch.ops:
-                kv = self.cf(cf)
-                if op == "put":
-                    kv.put(a, b)
-                elif op == "del":
-                    kv.delete(a)
-                elif op == "delr":
-                    kv.delete_range(a, b)
+        with TRACER.start_span("engine.write") as span:
+            span.set_attr("ops", len(batch.ops))
+            with self._lock:
+                for op, cf, a, b in batch.ops:
+                    kv = self.cf(cf)
+                    if op == "put":
+                        kv.put(a, b)
+                    elif op == "del":
+                        kv.delete(a)
+                    elif op == "delr":
+                        kv.delete_range(a, b)
 
     def scan(self, cf, start=b"", end=None):
         with self._lock:
@@ -320,7 +324,8 @@ class WalEngine(MemEngine):
         # one lock serializes WAL append + memtable apply + rotation:
         # multiple raft apply threads share this engine, and a rotation
         # closing self._wal mid-append would drop an acked write
-        with self._wal_lock:
+        with TRACER.start_span("engine.wal_write") as span, self._wal_lock:
+            span.set_attr("bytes", len(blob))
             self._wal.write(struct.pack(">II", _WAL_MAGIC, len(blob)) + blob)
             self._wal.flush()
             if self.fsync:

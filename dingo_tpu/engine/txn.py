@@ -34,6 +34,7 @@ from dingo_tpu.engine.concurrency import ConcurrencyManager
 from dingo_tpu.engine.write_data import TxnRaftData
 from dingo_tpu.mvcc.codec import MAX_TS, Codec
 from dingo_tpu.store.region import Region
+from dingo_tpu.trace import TRACER
 
 
 class TxnError(Exception):
@@ -187,9 +188,11 @@ class TxnEngine:
         for_update_ts: int = 0,
     ) -> None:
         """TxnEngineHelper::Prewrite (txn_engine_helper.h:199)."""
-        with self.cm.with_keys([m.key for m in mutations]):
-            self._prewrite_locked(mutations, primary, start_ts, lock_ttl_ms,
-                                  for_update_ts)
+        with TRACER.start_span("txn.prewrite") as span:
+            span.set_attr("mutations", len(mutations))
+            with self.cm.with_keys([m.key for m in mutations]):
+                self._prewrite_locked(mutations, primary, start_ts,
+                                      lock_ttl_ms, for_update_ts)
 
     def _prewrite_locked(self, mutations, primary, start_ts, lock_ttl_ms,
                          for_update_ts):
@@ -224,8 +227,10 @@ class TxnEngine:
 
     def commit(self, keys: Sequence[bytes], start_ts: int, commit_ts: int) -> None:
         """TxnEngineHelper::Commit (:209)."""
-        with self.cm.with_keys(keys):
-            self._commit_locked(keys, start_ts, commit_ts)
+        with TRACER.start_span("txn.commit") as span:
+            span.set_attr("keys", len(keys))
+            with self.cm.with_keys(keys):
+                self._commit_locked(keys, start_ts, commit_ts)
 
     def _commit_locked(self, keys, start_ts, commit_ts):
         puts, deletes = [], []
@@ -392,6 +397,10 @@ class TxnEngine:
     # -- reads ---------------------------------------------------------------
     def get(self, key: bytes, read_ts: int) -> Optional[bytes]:
         """Snapshot-isolated point read."""
+        with TRACER.start_span("txn.get"):
+            return self._get_impl(key, read_ts)
+
+    def _get_impl(self, key: bytes, read_ts: int) -> Optional[bytes]:
         lock = self.get_lock(key)
         if (
             lock is not None
@@ -413,6 +422,14 @@ class TxnEngine:
         self, start_key: bytes, end_key: bytes, read_ts: int, limit: int = 0
     ) -> List[Tuple[bytes, bytes]]:
         """Snapshot scan over the write CF (TxnIterator analog)."""
+        with TRACER.start_span("txn.scan") as span:
+            out = self._scan_impl(start_key, end_key, read_ts, limit)
+            span.set_attr("rows", len(out))
+            return out
+
+    def _scan_impl(
+        self, start_key: bytes, end_key: bytes, read_ts: int, limit: int = 0
+    ) -> List[Tuple[bytes, bytes]]:
         out: List[Tuple[bytes, bytes]] = []
         current: Optional[bytes] = None
         resolved = False

@@ -146,6 +146,11 @@ class _SlotStoreIndex(VectorIndex):
         # serializing at resolve time.
         dists.copy_to_host_async()
         slots.copy_to_host_async()
+        # trace hook OUTSIDE the device lock: a sampled request blocks for
+        # a true kernel-time span without stalling concurrent searches
+        from dingo_tpu.ops.distance import device_wait_span
+
+        device_wait_span("flat_scan", (dists, slots))
         def resolve() -> List[SearchResult]:
             try:
                 dists_h, slots_h = jax.device_get((dists, slots))

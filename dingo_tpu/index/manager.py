@@ -31,6 +31,7 @@ from dingo_tpu.index.vector_reader import ReaderContext, VectorReader
 from dingo_tpu.index.wrapper import VectorIndexWrapper
 from dingo_tpu.raft.log import RaftLog
 from dingo_tpu.store.region import Region
+from dingo_tpu.trace import TRACER
 
 _log = get_logger("index.manager")
 
@@ -68,7 +69,6 @@ class VectorIndexManager:
         reader = self._reader(region)
 
         ids_batch, vec_batch = [], []
-        train_sample = []
 
         def flush():
             if ids_batch:
@@ -78,21 +78,25 @@ class VectorIndexManager:
                 ids_batch.clear()
                 vec_batch.clear()
 
-        rows = reader.vector_scan_query(0, limit=1 << 62, with_vector_data=True)
-        if index.need_train():
-            # TrainForBuild (:1365): train on the scanned sample first
-            sample = [r.vector for r in rows]
-            if sample:
-                try:
-                    index.train(np.stack(sample))
-                except Exception:
-                    pass  # too little data: stays untrained (hybrid/fallback)
-        for r in rows:
-            ids_batch.append(r.id)
-            vec_batch.append(r.vector)
-            if len(ids_batch) >= BUILD_BATCH:
-                flush()
-        flush()
+        with TRACER.start_span("index.build") as span:
+            rows = reader.vector_scan_query(
+                0, limit=1 << 62, with_vector_data=True)
+            if index.need_train():
+                # TrainForBuild (:1365): train on the scanned sample first
+                sample = [r.vector for r in rows]
+                if sample:
+                    try:
+                        index.train(np.stack(sample))
+                    except Exception:
+                        pass  # too little data: stays untrained (fallback)
+            for r in rows:
+                ids_batch.append(r.id)
+                vec_batch.append(r.vector)
+                if len(ids_batch) >= BUILD_BATCH:
+                    flush()
+            flush()
+            span.set_attr("region_id", region.id)
+            span.set_attr("rows", len(rows))
         return index
 
     # ---------------- catch-up + switch ----------------
@@ -136,6 +140,9 @@ class VectorIndexManager:
             self.rebuild_running += 1
             self.rebuild_total += 1
         region_log(_log, region.id).info("index rebuild starting")
+        span = TRACER.start_span("index.rebuild")
+        span.set_attr("region_id", region.id)
+        token = span.attach()
         try:
             if raft_log is None:
                 # No WAL to replay: hold the wrapper lock across scan+swap so
@@ -154,10 +161,13 @@ class VectorIndexManager:
             index.apply_log_id = start_log_id
             self._catch_up_and_install(wrapper, index, region, raft_log)
             return True
-        except Exception:
+        except Exception as e:
+            span.set_error(e)
             wrapper.build_error = True
             raise
         finally:
+            span.detach(token)
+            span.end()
             with self._lock:
                 self._rebuilding.discard(region.id)
                 self.rebuild_running -= 1
@@ -169,14 +179,17 @@ class VectorIndexManager:
         if end < start:
             return 0
         n = 0
-        for log_id, _term, payload in raft_log.get_data_entries(start, end):
-            data = wd.decode_write(payload)
-            if isinstance(data, wd.VectorAddData):
-                index.upsert(data.ids, data.vectors)
-            elif isinstance(data, wd.VectorDeleteData):
-                index.delete(data.ids)
-            index.apply_log_id = log_id
-            n += 1
+        with TRACER.start_span("index.catchup") as span:
+            for log_id, _term, payload in raft_log.get_data_entries(start, end):
+                data = wd.decode_write(payload)
+                if isinstance(data, wd.VectorAddData):
+                    index.upsert(data.ids, data.vectors)
+                elif isinstance(data, wd.VectorDeleteData):
+                    index.delete(data.ids)
+                index.apply_log_id = log_id
+                n += 1
+            span.set_attr("region_id", region.id)
+            span.set_attr("entries", n)
         return n
 
     # ---------------- save / load (snapshots) ----------------
@@ -189,7 +202,8 @@ class VectorIndexManager:
         wrapper = region.vector_index_wrapper
         assert wrapper is not None and wrapper.own_index is not None
         path = self.snapshot_path(region.id)
-        with wrapper._lock:
+        with TRACER.start_span("index.save") as span, wrapper._lock:
+            span.set_attr("region_id", region.id)
             wrapper.own_index.save(path)
             wrapper.snapshot_log_id = wrapper.apply_log_id
             wrapper.write_count = 0

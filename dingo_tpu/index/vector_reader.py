@@ -48,6 +48,7 @@ from dingo_tpu.index.wrapper import VectorIndexWrapper
 from dingo_tpu.mvcc.codec import MAX_TS
 from dingo_tpu.mvcc.reader import Reader as MvccReader
 from dingo_tpu.raft import wire
+from dingo_tpu.trace import TRACER
 
 #: FLAGS_vector_index_bruteforce_batch_count (vector_reader.cc:61)
 BRUTEFORCE_BATCH = 2048
@@ -174,6 +175,28 @@ class VectorReader:
         topk: int,
         filter_mode: VectorFilterMode = VectorFilterMode.NONE,
         filter_type: VectorFilterType = VectorFilterType.QUERY_POST,
+        **kw,
+    ) -> List[List[VectorWithData]]:
+        """Batch search. When `stage_us` (kw) is a dict it receives
+        per-stage wall times in microseconds (prefilter/search/postfilter/
+        backfill/total) — the VectorSearchDebug contract
+        (vector_reader.h:85-88)."""
+        with TRACER.start_span("index.search") as span:
+            if span.sampled:
+                span.set_attr("region_id", self.ctx.region_id)
+                span.set_attr("batch", int(np.atleast_2d(queries).shape[0]))
+                span.set_attr("topk", int(topk))
+                span.set_attr("filter_mode", filter_mode.value)
+            return self._batch_search_impl(
+                queries, topk, filter_mode, filter_type, **kw
+            )
+
+    def _batch_search_impl(
+        self,
+        queries: np.ndarray,
+        topk: int,
+        filter_mode: VectorFilterMode = VectorFilterMode.NONE,
+        filter_type: VectorFilterType = VectorFilterType.QUERY_POST,
         scalar_filter: Optional[ScalarFilter] = None,
         vector_ids: Optional[Sequence[int]] = None,
         coprocessor=None,
@@ -182,9 +205,6 @@ class VectorReader:
         stage_us: Optional[dict] = None,
         **search_kw,
     ) -> List[List[VectorWithData]]:
-        """Batch search. When `stage_us` is a dict it receives per-stage
-        wall times in microseconds (prefilter/search/postfilter/backfill/
-        total) — the VectorSearchDebug contract (vector_reader.h:85-88)."""
         import time as _time
 
         t_start = _time.perf_counter_ns()
@@ -377,6 +397,14 @@ class VectorReader:
         (the reference builds a temp faiss flat per 2,048-vector batch and
         merges per-query top-k heaps; one TPU flat over the scan is the same
         result with fewer kernel launches)."""
+        with TRACER.start_span("index.bruteforce") as span:
+            out = self._brute_force_search_impl(queries, topk, spec)
+            span.set_attr("batch", len(queries))
+            return out
+
+    def _brute_force_search_impl(
+        self, queries: np.ndarray, topk: int, spec: FilterSpec
+    ) -> List[SearchResult]:
         if self.ctx.parameter is None:
             raise VectorIndexError("brute force needs index parameter (dim)")
         dim = self.ctx.parameter.dimension

@@ -177,3 +177,22 @@ def scores_to_distances(scores: jax.Array, metric: Metric) -> jax.Array:
     if metric_ascending(metric):
         return -scores
     return scores
+
+
+def device_wait_span(name: str, value):
+    """Trace hook for device dispatch sites: when the current trace is
+    sampled, block until `value` (any jax pytree) is ready inside an
+    ``ops.<name>`` span, so the span measures real kernel time instead of
+    async-dispatch time. Otherwise value passes through untouched — one
+    sampled-check, no synchronization, no allocation (the span name is
+    only built once the check passes); a dispatch with no surrounding
+    request trace is never timed, so background kernels don't mint
+    single-span root traces."""
+    from dingo_tpu.trace import TRACER, current_span
+
+    cur = current_span()
+    if cur is None or not cur.sampled:
+        return value
+    with TRACER.start_span("ops." + name):
+        jax.block_until_ready(value)
+    return value

@@ -193,4 +193,7 @@ def ivf_list_search(
         vprobes, queries, buckets, bucket_sqnorm, bucket_valid, bucket_slot,
         k=k, ascending=ascending, interpret=interpret,
     )
+    from dingo_tpu.ops.distance import device_wait_span
+
+    vals, slots = device_wait_span("pallas_ivf_search", (vals, slots))
     return vals[:b], slots[:b]

@@ -312,28 +312,35 @@ class TpuShardedFlat(VectorIndex):
 
     def search_async(self, queries, topk, filter_spec: Optional[FilterSpec] = None,
                      **kw):
-        queries = self._prep(np.atleast_2d(np.asarray(queries, np.float32)))
-        q = jax.device_put(
-            jnp.asarray(queries), NamedSharding(self.mesh, P(None, "dim"))
-        )
-        with self._device_lock:
-            # capture valid/vecs AND the gslot translation table inside the
-            # lock: a concurrent donated scatter invalidates the arrays and
-            # a growth remaps the gslot space
-            if filter_spec is None or filter_spec.is_empty():
-                valid = self._store.valid
-            else:
-                mask = filter_spec.slot_mask(self.ids_by_gslot)
-                valid = jax.device_put(
-                    jnp.asarray(mask) & self._store.valid,
-                    NamedSharding(self.mesh, P("data")),
-                )
-            vals, gslots = self._store._search_jit(
-                self._store.vecs, self._store.sqnorm, valid, q, int(topk)
+        from dingo_tpu.parallel.tracing import shard_search_span
+
+        with shard_search_span("parallel.flat.search", self.mesh) as span:
+            queries = self._prep(np.atleast_2d(np.asarray(queries, np.float32)))
+            q = jax.device_put(
+                jnp.asarray(queries), NamedSharding(self.mesh, P(None, "dim"))
             )
-            ids_by_gslot = self.ids_by_gslot.copy()
-        vals.copy_to_host_async()
-        gslots.copy_to_host_async()
+            with self._device_lock:
+                # capture valid/vecs AND the gslot translation table inside
+                # the lock: a concurrent donated scatter invalidates the
+                # arrays and a growth remaps the gslot space
+                if filter_spec is None or filter_spec.is_empty():
+                    valid = self._store.valid
+                else:
+                    mask = filter_spec.slot_mask(self.ids_by_gslot)
+                    valid = jax.device_put(
+                        jnp.asarray(mask) & self._store.valid,
+                        NamedSharding(self.mesh, P("data")),
+                    )
+                vals, gslots = self._store._search_jit(
+                    self._store.vecs, self._store.sqnorm, valid, q, int(topk)
+                )
+                ids_by_gslot = self.ids_by_gslot.copy()
+            vals.copy_to_host_async()
+            gslots.copy_to_host_async()
+            if span.sampled:
+                # sampled requests trade pipelining for a true kernel span
+                span.set_attr("batch", int(len(queries)))
+                jax.block_until_ready((vals, gslots))
         ascending = self.metric is Metric.L2
 
         def resolve() -> List[SearchResult]:

@@ -403,26 +403,33 @@ class TpuShardedIvfFlat(TpuShardedFlat):
                      nprobe: Optional[int] = None, **kw):
         if not self.is_trained():
             raise NotTrained("sharded IVF_FLAT not trained")
-        queries = self._prep(np.atleast_2d(np.asarray(queries, np.float32)))
-        b = queries.shape[0]
-        nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
-        qpad = jnp.asarray(_pad_batch(queries))
-        with self._device_lock:
-            if self._view_dirty:
-                self._rebuild_view()
-            view = self._view
-            bval = self._bucket_valid_for_filter(filter_spec)
-            q = jax.device_put(
-                qpad, NamedSharding(self.mesh, P(None, None))
-            )
-            vals, gslots = self._ivf_search_jit(
-                view.buckets, view.bucket_sqnorm, bval, view.bucket_slot,
-                view.probe_table, self.centroids, self._c_sqnorm, q,
-                jnp.int32(self.cap_per_shard),
-                k=int(topk), nprobe=int(nprobe),
-                max_spill=int(view.max_spill),
-            )
-            ids_by_gslot = self.ids_by_gslot.copy()
+        from dingo_tpu.parallel.tracing import shard_search_span
+
+        with shard_search_span("parallel.ivf.search", self.mesh) as span:
+            queries = self._prep(np.atleast_2d(np.asarray(queries, np.float32)))
+            b = queries.shape[0]
+            nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
+            qpad = jnp.asarray(_pad_batch(queries))
+            with self._device_lock:
+                if self._view_dirty:
+                    self._rebuild_view()
+                view = self._view
+                bval = self._bucket_valid_for_filter(filter_spec)
+                q = jax.device_put(
+                    qpad, NamedSharding(self.mesh, P(None, None))
+                )
+                vals, gslots = self._ivf_search_jit(
+                    view.buckets, view.bucket_sqnorm, bval, view.bucket_slot,
+                    view.probe_table, self.centroids, self._c_sqnorm, q,
+                    jnp.int32(self.cap_per_shard),
+                    k=int(topk), nprobe=int(nprobe),
+                    max_spill=int(view.max_spill),
+                )
+                ids_by_gslot = self.ids_by_gslot.copy()
+            if span.sampled:
+                span.set_attr("batch", b)
+                span.set_attr("nprobe", int(nprobe))
+                jax.block_until_ready((vals, gslots))
         return self._make_resolve(vals, gslots, b, ids_by_gslot)
 
     # -- lifecycle -----------------------------------------------------------

@@ -379,39 +379,47 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
                      nprobe: Optional[int] = None, **kw):
         if not self.is_trained():
             raise NotTrained("sharded IVF_PQ not trained")
-        queries = self._prep(np.atleast_2d(np.asarray(queries, np.float32)))
-        b = queries.shape[0]
-        nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
-        qpad = jnp.asarray(_pad_batch(queries))
-        k = int(topk)
-        kprime = max(
-            k, min(self.get_count() or k,
-                   k * int(FLAGS.get("ivfpq_rerank_factor") or 1))
-        )
-        with self._device_lock:
-            if self._view_dirty:
-                self._rebuild_view()
-            view = self._pq_view
-            bval = self._pq_bucket_valid_for_filter(filter_spec)
-            q = jax.device_put(
-                qpad, NamedSharding(self.mesh, P(None, None))
+        from dingo_tpu.parallel.tracing import shard_search_span
+
+        with shard_search_span("parallel.pq.search", self.mesh) as span:
+            queries = self._prep(np.atleast_2d(np.asarray(queries, np.float32)))
+            b = queries.shape[0]
+            nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
+            qpad = jnp.asarray(_pad_batch(queries))
+            k = int(topk)
+            kprime = max(
+                k, min(self.get_count() or k,
+                       k * int(FLAGS.get("ivfpq_rerank_factor") or 1))
             )
-            # per-(query, coarse-list) LUT sharing is worthwhile only while
-            # the [b, nprobe, m, ksub] table stays comfortably in HBM
-            lut_bytes = (
-                qpad.shape[0] * nprobe * self.m * self.ksub * 4
-            )
-            vals, gslots = self._pq_search_jit(
-                view.code_buckets, bval, view.bucket_slot,
-                view.bucket_coarse, view.probe_table,
-                self._store.vecs, self._store.sqnorm,
-                self.centroids, self._c_sqnorm, self.codebooks, q,
-                jnp.int32(self.cap_per_shard),
-                k=k, kprime=int(kprime), nprobe=int(nprobe),
-                max_spill=int(view.max_spill),
-                precompute_lut=lut_bytes <= 256 * 1024 * 1024,
-            )
-            ids_by_gslot = self.ids_by_gslot.copy()
+            with self._device_lock:
+                if self._view_dirty:
+                    self._rebuild_view()
+                view = self._pq_view
+                bval = self._pq_bucket_valid_for_filter(filter_spec)
+                q = jax.device_put(
+                    qpad, NamedSharding(self.mesh, P(None, None))
+                )
+                # per-(query, coarse-list) LUT sharing is worthwhile only
+                # while the [b, nprobe, m, ksub] table stays comfortably
+                # in HBM
+                lut_bytes = (
+                    qpad.shape[0] * nprobe * self.m * self.ksub * 4
+                )
+                vals, gslots = self._pq_search_jit(
+                    view.code_buckets, bval, view.bucket_slot,
+                    view.bucket_coarse, view.probe_table,
+                    self._store.vecs, self._store.sqnorm,
+                    self.centroids, self._c_sqnorm, self.codebooks, q,
+                    jnp.int32(self.cap_per_shard),
+                    k=k, kprime=int(kprime), nprobe=int(nprobe),
+                    max_spill=int(view.max_spill),
+                    precompute_lut=lut_bytes <= 256 * 1024 * 1024,
+                )
+                ids_by_gslot = self.ids_by_gslot.copy()
+            if span.sampled:
+                span.set_attr("batch", b)
+                span.set_attr("nprobe", int(nprobe))
+                jax.block_until_ready((vals, gslots))
         return self._make_resolve(vals, gslots, b, ids_by_gslot)
 
     # -- lifecycle -----------------------------------------------------------

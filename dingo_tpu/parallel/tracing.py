@@ -1,0 +1,31 @@
+"""Shared tracing wrapper for the mesh-sharded search fan-outs.
+
+One context manager instead of three copies of the start/attr/error/end
+boilerplate in sharded_flat / sharded_ivf / sharded_pq. Kept free of any
+sharded-store import so it loads even where shard_map is unavailable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def shard_search_span(name: str, mesh):
+    """Span around a sharded search dispatch: records the mesh fan-out,
+    marks errors, and always ends — the body decides whether to pay
+    block_until_ready for a true kernel-time measurement (sampled only)."""
+    from dingo_tpu.trace import TRACER
+
+    span = TRACER.start_span(name)
+    if span.sampled:
+        for axis in ("data", "dim"):
+            if axis in mesh.shape:
+                span.set_attr(f"{axis}_shards", mesh.shape[axis])
+    try:
+        yield span
+    except BaseException as e:
+        span.set_error(e)
+        raise
+    finally:
+        span.end()

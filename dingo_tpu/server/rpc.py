@@ -12,8 +12,17 @@ from typing import Dict, Optional, Tuple
 
 import grpc
 
+from dingo_tpu.common.config import FLAGS
 from dingo_tpu.raft.core import NotLeader
 from dingo_tpu.server import pb
+from dingo_tpu.trace import (
+    TRACE_METADATA_KEY,
+    TRACER,
+    UNSAMPLED_HEADER,
+    current_span,
+    extract_metadata,
+    inject_metadata,
+)
 from dingo_tpu.server.services import (
     CoordinatorService,
     DebugService,
@@ -142,6 +151,10 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
     },
     "DebugService": {
         "MetricsDump": (pb.MetricsDumpRequest, pb.MetricsDumpResponse),
+        # trace exports reuse the MetricsDump message pair (json payload);
+        # the method name alone routes — no proto regen needed
+        "TraceDump": (pb.MetricsDumpRequest, pb.MetricsDumpResponse),
+        "TraceChromeDump": (pb.MetricsDumpRequest, pb.MetricsDumpResponse),
         "FailPoint": (pb.FailPointRequest, pb.FailPointResponse),
     },
     "CoordinatorService": {
@@ -211,13 +224,41 @@ def _register(server: grpc.Server, service_name: str, impl) -> None:
         fn = getattr(impl, method)
 
         def make(fn, req_t, resp_t, method):
+            span_name = f"rpc.{service_name}.{method}"
+
             def handler(request, context):
+                # trace ingress: adopt the caller's context from metadata
+                # (one distributed trace across client -> server -> raft
+                # hops) or mint a root here; attaching makes every deeper
+                # span — coalescer, reader, kernels — a descendant
+                parent = extract_metadata(context.invocation_metadata())
+                span = TRACER.start_span(span_name, parent=parent)
+                # always-sample-slow: an unsampled request still gets a
+                # two-clock-read watch so outlier latency is never lost
+                slow_t0 = 0 if span.sampled else TRACER.slow_watch_start()
+                # attach only when a sampling DECISION exists (sampled,
+                # an upstream header, or a local rate roll). A rate-0
+                # ingress with no header must leave the context clean —
+                # otherwise nested outbound calls would propagate '0-0-0'
+                # for a decision nobody made and permanently suppress
+                # sampling on downstream servers that have tracing on
+                decided = (
+                    span.sampled or parent is not None
+                    or FLAGS.get("trace_sampling_rate") > 0
+                )
+                token = span.attach() if decided else None
                 try:
-                    return fn(request)
+                    resp = fn(request)
+                    if span.sampled and getattr(
+                        getattr(resp, "error", None), "errcode", 0
+                    ):
+                        span.set_attr("errcode", resp.error.errcode)
+                    return resp
                 except NotLeader as e:
                     # replicated-coordinator followers (raft_meta proxies)
                     # surface the hint so clients re-route, same contract
                     # as store-side region writes
+                    span.set_attr("errcode", 20001)
                     resp = resp_t()
                     if hasattr(resp, "error"):
                         resp.error.errcode = 20001
@@ -230,11 +271,17 @@ def _register(server: grpc.Server, service_name: str, impl) -> None:
 
                     get_logger("rpc").exception(
                         "%s.%s failed", service_name, method)
+                    span.set_error(e)
                     resp = resp_t()
                     if hasattr(resp, "error"):
                         resp.error.errcode = 99999
                         resp.error.errmsg = f"{type(e).__name__}: {e}"
                     return resp
+                finally:
+                    if token is not None:
+                        span.detach(token)
+                    span.end()
+                    TRACER.slow_watch_end(span_name, slow_t0)
 
             return handler
 
@@ -325,6 +372,38 @@ class DingoServer:
         self._server.stop(grace)
 
 
+class _TracedCall:
+    """Wraps a unary-unary multicallable: egress span + trace metadata
+    injection so server-side spans join the caller's trace. Unsampled
+    calls pass metadata through untouched (one sampled-check)."""
+
+    __slots__ = ("_call", "_name")
+
+    def __init__(self, call, name: str):
+        self._call = call
+        self._name = name
+
+    def __call__(self, request, timeout=None, metadata=None, **kwargs):
+        with TRACER.start_span(self._name) as span:
+            if span.sampled:
+                metadata = inject_metadata(metadata)
+            elif current_span() is not None \
+                    or FLAGS.get("trace_sampling_rate") > 0:
+                # a decision WAS made — locally (rate > 0) or upstream
+                # (an attached noop from an adopted '0-0-0' header):
+                # propagate it so downstream servers don't re-roll and
+                # mint fragment roots mid-request. With tracing fully off
+                # and no inherited decision we send nothing — that path
+                # stays allocation-free
+                metadata = [
+                    *(metadata or ()),
+                    (TRACE_METADATA_KEY, UNSAMPLED_HEADER),
+                ]
+            return self._call(
+                request, timeout=timeout, metadata=metadata, **kwargs
+            )
+
+
 class ServiceStub:
     """Minimal client-side stub (the grpc codegen plugin is absent)."""
 
@@ -332,8 +411,8 @@ class ServiceStub:
         self._channel = channel
         self._service = service_name
         for method, (req_t, resp_t) in SERVICE_SCHEMA[service_name].items():
-            setattr(self, method, channel.unary_unary(
+            setattr(self, method, _TracedCall(channel.unary_unary(
                 f"/dingo_tpu.{service_name}/{method}",
                 request_serializer=req_t.SerializeToString,
                 response_deserializer=resp_t.FromString,
-            ))
+            ), f"client.{service_name}.{method}"))

@@ -177,6 +177,13 @@ class IndexService:
         region = _region_or_err(self.node, req.context, resp)
         if region is None:
             return resp, None
+        from dingo_tpu.trace import current_span
+
+        ingress = current_span()
+        if ingress is not None and ingress.sampled:
+            ingress.set_attr("region_id", region.id)
+            ingress.set_attr("batch", len(req.vectors))
+            ingress.set_attr("topn", req.parameter.top_n or 10)
         lat = METRICS.latency("vector_search", region.id)
         t0 = time.perf_counter_ns()
         try:
@@ -1315,6 +1322,25 @@ class DebugService:
     def MetricsDump(self, req: pb.MetricsDumpRequest) -> pb.MetricsDumpResponse:
         resp = pb.MetricsDumpResponse()
         resp.json = json.dumps(METRICS.dump())
+        return resp
+
+    def TraceDump(self, req: pb.MetricsDumpRequest) -> pb.MetricsDumpResponse:
+        """Sampled span buffer + slow-query log as JSON (spans grouped by
+        trace id) — the RPC face of dingo_tpu/trace."""
+        from dingo_tpu.trace import to_json
+
+        resp = pb.MetricsDumpResponse()
+        resp.json = json.dumps(to_json())
+        return resp
+
+    def TraceChromeDump(self, req: pb.MetricsDumpRequest):
+        """Same buffer in Chrome trace_event form: save the payload to a
+        file and open it in chrome://tracing / Perfetto, or feed it to
+        tools/trace_report.py for a per-stage latency table."""
+        from dingo_tpu.trace import to_chrome_trace
+
+        resp = pb.MetricsDumpResponse()
+        resp.json = json.dumps(to_chrome_trace())
         return resp
 
     def FailPoint(self, req: pb.FailPointRequest) -> pb.FailPointResponse:

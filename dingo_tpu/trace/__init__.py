@@ -1,0 +1,51 @@
+"""Distributed tracing & query profiling.
+
+The reference attributes latency with ad-hoc bvar recorders and a
+per-request Tracker (src/common/tracker.h) that never leaves the process.
+This package adds real causality: every RPC ingress mints (or adopts) a
+trace id, spans nest through contextvars across the coalescer's thread
+handoffs, gRPC metadata carries the context between processes, and a
+bounded ring buffer retains sampled traces for the DebugService JSON dump
+and a Chrome ``trace_event`` file (chrome://tracing / Perfetto).
+
+Overhead contract: with ``trace_sampling_rate = 0`` every instrumented
+site costs ONE sampled-check (a contextvar read + flag read) and returns
+the shared no-op span — no allocations on the hot path.
+"""
+
+from dingo_tpu.trace.buffer import TRACE_BUFFER, TraceBuffer
+from dingo_tpu.trace.export import (
+    dump_chrome_trace,
+    to_chrome_trace,
+    to_json,
+)
+from dingo_tpu.trace.span import (
+    NOOP_SPAN,
+    TRACE_METADATA_KEY,
+    UNSAMPLED_HEADER,
+    Span,
+    SpanContext,
+    TRACER,
+    Tracer,
+    current_span,
+    extract_metadata,
+    inject_metadata,
+)
+
+__all__ = [
+    "NOOP_SPAN",
+    "Span",
+    "SpanContext",
+    "TRACER",
+    "TRACE_BUFFER",
+    "TRACE_METADATA_KEY",
+    "TraceBuffer",
+    "Tracer",
+    "UNSAMPLED_HEADER",
+    "current_span",
+    "dump_chrome_trace",
+    "extract_metadata",
+    "inject_metadata",
+    "to_chrome_trace",
+    "to_json",
+]

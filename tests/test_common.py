@@ -112,6 +112,43 @@ def test_metrics_registry():
     assert dump["search{region=7}"]["p99_us"] >= 1500
 
 
+def test_latency_recorder_empty_window_is_safe():
+    """percentile()/stats() on a fresh recorder return zeros, never raise
+    (metrics endpoints poll before the first request lands)."""
+    from dingo_tpu.common.metrics import LatencyRecorder
+
+    lr = LatencyRecorder()
+    assert lr.percentile(50) == 0.0
+    assert lr.percentile(99) == 0.0
+    assert lr.percentile(100) == 0.0
+    st = lr.stats()
+    assert st["count"] == 0
+    assert st["avg_us"] == 0.0
+    assert st["p50_us"] == 0.0 and st["p99_us"] == 0.0
+    assert st["qps"] >= 0.0
+
+
+def test_metrics_dump_per_region_dimension():
+    """dump() keeps the region dimension distinct from the global series
+    and from other regions (StoreBvarMetrics multi-dimension contract)."""
+    m = MetricsRegistry()
+    m.counter("req").add(1)
+    m.counter("req", region_id=1).add(2)
+    m.counter("req", region_id=2).add(5)
+    m.latency("lat", region_id=1).observe_us(100.0)
+    m.latency("lat")  # empty window rides along in the dump
+    dump = m.dump()
+    assert dump["req"] == 1
+    assert dump["req{region=1}"] == 2
+    assert dump["req{region=2}"] == 5
+    assert dump["lat{region=1}"]["count"] == 1
+    assert dump["lat{region=1}"]["avg_us"] == 100.0
+    assert dump["lat"]["count"] == 0          # empty window dumps as zeros
+    # same (name, region) resolves to the same instance
+    m.counter("req", region_id=1).add(1)
+    assert m.dump()["req{region=1}"] == 3
+
+
 def test_stream_paging():
     sm = StreamManager(idle_timeout_s=0.05)
     s = sm.open(iter(range(25)), limit=10)

@@ -1,0 +1,263 @@
+"""Trace propagation over real gRPC: metadata carries the context
+client -> server, and a full VectorSearch through the coalescer produces
+one connected multi-span trace, exported via the debug RPCs and as a
+valid Chrome trace_event file."""
+
+import json
+
+import grpc
+import numpy as np
+import pytest
+
+from dingo_tpu.common.config import FLAGS
+from dingo_tpu.coordinator.control import CoordinatorControl
+from dingo_tpu.coordinator.kv_control import KvControl
+from dingo_tpu.coordinator.tso import TsoControl
+from dingo_tpu.engine.raw_engine import MemEngine
+from dingo_tpu.raft import LocalTransport
+from dingo_tpu.server import pb
+from dingo_tpu.server.rpc import DingoServer, ServiceStub, _register
+from dingo_tpu.server.services import DebugService
+from dingo_tpu.store.node import StoreNode
+from dingo_tpu.trace import TRACE_BUFFER, TRACER, to_chrome_trace
+
+
+@pytest.fixture()
+def sampled():
+    TRACE_BUFFER.clear()
+    FLAGS.set("trace_sampling_rate", 1.0)
+    try:
+        yield
+    finally:
+        FLAGS.set("trace_sampling_rate", 0.0)
+        TRACE_BUFFER.clear()
+
+
+def test_grpc_metadata_propagation_roundtrip(sampled):
+    """Client span context rides gRPC metadata; the server ingress span
+    joins the SAME trace with the client span as parent."""
+    server = DingoServer()
+    _register(server._server, "DebugService", DebugService())
+    port = server.start()
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        stub = ServiceStub(chan, "DebugService")
+        with TRACER.start_span("test.client_root") as root:
+            stub.MetricsDump(pb.MetricsDumpRequest())
+            trace_id = f"{root.trace_id:016x}"
+        spans = {r["name"]: r
+                 for r in TRACE_BUFFER.snapshot(trace_id=trace_id)}
+        assert "client.DebugService.MetricsDump" in spans
+        assert "rpc.DebugService.MetricsDump" in spans
+        # cross-process link: server parent == client egress span id
+        assert spans["rpc.DebugService.MetricsDump"]["parent_id"] == \
+            spans["client.DebugService.MetricsDump"]["span_id"]
+        assert spans["client.DebugService.MetricsDump"]["parent_id"] == \
+            spans["test.client_root"]["span_id"]
+    finally:
+        chan.close()
+        server.stop()
+
+
+def test_grpc_unsampled_sends_no_metadata():
+    """With sampling off the stub must not add metadata (and the server
+    must not record)."""
+    FLAGS.set("trace_sampling_rate", 0.0)
+    TRACE_BUFFER.clear()
+    server = DingoServer()
+    _register(server._server, "DebugService", DebugService())
+    port = server.start()
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        stub = ServiceStub(chan, "DebugService")
+        stub.MetricsDump(pb.MetricsDumpRequest())
+        assert TRACE_BUFFER.snapshot() == []
+    finally:
+        chan.close()
+        server.stop()
+
+
+def test_grpc_propagates_unsampled_decision(sampled):
+    """At 0 < rate < 1 an unsampled root's decision rides the metadata as
+    '0-0-0' so downstream servers do NOT re-roll and mint fragment roots
+    mid-request."""
+    FLAGS.set("trace_sampling_rate", 0.5)
+    server = DingoServer()
+    _register(server._server, "DebugService", DebugService())
+    port = server.start()
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        stub = ServiceStub(chan, "DebugService")
+        for _ in range(40):
+            stub.MetricsDump(pb.MetricsDumpRequest())
+        # every recorded server span must be linked to a client span of
+        # the same trace — no server-side roots (fragments) at all
+        recs = TRACE_BUFFER.snapshot()
+        server_spans = [r for r in recs if r["name"].startswith("rpc.")]
+        client_ids = {
+            (r["trace_id"], r["span_id"])
+            for r in recs if r["name"].startswith("client.")
+        }
+        assert server_spans, "rate 0.5 over 40 calls: expected samples"
+        for s in server_spans:
+            assert (s["trace_id"], s["parent_id"]) in client_ids, s
+    finally:
+        chan.close()
+        server.stop()
+
+
+def test_tracing_off_ingress_leaves_context_clean():
+    """A rate-0 server with no incoming header must not attach a noop
+    context: its nested outbound calls would otherwise send '0-0-0' for
+    a decision nobody made, suppressing sampling on downstream servers."""
+    from dingo_tpu.trace import current_span
+
+    FLAGS.set("trace_sampling_rate", 0.0)
+    seen = {}
+
+    class Probe(DebugService):
+        def MetricsDump(self, req):
+            seen["ctx"] = current_span()
+            seen["onward_md"] = __import__(
+                "dingo_tpu.trace", fromlist=["inject_metadata"]
+            ).inject_metadata(None)
+            return super().MetricsDump(req)
+
+    server = DingoServer()
+    _register(server._server, "DebugService", Probe())
+    port = server.start()
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        ServiceStub(chan, "DebugService").MetricsDump(pb.MetricsDumpRequest())
+        assert seen["ctx"] is None
+        assert seen["onward_md"] is None
+    finally:
+        chan.close()
+        server.stop()
+
+
+def test_slow_query_logged_even_when_unsampled(sampled):
+    """Always-sample-slow: a request that loses the head-sampling roll
+    still lands in the slow-query log (synthesized record, no span tree)."""
+    FLAGS.set("trace_sampling_rate", 1e-9)   # armed, but never samples
+    FLAGS.set("slow_query_ms", 0.0001)       # every RPC counts as slow
+    server = DingoServer()
+    _register(server._server, "DebugService", DebugService())
+    port = server.start()
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        stub = ServiceStub(chan, "DebugService")
+        stub.MetricsDump(pb.MetricsDumpRequest())
+        slow = TRACE_BUFFER.slow_queries()
+        mine = [s for s in slow if s["name"] == "rpc.DebugService.MetricsDump"]
+        assert mine and mine[-1]["attrs"] == {"unsampled": True}
+        assert mine[-1]["dur_us"] > 0
+        # no span tree was recorded for the unsampled request
+        assert all(r["name"] != "rpc.DebugService.MetricsDump"
+                   for r in TRACE_BUFFER.snapshot())
+    finally:
+        FLAGS.set("slow_query_ms", 500.0)
+        chan.close()
+        server.stop()
+
+
+def test_slow_log_excludes_background_roots(sampled):
+    """Slow-QUERY log: only rpc./client. roots qualify — a slow sampled
+    background root (rebuild, raft-apply write) is buffered and bridged
+    but never buries query evidence in the slow log."""
+    import time as _time
+
+    FLAGS.set("slow_query_ms", 0.001)
+    try:
+        with TRACER.start_span("index.rebuild"):
+            _time.sleep(0.005)
+        assert all(s["name"] != "index.rebuild"
+                   for s in TRACE_BUFFER.slow_queries())
+        assert any(r["name"] == "index.rebuild"
+                   for r in TRACE_BUFFER.snapshot())
+    finally:
+        FLAGS.set("slow_query_ms", 500.0)
+
+
+def test_vector_search_trace_end_to_end(sampled):
+    """Acceptance: at sampling 1.0 one VectorSearch RPC through the
+    coalescer yields >= 5 nested spans (rpc -> coalesce.wait ->
+    coalesce.run -> index scan -> device kernel) sharing one trace id,
+    visible through TraceDump JSON and a valid Chrome trace file."""
+    from dingo_tpu.client import DingoClient
+
+    me = MemEngine()
+    control = CoordinatorControl(me, replication=1)
+    cs = DingoServer()
+    cs.host_coordinator_role(control, TsoControl(me), KvControl(me))
+    cport = cs.start()
+    node = StoreNode("s0", LocalTransport(), control, raft_kw={"seed": 0})
+    srv = DingoServer()
+    srv.host_store_role(node)
+    port = srv.start()
+    node.start_heartbeat(0.1)
+    client = DingoClient(f"127.0.0.1:{cport}", {"s0": f"127.0.0.1:{port}"})
+    FLAGS.set("search_coalescing_window_ms", 10.0)
+    try:
+        param = pb.VectorIndexParameter(
+            index_type=pb.VECTOR_INDEX_TYPE_FLAT, dimension=8,
+            metric_type=pb.METRIC_TYPE_L2,
+        )
+        client.create_index_region(0, 0, 1 << 30, param)
+        import time
+        time.sleep(1.0)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((50, 8)).astype(np.float32)
+        client.vector_add(0, list(range(50)), x)
+
+        TRACE_BUFFER.clear()
+        with TRACER.start_span("test.ingress") as root:
+            res = client.vector_search(0, x[[3]], topk=3)
+            trace_id = f"{root.trace_id:016x}"
+        assert res[0][0][0] == 3
+
+        spans = TRACE_BUFFER.snapshot(trace_id=trace_id)
+        names = {s["name"] for s in spans}
+        assert len(spans) >= 5, names
+        assert "rpc.IndexService.VectorSearch" in names
+        assert "coalesce.wait" in names
+        assert "coalesce.run" in names
+        assert "index.search" in names
+        assert any(n.startswith("ops.") for n in names), names
+        # single trace id and a CONNECTED tree: every non-root parent id
+        # is another span of the same trace
+        ids = {s["span_id"] for s in spans}
+        roots = [s for s in spans if not s["parent_id"]]
+        assert [r["name"] for r in roots] == ["test.ingress"]
+        for s in spans:
+            assert s["trace_id"] == trace_id
+            if s["parent_id"]:
+                assert s["parent_id"] in ids, s
+        # ingress carries the profiling attributes
+        rpc_span = next(s for s in spans
+                        if s["name"] == "rpc.IndexService.VectorSearch")
+        assert rpc_span["attrs"]["region_id"] >= 1
+        assert rpc_span["attrs"]["batch"] == 1
+
+        # exported via the DebugService JSON RPC
+        dbg = client._stub("s0", "DebugService")
+        payload = json.loads(dbg.TraceDump(pb.MetricsDumpRequest()).json)
+        assert trace_id in payload["traces"]
+        assert {s["name"] for s in payload["traces"][trace_id]} >= {
+            "rpc.IndexService.VectorSearch", "coalesce.run"}
+
+        # and as a Chrome trace_event payload (RPC + in-process exporter)
+        chrome = json.loads(
+            dbg.TraceChromeDump(pb.MetricsDumpRequest()).json)
+        assert chrome["traceEvents"]
+        local = to_chrome_trace(spans)
+        assert {e["name"] for e in local["traceEvents"]} == names
+        for ev in local["traceEvents"]:
+            assert ev["ph"] == "X"
+            assert isinstance(ev["ts"], int) and ev["dur"] >= 1
+    finally:
+        FLAGS.set("search_coalescing_window_ms", 0.0)
+        client.close()
+        srv.stop()
+        cs.stop()
+        node.stop()

@@ -1,0 +1,140 @@
+"""Chrome-trace -> per-stage latency table.
+
+Companion to tools/report.py (same json+html output convention): feed it
+the Chrome ``trace_event`` file produced by
+``dingo_tpu.trace.dump_chrome_trace`` or the ``TraceChromeDump`` RPC and
+get the Faiss-paper-style per-stage breakdown (count / avg / p50 / p99 /
+max / total per span name):
+
+    python tools/trace_report.py /tmp/dingo_trace.json [out_dir]
+
+Prints an aligned table; with out_dir also writes trace_report.json and
+trace_report.html (report.py's visual style).
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import os
+import sys
+from typing import Dict, List
+
+
+def _percentile(ordered: List[float], p: float) -> float:
+    if not ordered:
+        return 0.0
+    i = min(len(ordered) - 1, int(p / 100.0 * len(ordered)))
+    return ordered[i]
+
+
+def aggregate(events: List[Dict]) -> List[Dict]:
+    """Per-name duration stats from trace_event 'X' entries, slowest
+    total first (the stage eating the most wall time leads)."""
+    by_name: Dict[str, List[float]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        by_name.setdefault(ev["name"], []).append(float(ev.get("dur", 0)))
+    rows = []
+    for name, durs in by_name.items():
+        durs.sort()
+        total = sum(durs)
+        rows.append({
+            "stage": name,
+            "count": len(durs),
+            "avg_us": total / len(durs),
+            "p50_us": _percentile(durs, 50),
+            "p99_us": _percentile(durs, 99),
+            "max_us": durs[-1],
+            "total_us": total,
+        })
+    rows.sort(key=lambda r: r["total_us"], reverse=True)
+    return rows
+
+
+def load_events(path: str) -> List[Dict]:
+    with open(path) as f:
+        data = json.load(f)
+    # both documented forms: {"traceEvents": [...]} or a bare array
+    return data["traceEvents"] if isinstance(data, dict) else data
+
+
+_COLS = ("stage", "count", "avg_us", "p50_us", "p99_us", "max_us", "total_us")
+
+
+def render_table(rows: List[Dict]) -> str:
+    widths = {c: len(c) for c in _COLS}
+    lines = []
+    for r in rows:
+        line = {
+            c: (r[c] if isinstance(r[c], str) else
+                (str(r[c]) if isinstance(r[c], int) else f"{r[c]:.1f}"))
+            for c in _COLS
+        }
+        for c in _COLS:
+            widths[c] = max(widths[c], len(line[c]))
+        lines.append(line)
+    def fmt(vals):
+        return "  ".join(
+            vals[c].ljust(widths[c]) if c == "stage"
+            else vals[c].rjust(widths[c]) for c in _COLS
+        )
+    out = [fmt({c: c for c in _COLS})]
+    out.append("  ".join("-" * widths[c] for c in _COLS))
+    out.extend(fmt(line) for line in lines)
+    return "\n".join(out)
+
+
+_PAGE = """<!doctype html><html><head><meta charset="utf-8">
+<title>dingo-tpu trace report</title><style>
+body{{font-family:system-ui,sans-serif;margin:2rem;max-width:70rem}}
+table{{border-collapse:collapse;width:100%}}
+td,th{{padding:.25rem .6rem;border-bottom:1px solid #ddd;text-align:right}}
+td:first-child,th:first-child{{text-align:left}}
+</style></head><body>
+<h1>dingo-tpu per-stage latency</h1>
+<p>{n_events} span events &middot; {n_stages} stages</p>
+<table><tr>{head}</tr>
+{rows}
+</table></body></html>"""
+
+
+def render_html(rows: List[Dict], n_events: int) -> str:
+    head = "".join(f"<th>{c}</th>" for c in _COLS)
+    body = []
+    for r in rows:
+        cells = "".join(
+            f"<td>{html.escape(str(r[c])) if isinstance(r[c], (str, int)) else f'{r[c]:.1f}'}</td>"
+            for c in _COLS
+        )
+        body.append(f"<tr>{cells}</tr>")
+    return _PAGE.format(n_events=n_events, n_stages=len(rows),
+                        head=head, rows="\n".join(body))
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) not in (1, 2):
+        print("usage: trace_report.py <chrome_trace.json> [out_dir]",
+              file=sys.stderr)
+        return 2
+    events = load_events(argv[0])
+    rows = aggregate(events)
+    if not rows:
+        print("no span events in trace", file=sys.stderr)
+        return 1
+    print(render_table(rows))
+    if len(argv) == 2:
+        out_dir = argv[1]
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "trace_report.json"), "w") as f:
+            json.dump({"stages": rows, "events": len(events)}, f, indent=1)
+        with open(os.path.join(out_dir, "trace_report.html"), "w") as f:
+            f.write(render_html(rows, len(events)))
+        print(f"{len(rows)} stages -> {out_dir}/trace_report.html")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
