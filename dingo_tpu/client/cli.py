@@ -36,6 +36,84 @@ _ITYPES = {
 }
 
 
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def _render_table(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    line = "  ".join(h.ljust(w) for h, w in zip(headers, widths))
+    out = [line, "  ".join("-" * w for w in widths)]
+    for r in rows:
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def format_cluster_top(resp, region_id: int = 0) -> str:
+    """`cluster top`: per-store summary + per-region detail tables from a
+    GetStoreMetricsResponse (pure render — tests drive it directly)."""
+    store_rows = []
+    region_rows = []
+    for entry in resp.stores:
+        m = entry.metrics
+        store_rows.append([
+            entry.store_id,
+            "STALE" if entry.stale else "ok",
+            str(len(m.regions)),
+            str(sum(1 for r in m.regions if r.is_leader)),
+            str(sum(r.key_count for r in m.regions)),
+            str(sum(r.vector_count for r in m.regions)),
+            _fmt_bytes(sum(r.vector_memory_bytes for r in m.regions)),
+            _fmt_bytes(sum(r.device_memory_bytes for r in m.regions)),
+            _fmt_bytes(m.device_bytes_in_use),
+            f"{sum(r.search_qps for r in m.regions if r.is_leader):.1f}",
+        ])
+        for r in m.regions:
+            if region_id and r.region_id != region_id:
+                continue
+            flags = []
+            if r.index_building:
+                flags.append("building")
+            if r.index_build_error:
+                flags.append("build-error")
+            if not r.index_ready and r.vector_count:
+                flags.append("not-ready")
+            region_rows.append([
+                str(r.region_id),
+                entry.store_id,
+                "L" if r.is_leader else "F",
+                str(r.key_count),
+                str(r.vector_count),
+                _fmt_bytes(r.vector_memory_bytes),
+                _fmt_bytes(r.device_memory_bytes),
+                str(r.apply_lag),
+                f"{r.search_qps:.1f}",
+                ",".join(flags) or "-",
+            ])
+    region_rows.sort(key=lambda r: (int(r[0]), r[1]))
+    out = [
+        _render_table(
+            ["STORE", "METRICS", "REGIONS", "LEADERS", "KEYS", "VECTORS",
+             "MEM", "DEVMEM", "DEV-IN-USE", "QPS"],
+            store_rows,
+        ),
+        "",
+        _render_table(
+            ["REGION", "STORE", "ROLE", "KEYS", "VECTORS", "MEM", "DEVMEM",
+             "LAG", "QPS", "FLAGS"],
+            region_rows,
+        ),
+    ]
+    return "\n".join(out)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dingo-cli")
     p.add_argument("--coordinator", default="127.0.0.1:20001",
@@ -184,6 +262,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     cluster = sub.add_parser("cluster").add_subparsers(dest="cmd")
     cluster.add_parser("stat")
+    top = cluster.add_parser("top")   # per-store/per-region metrics table
+    top.add_argument("--store", dest="target_store", default="",
+                     help="limit to one store id")
+    top.add_argument("--region", type=int, default=0,
+                     help="limit the region table to one region id")
     jobs = cluster.add_parser("jobs")
     jobs.add_argument("--include-done", action="store_true")
     detail = cluster.add_parser("region-detail")
@@ -481,6 +564,12 @@ def run_command(client: DingoClient, args) -> int:
                 for st in r.stores
             ],
         }))
+    elif g == "cluster" and c == "top":
+        stub = client.coordinator_service("ClusterStatService")
+        r = stub.GetStoreMetrics(
+            pb.GetStoreMetricsRequest(store_id=args.target_store)
+        )
+        print(format_cluster_top(r, region_id=args.region))
     elif g == "cluster" and c == "jobs":
         stub = client.coordinator_service("JobService")
         r = stub.ListJobs(pb.ListJobsRequest(include_done=args.include_done))

@@ -119,6 +119,20 @@ FLAGS.define("use_mesh_sharded_ivfpq", False, mutable=True,
 FLAGS.define("mesh_dim_axis", 1, mutable=True,
              help_="size of the mesh 'dim' (tensor-parallel) axis used by "
                    "mesh-sharded indexes; 'data' axis = n_devices // dim")
+FLAGS.define("metrics_collect_interval_s", 5.0, mutable=True,
+             help_="StoreMetricsCollector crontab period; heartbeats also "
+                   "refresh snapshots older than this so beats never ship "
+                   "stale figures even without the crontab")
+FLAGS.define("metrics_http_port", 0, mutable=False,
+             help_="bind a plain-HTTP sidecar on this port serving "
+                   "/metrics (Prometheus text format) and /vars (JSON); "
+                   "0 disables — scrapers can't speak the grpc "
+                   "DebugService.MetricsDump")
+FLAGS.define("balance_mode", "count", mutable=True,
+             help_="leader balancing signal: 'count' (leader tallies) or "
+                   "'load' (measured per-region QPS + memory bytes from "
+                   "store metrics; falls back to count while metrics are "
+                   "missing or stale)")
 FLAGS.define("trace_sampling_rate", 0.0, mutable=True,
              help_="fraction of ingress requests recording a full span "
                    "tree into dingo_tpu/trace (0 disables; 1 records "

@@ -12,6 +12,10 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from dingo_tpu.common.log import get_logger
+
+_log = get_logger("crontab")
+
 
 class Crontab:
     def __init__(self, name: str, interval_s: float,
@@ -23,6 +27,7 @@ class Crontab:
         self.run_count = 0
         self.error_count = 0
         self.last_run_ms = 0
+        self.last_error = ""
         self._next_due = 0.0
 
 
@@ -60,7 +65,13 @@ class CrontabManager:
             self._thread = None
 
     def run_pending(self) -> int:
-        """Manual pump (tests / single-threaded drivers)."""
+        """Manual pump (tests / single-threaded drivers).
+
+        Failure isolation contract: one crontab's exception must neither
+        stop the remaining due crontabs this tick nor unschedule the
+        failing one — a buggy metrics collector silently killing the
+        heartbeat crontab would partition the store. Errors are counted,
+        logged, and mirrored into the metrics registry."""
         now = time.monotonic()
         due: List[Crontab] = []
         with self._lock:
@@ -72,14 +83,31 @@ class CrontabManager:
             try:
                 tab.func()
                 tab.run_count += 1
-            except Exception:
+            except Exception as e:  # noqa: BLE001
                 tab.error_count += 1
+                tab.last_error = f"{type(e).__name__}: {e}"
+                _log.exception("crontab %r failed (run %d, error %d)",
+                               tab.name, tab.run_count, tab.error_count)
+                try:
+                    from dingo_tpu.common.metrics import METRICS
+
+                    METRICS.counter(
+                        "crontab.errors", labels={"name": tab.name}
+                    ).add(1)
+                except Exception:  # noqa: BLE001 — never amplify
+                    pass
             tab.last_run_ms = int(time.time() * 1000)
         return len(due)
 
     def _loop(self) -> None:
         while not self._stop.wait(self._tick):
-            self.run_pending()
+            try:
+                self.run_pending()
+            except Exception:  # noqa: BLE001
+                # run_pending already isolates per-tab errors; this guards
+                # the scheduler itself (e.g. an exotic failure inside the
+                # due-computation) — the thread must outlive any bug
+                _log.exception("crontab scheduler tick failed")
 
     def stats(self) -> Dict[str, dict]:
         with self._lock:
@@ -88,6 +116,7 @@ class CrontabManager:
                     "interval_s": t.interval_s,
                     "runs": t.run_count,
                     "errors": t.error_count,
+                    "last_error": t.last_error,
                 }
                 for name, t in self._crontabs.items()
             }

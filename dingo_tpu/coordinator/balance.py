@@ -10,7 +10,7 @@ balancing may run (config_helper.h:46-48).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from dingo_tpu.coordinator.control import CoordinatorControl, StoreState
 
@@ -29,15 +29,63 @@ class MoveRegionOp:
     to_store: str
 
 
+#: load-aware weight: one load unit per this many index bytes (memory is a
+#: capacity signal alongside QPS — a cold 4GB leader still costs HBM)
+LOAD_BYTES_PER_UNIT = 64 * 1024 * 1024
+#: hysteresis floor: gaps under one load unit (1 QPS / 64MB) are noise —
+#: acting on them would churn leadership for nothing (count mode's
+#: `n_most <= n_least + 1` dead band, translated to load units)
+MIN_LOAD_GAP = 1.0
+
+
 class BalanceLeaderScheduler:
     """Move leaders from the most-loaded store to the least-loaded one when
-    the imbalance exceeds the ratio gate (BalanceLeaderScheduler)."""
+    the imbalance exceeds the ratio gate (BalanceLeaderScheduler).
 
-    def __init__(self, control: CoordinatorControl, ratio_gate: float = 1.2):
+    mode="count": load = leader tally (reference behavior).
+    mode="load":  load = measured leader QPS + memory units from the
+    store-metrics plane — two stores with EQUAL leader counts but skewed
+    traffic rebalance under this mode where count mode sees no work.
+    Falls back to count while metrics are missing or stale (a balancing
+    decision on dead figures is worse than none)."""
+
+    def __init__(self, control: CoordinatorControl, ratio_gate: float = 1.2,
+                 mode: str = "count"):
         self.control = control
         self.ratio_gate = ratio_gate
+        self.mode = mode
+
+    # ---------------- load-aware helpers ----------------
+    def _region_weights(self) -> Optional[Dict[str, Dict[int, float]]]:
+        """store_id -> {led region_id -> weight}; None when any alive
+        store lacks fresh metrics (fall back to count mode)."""
+        alive = {s.store_id for s in self.control.alive_stores()}
+        rows = self.control.get_store_metrics()
+        fresh = {
+            sid: snap for sid, snap, _at, stale in rows
+            if not stale and sid in alive
+        }
+        if alive - set(fresh):
+            return None
+        out: Dict[str, Dict[int, float]] = {}
+        for sid, snap in fresh.items():
+            out[sid] = {
+                rm.region_id:
+                    rm.search_qps
+                    + (rm.vector_memory_bytes + rm.device_memory_bytes)
+                    / LOAD_BYTES_PER_UNIT
+                for rm in snap.regions if rm.is_leader
+            }
+        return out
 
     def plan(self) -> List[TransferLeaderOp]:
+        if self.mode == "load":
+            weights = self._region_weights()
+            if weights is not None:
+                return self._plan_load(weights)
+        return self._plan_count()
+
+    def _plan_count(self) -> List[TransferLeaderOp]:
         stores = self.control.alive_stores()
         if len(stores) < 2:
             return []
@@ -60,6 +108,46 @@ class BalanceLeaderScheduler:
         to_move = (n_most - n_least) // 2
         for rid in movable[:to_move]:
             ops.append(TransferLeaderOp(rid, most.store_id, least.store_id))
+        return ops
+
+    def _plan_load(self, weights: Dict[str, Dict[int, float]]
+                   ) -> List[TransferLeaderOp]:
+        stores = self.control.alive_stores()
+        if len(stores) < 2:
+            return []
+        load = {
+            s.store_id: sum(weights.get(s.store_id, {}).values())
+            for s in stores
+        }
+        by_load = sorted(stores, key=lambda s: load[s.store_id])
+        least, most = by_load[0], by_load[-1]
+        l_least, l_most = load[least.store_id], load[most.store_id]
+        gap = l_most - l_least
+        if gap < MIN_LOAD_GAP:
+            return []
+        if l_least > 0 and l_most / l_least < self.ratio_gate:
+            return []
+        # move the heaviest movable leaders first, stopping once half the
+        # gap shifts. Each move must STRICTLY shrink the gap (w < remaining
+        # gap): with a single dominant leader, w == gap would mirror the
+        # skew exactly and the next tick would move it straight back —
+        # perpetual leadership ping-pong
+        movable = sorted(
+            (
+                (w, rid) for rid, w in weights[most.store_id].items()
+                if least.store_id in
+                (self.control.regions.get(rid).peers
+                 if self.control.regions.get(rid) else [])
+            ),
+            reverse=True,
+        )
+        ops: List[TransferLeaderOp] = []
+        moved = 0.0
+        for w, rid in movable:
+            if moved >= gap / 2 or w >= gap - moved:
+                continue
+            ops.append(TransferLeaderOp(rid, most.store_id, least.store_id))
+            moved += w
         return ops
 
     def dispatch(self) -> int:

@@ -25,6 +25,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from dingo_tpu.common import persist
 from dingo_tpu.common.log import get_logger, region_log
+# heartbeat metrics payloads ride persist-encoded raft proposals on the
+# replicated coordinator — the snapshot types must be registered before
+# any log replay decodes one, so import them eagerly here
+from dingo_tpu.metrics import snapshot as _metrics_snapshot  # noqa: F401
 from dingo_tpu.engine.raw_engine import CF_META, RawEngine
 from dingo_tpu.index.base import IndexParameter
 from dingo_tpu.store.region import (
@@ -100,6 +104,10 @@ class CoordinatorControl:
     #: stores missing heartbeats longer than this go OFFLINE
     #: (server.heartbeat_interval_s based; UpdateStoreState crontab)
     OFFLINE_AFTER_MS = 30_000
+    #: a store's metrics snapshot older than this is flagged stale in
+    #: GetStoreMetrics/GetRegionMetrics and excluded from cluster rollups
+    #: and load-aware balancing (3x the default heartbeat interval)
+    METRICS_STALE_MS = 30_000
 
     def __init__(self, engine: RawEngine, replication: int = 3):
         self.engine = engine
@@ -110,6 +118,11 @@ class CoordinatorControl:
         self.region_leaders: Dict[int, str] = {}
         #: per-store command queues (store operations pushed/pulled)
         self.store_ops: Dict[str, List[RegionCmd]] = {}
+        #: freshest metrics snapshot per store -> (snapshot, received_ms).
+        #: In-memory only, like the reference's bvar plane: telemetry is
+        #: re-reported every beat, persisting it would only replay stale
+        #: figures after a restart
+        self.store_metrics: Dict[str, Tuple[object, int]] = {}
         self.jobs: List[RegionCmd] = []
         self._next_region_id = 1000
         self._next_cmd_id = 1
@@ -177,6 +190,7 @@ class CoordinatorControl:
         done_cmd_ids: Sequence[int] = (),
         failed_cmd_ids: Sequence[int] = (),
         stalled_cmd_ids: Sequence[int] = (),
+        metrics=None,
     ) -> List[RegionCmd]:
         """StoreHeartbeat: record metrics, reconcile region topology from the
         store's reported definitions (splits survive leader crashes this
@@ -195,11 +209,18 @@ class CoordinatorControl:
             if info is None:
                 self.register_store(store_id, now_ms=now_ms)
                 info = self.stores[store_id]
-            info.last_heartbeat_ms = now_ms if now_ms is not None else int(time.time() * 1000)
+            beat_ms = now_ms if now_ms is not None else int(time.time() * 1000)
+            info.last_heartbeat_ms = beat_ms
             info.region_ids = list(region_ids)
             info.leader_region_ids = list(leader_region_ids)
             info.capacity_bytes = capacity_bytes
             info.used_bytes = used_bytes
+            if metrics is not None:
+                # freshest-wins metrics plane (StoreMetricsManager analog);
+                # staleness is judged from OUR receive clock, not the
+                # store's collect clock — skewed store clocks must not
+                # make live stores look stale
+                self.store_metrics[store_id] = (metrics, beat_ms)
             for rid in leader_region_ids:
                 self.region_leaders[rid] = store_id
             self._persist(_PREFIX_STORE + store_id.encode(), info)
@@ -301,6 +322,77 @@ class CoordinatorControl:
                 s for s in self.stores.values()
                 if s.state is StoreState.NORMAL
             ]
+
+    # ---------------- metrics aggregation -----------------------------------
+    def get_store_metrics(self, store_id: str = "", *,
+                          now_ms: Optional[int] = None) -> List[Tuple]:
+        """Freshest snapshot per store: [(store_id, snapshot, last_update_ms,
+        stale)] — stale once no beat delivered metrics for METRICS_STALE_MS
+        (a stopped store keeps its last figures, flagged)."""
+        now = now_ms if now_ms is not None else int(time.time() * 1000)
+        with self._lock:
+            out = []
+            for sid, (snap, at_ms) in sorted(self.store_metrics.items()):
+                if store_id and sid != store_id:
+                    continue
+                stale = now - at_ms > self.METRICS_STALE_MS
+                out.append((sid, snap, at_ms, stale))
+            return out
+
+    def get_region_metrics(self, region_id: int = 0, *,
+                           now_ms: Optional[int] = None) -> List[Tuple]:
+        """Per-replica region rows across stores: [(store_id, stale,
+        RegionMetricsSnapshot)] (region_id 0 = every region)."""
+        rows = []
+        for sid, snap, _at, stale in self.get_store_metrics(now_ms=now_ms):
+            for rm in snap.regions:
+                if region_id and rm.region_id != region_id:
+                    continue
+                rows.append((sid, stale, rm))
+        rows.sort(key=lambda r: (r[2].region_id, r[0]))
+        return rows
+
+    def cluster_metrics_rollup(self, *,
+                               now_ms: Optional[int] = None) -> Dict[str, int]:
+        """Cluster totals over NON-stale snapshots (leader replicas only
+        for key/vector counts so replication factor doesn't multiply
+        logical sizes; memory/device bytes sum over every replica — HBM
+        is spent per replica)."""
+        totals = {
+            "key_count": 0, "vector_count": 0,
+            "memory_bytes": 0, "device_memory_bytes": 0,
+        }
+        for _sid, snap, _at, stale in self.get_store_metrics(now_ms=now_ms):
+            if stale:
+                continue
+            for rm in snap.regions:
+                if rm.is_leader:
+                    totals["key_count"] += rm.key_count
+                    totals["vector_count"] += rm.vector_count
+                totals["memory_bytes"] += rm.vector_memory_bytes
+                totals["device_memory_bytes"] += rm.device_memory_bytes
+        return totals
+
+    def store_metrics_summary(self, store_id: str, *,
+                              now_ms: Optional[int] = None) -> Dict[str, object]:
+        """Per-store rollup for GetClusterStat's StoreStat rows (zeros +
+        stale=True when the store never delivered metrics)."""
+        rows = self.get_store_metrics(store_id, now_ms=now_ms)
+        if not rows:
+            return {"key_count": 0, "vector_count": 0, "memory_bytes": 0,
+                    "device_memory_bytes": 0, "stale": True,
+                    "leader_qps": 0.0}
+        _sid, snap, _at, stale = rows[0]
+        return {
+            "key_count": sum(r.key_count for r in snap.regions),
+            "vector_count": sum(r.vector_count for r in snap.regions),
+            "memory_bytes": sum(r.vector_memory_bytes for r in snap.regions),
+            "device_memory_bytes": sum(
+                r.device_memory_bytes for r in snap.regions),
+            "stale": stale,
+            "leader_qps": sum(
+                r.search_qps for r in snap.regions if r.is_leader),
+        }
 
     # ---------------- id allocation -----------------------------------------
     def next_region_id(self) -> int:

@@ -249,6 +249,15 @@ class VectorIndex(abc.ABC):
     def get_memory_size(self) -> int:
         ...
 
+    def get_device_memory_size(self) -> int:
+        """Live device (HBM) bytes attributable to this index: distinct
+        jax.Arrays reachable from it (slot-store vecs/sqnorm, centroids,
+        PQ codes, ...). Host-only indexes (HNSW graph, numpy stores)
+        report 0 — get_memory_size() covers host bytes."""
+        from dingo_tpu.metrics.device import live_device_bytes
+
+        return live_device_bytes(self)
+
     def need_to_rebuild(self) -> bool:
         """Reference default: false; HNSW overrides (deleted > total/2 —
         vector_index_hnsw.cc:577-589; note getCurrentElementCount counts

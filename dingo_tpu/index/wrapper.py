@@ -196,3 +196,9 @@ class VectorIndexWrapper:
     def get_memory_size(self) -> int:
         idx = self.own_index
         return idx.get_memory_size() if idx else 0
+
+    def get_device_memory_size(self) -> int:
+        """Device bytes of the OWN index (a shared parent's arrays are
+        accounted on the parent's region, not double-counted here)."""
+        idx = self.own_index
+        return idx.get_device_memory_size() if idx else 0

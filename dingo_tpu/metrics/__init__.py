@@ -1,0 +1,32 @@
+"""Store-metrics plane (reference src/metrics/ StoreMetricsManager +
+store_bvar_metrics, rebuilt for the TPU store).
+
+Five layers (ARCHITECTURE.md "Metrics"):
+
+- collection: StoreMetricsCollector snapshots every hosted region —
+  engine key counts/bytes, vector-index elements + host memory,
+  build/snapshot status, WAL replay lag, and live device (HBM) bytes —
+  on a crontab, registering everything into MetricsRegistry with a
+  region dimension (collector.py, device.py).
+- transport: each StoreHeartbeatRequest carries the freshest snapshot
+  (store/node.py in-process, server/remote_heartbeat.py over grpc).
+- aggregation: CoordinatorControl keeps per-store/per-region snapshots
+  with staleness timestamps and cluster rollups; exposed via
+  ClusterStatService GetClusterStat / GetStoreMetrics / GetRegionMetrics.
+- exposition: MetricsRegistry.render_prometheus() behind
+  DebugService.MetricsDump(format="prometheus") and the optional
+  plain-HTTP /metrics port (http.py).
+- tooling: CLI `cluster top`, tools/metrics_report.py,
+  tools/check_metrics_names.py.
+"""
+
+from dingo_tpu.metrics.snapshot import (  # noqa: F401
+    RegionMetricsSnapshot,
+    StoreMetricsSnapshot,
+)
+from dingo_tpu.metrics.collector import StoreMetricsCollector  # noqa: F401
+from dingo_tpu.metrics.device import (  # noqa: F401
+    device_memory_stats,
+    live_device_bytes,
+)
+from dingo_tpu.metrics.http import MetricsHttpServer  # noqa: F401

@@ -1,0 +1,58 @@
+"""Metric snapshot records shipped store -> coordinator in heartbeats.
+
+persist-registered because the replicated coordinator proposes
+store_heartbeat(args, kwargs) through the meta raft group
+(coordinator/raft_meta.py) — the payload must round-trip persist.dumps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+from dingo_tpu.common import persist
+
+
+@persist.register
+@dataclasses.dataclass
+class RegionMetricsSnapshot:
+    """One region's sizes/counts/status as seen by its hosting store
+    (reference pb::common::RegionMetrics subset + device accounting)."""
+
+    region_id: int
+    key_count: int = 0
+    approximate_bytes: int = 0
+    vector_count: int = 0
+    vector_memory_bytes: int = 0
+    device_memory_bytes: int = 0
+    index_ready: bool = False
+    index_building: bool = False
+    index_build_error: bool = False
+    index_apply_log_id: int = 0
+    index_snapshot_log_id: int = 0
+    apply_lag: int = 0
+    is_leader: bool = False
+    search_qps: float = 0.0
+    document_count: int = 0
+
+
+@persist.register
+@dataclasses.dataclass
+class StoreMetricsSnapshot:
+    """Whole-store snapshot: process-level device gauges + regions."""
+
+    store_id: str
+    collected_at_ms: int = 0
+    device_bytes_in_use: int = 0
+    device_bytes_limit: int = 0
+    device_peak_bytes: int = 0
+    engine_key_count: int = 0
+    regions: List[RegionMetricsSnapshot] = dataclasses.field(
+        default_factory=list
+    )
+
+    def region(self, region_id: int) -> RegionMetricsSnapshot:
+        for r in self.regions:
+            if r.region_id == region_id:
+                return r
+        raise KeyError(region_id)

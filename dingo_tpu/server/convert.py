@@ -239,3 +239,55 @@ def coprocessor_from_pb(m) -> "object | None":
         ],
     )
     return CoprocessorV2(defn)
+
+
+# ---------------- store metrics (heartbeat payload) ----------------
+
+_REGION_METRIC_FIELDS = (
+    "region_id", "key_count", "approximate_bytes", "vector_count",
+    "vector_memory_bytes", "device_memory_bytes", "index_ready",
+    "index_building", "index_build_error", "index_apply_log_id",
+    "index_snapshot_log_id", "apply_lag", "is_leader", "search_qps",
+    "document_count",
+)
+
+_STORE_METRIC_FIELDS = (
+    "store_id", "collected_at_ms", "device_bytes_in_use",
+    "device_bytes_limit", "device_peak_bytes", "engine_key_count",
+)
+
+
+def region_metrics_to_pb(rm, out: Optional[pb.RegionMetrics] = None
+                         ) -> pb.RegionMetrics:
+    out = out if out is not None else pb.RegionMetrics()
+    for f in _REGION_METRIC_FIELDS:
+        setattr(out, f, getattr(rm, f))
+    return out
+
+
+def region_metrics_from_pb(m: pb.RegionMetrics):
+    from dingo_tpu.metrics.snapshot import RegionMetricsSnapshot
+
+    return RegionMetricsSnapshot(
+        **{f: getattr(m, f) for f in _REGION_METRIC_FIELDS}
+    )
+
+
+def store_metrics_to_pb(snap, out: Optional[pb.StoreMetrics] = None
+                        ) -> pb.StoreMetrics:
+    out = out if out is not None else pb.StoreMetrics()
+    for f in _STORE_METRIC_FIELDS:
+        setattr(out, f, getattr(snap, f))
+    for rm in snap.regions:
+        region_metrics_to_pb(rm, out.regions.add())
+    return out
+
+
+def store_metrics_from_pb(m: pb.StoreMetrics):
+    from dingo_tpu.metrics.snapshot import StoreMetricsSnapshot
+
+    snap = StoreMetricsSnapshot(
+        **{f: getattr(m, f) for f in _STORE_METRIC_FIELDS}
+    )
+    snap.regions = [region_metrics_from_pb(r) for r in m.regions]
+    return snap

@@ -117,20 +117,31 @@ def serve_coordinator(args) -> None:
     crontab.add("update_store_state", 5.0,
                 when_leader(control.update_store_states))
     crontab.add("lease_gc", 10.0, when_leader(kv_control.lease_gc))
+    balance_leader = BalanceLeaderScheduler(control)
+
+    def dispatch_balance_leader():
+        # balance_mode is hot-changeable — re-read per tick so an operator
+        # can flip count <-> load without a restart
+        balance_leader.mode = str(FLAGS.get("balance_mode"))
+        return balance_leader.dispatch()
+
     crontab.add(
         "balance_leader", 30.0,
-        when_leader(BalanceLeaderScheduler(control).dispatch),
+        when_leader(dispatch_balance_leader),
     )
     crontab.add(
         "balance_region", 60.0,
         when_leader(BalanceRegionScheduler(control).dispatch),
     )
+    metrics_http = _maybe_metrics_http()
     crontab.start()
     print(f"coordinator {args.id} listening on 127.0.0.1:{port}"
           + (" (raft group)" if raft_coordinator else ""), flush=True)
     try:
         _wait(server, crontab)
     finally:
+        if metrics_http is not None:
+            metrics_http.stop()
         if raft_coordinator is not None:
             raft_coordinator.stop()
 
@@ -231,9 +242,36 @@ def serve_store(args) -> None:
         t.start()
 
     crontab.add("scrub_vector_index", 60.0, scrub_all)
+    # metrics collection rides its own crontab so heartbeats reuse the
+    # cached snapshot instead of paying a full region sweep per beat
+    crontab.add(
+        "store_metrics",
+        float(FLAGS.get("metrics_collect_interval_s")),
+        node.metrics.collect,
+        immediately=True,
+    )
+    metrics_http = _maybe_metrics_http()
     crontab.start()
     print(f"store {args.id} listening on 127.0.0.1:{port}", flush=True)
-    _wait(server, crontab, node)
+    try:
+        _wait(server, crontab, node)
+    finally:
+        if metrics_http is not None:
+            metrics_http.stop()
+
+
+def _maybe_metrics_http():
+    """Bind the plain-HTTP /metrics sidecar when metrics.http_port is set
+    (Prometheus scrapers can't speak the grpc DebugService)."""
+    port = int(FLAGS.get("metrics_http_port"))
+    if not port:
+        return None
+    from dingo_tpu.metrics.http import MetricsHttpServer
+
+    srv = MetricsHttpServer(port)
+    bound = srv.start()
+    print(f"metrics http on 127.0.0.1:{bound}/metrics", flush=True)
+    return srv
 
 
 def _wait(server, crontab, node=None) -> None:

@@ -64,6 +64,12 @@ class RemoteHeartbeat:
                 req.region_definitions.add().CopyFrom(
                     convert.region_def_to_pb(r.definition)
                 )
+        from dingo_tpu.common.config import FLAGS
+
+        snap = node.metrics.maybe_collect(
+            max_age_s=float(FLAGS.get("metrics_collect_interval_s"))
+        )
+        convert.store_metrics_to_pb(snap, req.metrics)
         resp = self._call("StoreHeartbeat", req)
         node._unacked_done.difference_update(acking)
         node._failed_cmds.difference_update(nacking)

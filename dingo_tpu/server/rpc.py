@@ -182,6 +182,12 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "GetClusterStat": (
             pb.GetClusterStatRequest, pb.GetClusterStatResponse,
         ),
+        "GetStoreMetrics": (
+            pb.GetStoreMetricsRequest, pb.GetStoreMetricsResponse,
+        ),
+        "GetRegionMetrics": (
+            pb.GetRegionMetricsRequest, pb.GetRegionMetricsResponse,
+        ),
     },
     "RegionControlService": {
         "RegionSnapshot": (

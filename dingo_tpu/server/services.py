@@ -1321,7 +1321,15 @@ class FileService:
 class DebugService:
     def MetricsDump(self, req: pb.MetricsDumpRequest) -> pb.MetricsDumpResponse:
         resp = pb.MetricsDumpResponse()
-        resp.json = json.dumps(METRICS.dump())
+        fmt = req.format or "json"
+        if fmt == "prometheus":
+            # the payload field stays `json` (wire compatibility); the
+            # content is Prometheus text exposition format
+            resp.json = METRICS.render_prometheus()
+        elif fmt == "json":
+            resp.json = json.dumps(METRICS.dump())
+        else:
+            return _err(resp, 50002, f"unknown metrics format {fmt!r}")
         return resp
 
     def TraceDump(self, req: pb.MetricsDumpRequest) -> pb.MetricsDumpResponse:
@@ -1380,6 +1388,10 @@ class CoordinatorService:
             done_cmd_ids=list(req.done_cmd_ids),
             failed_cmd_ids=list(req.failed_cmd_ids),
             stalled_cmd_ids=list(req.stalled_cmd_ids),
+            metrics=(
+                convert.store_metrics_from_pb(req.metrics)
+                if req.HasField("metrics") else None
+            ),
         )
         for c in cmds:
             out = resp.commands.add()
@@ -1856,6 +1868,44 @@ class ClusterStatService:
                 st.region_count = len(s.region_ids)
                 st.leader_count = len(s.leader_region_ids)
                 st.last_heartbeat_ms = s.last_heartbeat_ms
+                summary = self.control.store_metrics_summary(s.store_id)
+                st.key_count = summary["key_count"]
+                st.vector_count = summary["vector_count"]
+                st.memory_bytes = summary["memory_bytes"]
+                st.device_memory_bytes = summary["device_memory_bytes"]
+                st.metrics_stale = summary["stale"]
+                st.leader_qps = summary["leader_qps"]
+            rollup = self.control.cluster_metrics_rollup()
+        resp.total_key_count = rollup["key_count"]
+        resp.total_vector_count = rollup["vector_count"]
+        resp.total_memory_bytes = rollup["memory_bytes"]
+        resp.total_device_memory_bytes = rollup["device_memory_bytes"]
+        return resp
+
+    def GetStoreMetrics(self, req: pb.GetStoreMetricsRequest):
+        """Freshest per-store metrics snapshots with staleness flags (the
+        query face of the heartbeat metrics plane; `cluster top` renders
+        this)."""
+        resp = pb.GetStoreMetricsResponse()
+        for sid, snap, at_ms, stale in self.control.get_store_metrics(
+            req.store_id
+        ):
+            entry = resp.stores.add()
+            entry.store_id = sid
+            entry.last_update_ms = at_ms
+            entry.stale = stale
+            convert.store_metrics_to_pb(snap, entry.metrics)
+        return resp
+
+    def GetRegionMetrics(self, req: pb.GetRegionMetricsRequest):
+        """Per-replica rows for one region (or all, region_id=0) across
+        stores — leader/follower lag and per-replica HBM side by side."""
+        resp = pb.GetRegionMetricsResponse()
+        for sid, stale, rm in self.control.get_region_metrics(req.region_id):
+            entry = resp.regions.add()
+            entry.store_id = sid
+            entry.stale = stale
+            convert.region_metrics_to_pb(rm, entry.metrics)
         return resp
 
 

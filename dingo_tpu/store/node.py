@@ -58,6 +58,11 @@ class StoreNode:
         self.meta = StoreMetaManager(self.raw)
         self.index_manager = VectorIndexManager(self.raw, snapshot_root)
         self.storage = Storage(self.engine)
+        from dingo_tpu.metrics.collector import StoreMetricsCollector
+
+        #: per-region metrics snapshots (StoreMetricsManager analog);
+        #: ticked by the metrics crontab, attached to every heartbeat
+        self.metrics = StoreMetricsCollector(self)
         self.raft_kw = raft_kw or {}
         self._lock = threading.RLock()
         self._hb_stop = threading.Event()
@@ -370,6 +375,11 @@ class StoreNode:
         acking = list(self._unacked_done)
         nacking = list(self._failed_cmds)
         stalling = list(self._stalled_cmds)
+        from dingo_tpu.common.config import FLAGS
+
+        snap = self.metrics.maybe_collect(
+            max_age_s=float(FLAGS.get("metrics_collect_interval_s"))
+        )
         cmds = self.coordinator.store_heartbeat(
             self.store_id,
             region_ids=[r.id for r in regions],
@@ -379,6 +389,7 @@ class StoreNode:
             done_cmd_ids=acking,
             failed_cmd_ids=nacking,
             stalled_cmd_ids=stalling,
+            metrics=snap,
         )
         # the call returned, so the coordinator applied the acks (raft-
         # replicated coordinators apply before responding)

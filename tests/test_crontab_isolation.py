@@ -1,0 +1,57 @@
+"""CrontabManager failure isolation (satellite regression): one crontab
+raising must increment error_count, keep the OTHER crontabs firing, and
+keep the scheduler thread alive — a buggy metrics collector must never
+silently kill the heartbeat crontab."""
+
+import time
+
+from dingo_tpu.common.crontab import CrontabManager
+
+
+def test_failing_crontab_does_not_starve_others_same_tick():
+    mgr = CrontabManager(tick_s=0.01)
+    order = []
+    # the failing tab registers FIRST so it's due before the healthy one
+    mgr.add("boom", 0.01, lambda: (_ for _ in ()).throw(RuntimeError("x")),
+            immediately=True)
+    mgr.add("heartbeat", 0.01, lambda: order.append("hb"), immediately=True)
+    for _ in range(4):
+        mgr.run_pending()
+        time.sleep(0.015)
+    stats = mgr.stats()
+    assert stats["boom"]["errors"] >= 3
+    assert stats["boom"]["last_error"].startswith("RuntimeError")
+    assert stats["heartbeat"]["runs"] >= 3   # every tick, despite boom
+    assert stats["heartbeat"]["errors"] == 0
+
+
+def test_scheduler_thread_survives_exceptions():
+    mgr = CrontabManager(tick_s=0.005)
+    hits = []
+    mgr.add("boom", 0.005, lambda: 1 / 0, immediately=True)
+    mgr.add("alive", 0.005, lambda: hits.append(1), immediately=True)
+    mgr.start()
+    try:
+        time.sleep(0.2)
+        assert mgr._thread is not None and mgr._thread.is_alive()
+        n = len(hits)
+        assert n >= 5                      # healthy tab kept firing
+        assert mgr.stats()["boom"]["errors"] >= 5
+        time.sleep(0.1)
+        assert len(hits) > n               # ... and still fires NOW
+    finally:
+        mgr.stop()
+
+
+def test_errors_mirrored_into_metrics_registry():
+    from dingo_tpu.common.metrics import METRICS
+
+    mgr = CrontabManager()
+    mgr.add("always_fails", 0.001, lambda: 1 / 0, immediately=True)
+    before = METRICS.counter(
+        "crontab.errors", labels={"name": "always_fails"}).get()
+    time.sleep(0.002)
+    mgr.run_pending()
+    after = METRICS.counter(
+        "crontab.errors", labels={"name": "always_fails"}).get()
+    assert after == before + 1

@@ -1,0 +1,104 @@
+"""Heartbeat metrics over REAL grpc: a store beating through
+RemoteHeartbeat delivers region metrics that become visible in
+GetClusterStat / GetStoreMetrics on the coordinator server, including
+staleness once the store stops beating (satellite: gRPC transport leg of
+the metrics pipeline)."""
+
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from dingo_tpu.coordinator.control import CoordinatorControl
+from dingo_tpu.coordinator.kv_control import KvControl
+from dingo_tpu.coordinator.tso import TsoControl
+from dingo_tpu.engine.raw_engine import MemEngine
+from dingo_tpu.raft import LocalTransport
+from dingo_tpu.server import pb
+from dingo_tpu.server.remote_heartbeat import RemoteHeartbeat
+from dingo_tpu.server.rpc import DingoServer, ServiceStub
+from dingo_tpu.store.node import StoreNode
+
+
+@pytest.fixture()
+def remote_cluster():
+    meta_engine = MemEngine()
+    control = CoordinatorControl(meta_engine, replication=1)
+    coord_server = DingoServer()
+    coord_server.host_coordinator_role(
+        control, TsoControl(meta_engine), KvControl(meta_engine))
+    coord_port = coord_server.start()
+    addr = f"127.0.0.1:{coord_port}"
+
+    # a store with NO in-process coordinator: it only talks grpc
+    node = StoreNode("s0", LocalTransport(), coordinator=None,
+                     raft_kw={"seed": 0})
+    hb = RemoteHeartbeat(node, addr)
+    channel = grpc.insecure_channel(addr)
+    yield control, node, hb, channel
+    channel.close()
+    coord_server.stop()
+    node.stop()
+
+
+def test_remote_heartbeat_delivers_metrics(remote_cluster):
+    control, node, hb, channel = remote_cluster
+    hb.beat()
+    definition = control.create_region(b"", b"", replication=1)
+    rid = definition.region_id
+    deadline = time.monotonic() + 5
+    while node.get_region(rid) is None and time.monotonic() < deadline:
+        hb.beat()
+        time.sleep(0.05)
+    region = node.get_region(rid)
+    assert region is not None
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        rn = node.engine.get_node(rid)
+        if rn is not None and rn.is_leader():
+            break
+        time.sleep(0.03)
+    node.storage.kv_put(region, [(b"k1", b"v1"), (b"k2", b"v2")])
+
+    node.metrics._latest_mono = 0.0    # next beat must collect fresh
+    hb.beat()
+
+    # metrics landed on the coordinator via the pb leg
+    rows = control.get_store_metrics("s0")
+    assert len(rows) == 1
+    _sid, snap, _at, stale = rows[0]
+    assert not stale
+    assert snap.region(rid).key_count == 2
+    assert snap.region(rid).is_leader
+
+    # and are queryable over the grpc service surface
+    stub = ServiceStub(channel, "ClusterStatService")
+    resp = stub.GetStoreMetrics(pb.GetStoreMetricsRequest())
+    assert resp.stores[0].store_id == "s0"
+    assert resp.stores[0].metrics.regions[0].key_count == 2
+    stat = stub.GetClusterStat(pb.GetClusterStatRequest())
+    assert stat.total_key_count == 2
+    srow = next(s for s in stat.stores if s.store_id == "s0")
+    assert srow.key_count == 2 and not srow.metrics_stale
+
+    # staleness: no beats for METRICS_STALE_MS -> flagged, rollups drop
+    future = int(time.time() * 1000) + control.METRICS_STALE_MS + 1
+    assert control.get_store_metrics("s0", now_ms=future)[0][3] is True
+    assert control.cluster_metrics_rollup(now_ms=future)["key_count"] == 0
+
+
+def test_debug_metrics_dump_prometheus_over_grpc(remote_cluster):
+    control, node, hb, channel = remote_cluster
+    # store-side DebugService is registered on the store's own server in
+    # production; here exercise the coordinator-side one over the wire
+    stub = ServiceStub(channel, "DebugService")
+    resp = stub.MetricsDump(pb.MetricsDumpRequest(format="prometheus"))
+    assert not resp.error.errcode
+    from tests.test_store_metrics import parse_prometheus
+
+    parse_prometheus(resp.json)   # every line must obey the text format
+    resp = stub.MetricsDump(pb.MetricsDumpRequest())
+    import json
+
+    json.loads(resp.json)         # default stays the /vars JSON dump

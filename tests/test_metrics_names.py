@@ -1,0 +1,48 @@
+"""tools/check_metrics_names.py wired as a tier-1 gate (satellite): every
+literal metric registration in dingo_tpu/ must be a lowercase dotted
+identifier so Prometheus name-mangling cannot collide or drop series."""
+
+import importlib
+
+import pytest
+
+checker = importlib.import_module("tools.check_metrics_names")
+
+
+def test_repo_metric_names_are_clean(capsys):
+    assert checker.main() == 0, capsys.readouterr().err
+
+
+def test_checker_flags_bad_literal(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from dingo_tpu.common.metrics import METRICS\n"
+        "METRICS.counter('CamelCase.Name').add(1)\n"
+        "METRICS.gauge('has space').set(2)\n"
+        "METRICS.latency('fine.name')\n"
+    )
+    problems = checker.check_file(str(bad))
+    assert len(problems) == 2
+    assert problems[0][0] == 2 and "CamelCase.Name" in problems[0][1]
+
+
+def test_checker_validates_fstring_prefix(tmp_path):
+    f = tmp_path / "dyn.py"
+    f.write_text(
+        "from dingo_tpu.common.metrics import METRICS\n"
+        "name = 'x'\n"
+        "METRICS.latency(f'span.{name}')\n"       # ok: clean prefix
+        "METRICS.latency(f'Span.{name}')\n"       # bad: uppercase prefix
+    )
+    problems = checker.check_file(str(f))
+    assert len(problems) == 1 and problems[0][0] == 4
+
+
+def test_registry_name_rule_matches_lint():
+    from dingo_tpu.common.metrics import valid_metric_name
+
+    assert valid_metric_name("store.region.key_count")
+    assert valid_metric_name("qps")
+    assert not valid_metric_name("Store.Region")
+    assert not valid_metric_name("1leading")
+    assert not valid_metric_name("has space")

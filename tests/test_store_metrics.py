@@ -1,0 +1,457 @@
+"""Store-metrics pipeline: collection -> heartbeat -> coordinator
+aggregation -> exposition (tentpole acceptance: a two-store cluster shows
+per-region key counts, vector counts, memory bytes, and device-memory
+gauges flowing store -> heartbeat -> coordinator, queryable via
+GetStoreMetrics, rendered by `cluster top`, scrapeable as valid Prometheus
+text, and load-aware balancing acts on injected skew that count-based
+balancing ignores)."""
+
+import re
+import time
+
+import numpy as np
+import pytest
+
+from dingo_tpu.client.cli import format_cluster_top
+from dingo_tpu.common.metrics import (
+    Gauge,
+    LatencyRecorder,
+    MetricsRegistry,
+)
+from dingo_tpu.coordinator.balance import BalanceLeaderScheduler
+from dingo_tpu.coordinator.control import CoordinatorControl
+from dingo_tpu.engine.raw_engine import MemEngine
+from dingo_tpu.index import codec as vcodec
+from dingo_tpu.index.base import IndexParameter, IndexType
+from dingo_tpu.metrics.snapshot import (
+    RegionMetricsSnapshot,
+    StoreMetricsSnapshot,
+)
+from dingo_tpu.raft import LocalTransport
+from dingo_tpu.server.services import ClusterStatService, DebugService
+from dingo_tpu.server import pb
+from dingo_tpu.store.node import StoreNode
+from dingo_tpu.store.region import RegionType
+
+
+# ---------------------------------------------------------------------------
+# metric primitives (satellites)
+# ---------------------------------------------------------------------------
+
+def test_gauge_add_is_atomic_delta():
+    g = Gauge()
+    g.set(100.0)
+    assert g.add(28.0) == 128.0
+    assert g.add(-128.0) == 0.0
+    import threading
+
+    def worker():
+        for _ in range(1000):
+            g.add(1)
+            g.add(-1)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # racing read-modify-write via set() would lose deltas; add() must not
+    assert g.get() == 0.0
+
+
+def test_latency_qps_is_windowed_not_lifetime():
+    lr = LatencyRecorder()
+    # simulate a long-lived process: constructed 1000s ago, traffic NOW
+    lr._t0 -= 1000.0
+    for _ in range(32):
+        lr.observe_us(50.0)
+    st = lr.stats()
+    assert st["count"] == 32            # count stays lifetime
+    # lifetime-based estimate would be 32/1000 = 0.032; windowed must see
+    # the current burst (32 samples within the 16s window -> >= 2/s)
+    assert st["qps"] >= 1.0
+    # and an idle recorder's rate decays to zero once the window passes
+    lr2 = LatencyRecorder()
+    lr2.observe_us(10.0)
+    now = time.monotonic() + 60       # pretend a minute passed
+    assert lr2.windowed_qps(now=now) == 0.0
+
+
+def test_prometheus_rendering_parses_back():
+    m = MetricsRegistry()
+    m.counter("rpc.requests", labels={"service": "index"}).add(5)
+    m.gauge("store.region.key_count", region_id=3).set(42)
+    lat = m.latency("vector_search", region_id=3)
+    for v in (100.0, 200.0):
+        lat.observe_us(v)
+    text = m.render_prometheus()
+    assert parse_prometheus(text)  # strict line grammar
+    series = parse_prometheus(text)
+    assert series[("rpc_requests", (("service", "index"),))] == 5.0
+    assert series[("store_region_key_count", (("region", "3"),))] == 42.0
+    assert series[
+        ("vector_search_count", (("region", "3"),))
+    ] == 2.0
+    assert ("vector_search", (("quantile", "0.5"), ("region", "3"))) in series
+
+
+_LINE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})? (-?[0-9.eE+\-]+|NaN)$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text):
+    """Minimal strict parser: every exposition line must match the text
+    format grammar; returns {(name, sorted-label-tuple): value}."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, labelstr, value = m.groups()
+        labels = tuple(sorted(_LABEL_RE.findall(labelstr or "")))
+        out[(name, labels)] = float(value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# two-store pipeline (tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def two_store_cluster():
+    transport = LocalTransport()
+    coord = CoordinatorControl(MemEngine(), replication=2)
+    nodes = {
+        sid: StoreNode(sid, transport, coord, raft_kw={"seed": i})
+        for i, sid in enumerate(["s0", "s1"])
+    }
+    yield coord, nodes
+    for n in nodes.values():
+        n.stop()
+
+
+def drive_until_leader(coord, nodes, region_id, timeout=6.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for n in nodes.values():
+            n.heartbeat_once()
+        leaders = [
+            n for n in nodes.values()
+            if (rn := n.engine.get_node(region_id)) is not None
+            and rn.is_leader()
+        ]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.03)
+    raise AssertionError(f"no leader for region {region_id}")
+
+
+def force_fresh_beats(nodes):
+    for n in nodes.values():
+        n.metrics._latest_mono = 0.0   # invalidate the snapshot cache
+        n.heartbeat_once()
+
+
+def test_metrics_flow_two_store_cluster(two_store_cluster):
+    coord, nodes = two_store_cluster
+    definition = coord.create_region(
+        start_key=vcodec.encode_vector_key(0, 0),
+        end_key=vcodec.encode_vector_key(0, 1 << 40),
+        region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(
+            index_type=IndexType.FLAT, dimension=8),
+    )
+    rid = definition.region_id
+    leader = drive_until_leader(coord, nodes, rid)
+    region = leader.get_region(rid)
+    n_vec = 12
+    leader.storage.vector_add(
+        region, np.arange(n_vec, dtype=np.int64),
+        np.random.default_rng(0).standard_normal((n_vec, 8))
+        .astype(np.float32),
+    )
+    force_fresh_beats(nodes)
+
+    # --- coordinator holds both stores' snapshots, fresh
+    rows = coord.get_store_metrics()
+    assert [r[0] for r in rows] == ["s0", "s1"]
+    for sid, snap, _at, stale in rows:
+        assert not stale
+        rm = snap.region(rid)
+        assert rm.key_count == n_vec          # raft-replicated to both
+        assert rm.vector_count == n_vec
+        assert rm.vector_memory_bytes > 0
+        assert rm.device_memory_bytes > 0     # live jax arrays (HBM analog)
+        assert rm.approximate_bytes > 0
+    leaders = [r for r in coord.get_region_metrics(rid) if r[2].is_leader]
+    assert len(leaders) == 1 and leaders[0][0] == leader.store_id
+
+    # --- queryable over the service surface (GetStoreMetrics RPC impl)
+    stat = ClusterStatService(coord)
+    resp = stat.GetStoreMetrics(pb.GetStoreMetricsRequest())
+    assert {e.store_id for e in resp.stores} == {"s0", "s1"}
+    entry = next(e for e in resp.stores if e.store_id == leader.store_id)
+    pb_rm = next(r for r in entry.metrics.regions if r.region_id == rid)
+    assert pb_rm.vector_count == n_vec and pb_rm.device_memory_bytes > 0
+    region_resp = stat.GetRegionMetrics(
+        pb.GetRegionMetricsRequest(region_id=rid))
+    assert len(region_resp.regions) == 2
+
+    # --- GetClusterStat rollups (leader-only logical counts)
+    cs = stat.GetClusterStat(pb.GetClusterStatRequest())
+    assert cs.total_vector_count == n_vec
+    assert cs.total_key_count == n_vec
+    assert cs.total_device_memory_bytes > 0
+    lead_stat = next(
+        s for s in cs.stores if s.store_id == leader.store_id)
+    assert lead_stat.vector_count == n_vec and not lead_stat.metrics_stale
+
+    # --- cluster top renders both tables
+    table = format_cluster_top(resp)
+    assert "STORE" in table and "REGION" in table
+    assert str(rid) in table and "s0" in table and "s1" in table
+    assert "L" in table  # a leader row
+
+    # --- scrapeable as VALID prometheus text (parse-back)
+    from dingo_tpu.common.metrics import METRICS
+
+    series = parse_prometheus(METRICS.render_prometheus())
+    key = ("store_region_vector_count", (("region", str(rid)),))
+    assert series[key] == float(n_vec)
+    assert series[
+        ("store_region_device_memory_bytes", (("region", str(rid)),))
+    ] > 0
+
+    # --- DebugService format switch serves the same payload in-band
+    dump = DebugService().MetricsDump(
+        pb.MetricsDumpRequest(format="prometheus"))
+    assert parse_prometheus(dump.json)[key] == float(n_vec)
+    bad = DebugService().MetricsDump(pb.MetricsDumpRequest(format="xml"))
+    assert bad.error.errcode
+
+
+def test_metrics_staleness_after_store_stops_beating(two_store_cluster):
+    coord, nodes = two_store_cluster
+    for n in nodes.values():
+        n.heartbeat_once()
+    rows = coord.get_store_metrics()
+    assert rows and all(not stale for *_x, stale in rows)
+    # the store stops beating; judged from the coordinator's receive clock
+    future = int(time.time() * 1000) + coord.METRICS_STALE_MS + 1
+    rows = coord.get_store_metrics(now_ms=future)
+    assert rows and all(stale for *_x, stale in rows)
+    # stale snapshots drop out of cluster rollups
+    assert coord.cluster_metrics_rollup(now_ms=future) == {
+        "key_count": 0, "vector_count": 0,
+        "memory_bytes": 0, "device_memory_bytes": 0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# load-aware balancing (tentpole acceptance: plans on skew count mode misses)
+# ---------------------------------------------------------------------------
+
+def _inject_cluster(hot_qps=100.0, warm_qps=10.0, cold_qps=1.0):
+    """Two stores, three regions. s0 leads {1 (hot), 2 (warm)}, s1 leads
+    {3 (cold)} — a 2-vs-1 leader split is inside count mode's
+    `n_most <= n_least + 1` dead band, but the measured load is skewed."""
+    from dingo_tpu.store.region import RegionDefinition
+
+    coord = CoordinatorControl(MemEngine(), replication=2)
+    coord.register_store("s0")
+    coord.register_store("s1")
+    for rid in (1, 2, 3):
+        coord.regions[rid] = RegionDefinition(
+            region_id=rid, start_key=b"", end_key=b"",
+            peers=["s0", "s1"],
+        )
+    qps = {1: hot_qps, 2: warm_qps, 3: cold_qps}
+
+    def snap(store_id, led):
+        return StoreMetricsSnapshot(
+            store_id=store_id,
+            regions=[
+                RegionMetricsSnapshot(
+                    region_id=r, is_leader=(r in led),
+                    search_qps=qps[r] if r in led else 0.0,
+                    vector_memory_bytes=1 << 20,
+                )
+                for r in (1, 2, 3)
+            ],
+        )
+
+    coord.store_heartbeat(
+        "s0", region_ids=[1, 2, 3], leader_region_ids=[1, 2],
+        metrics=snap("s0", {1, 2}))
+    coord.store_heartbeat(
+        "s1", region_ids=[1, 2, 3], leader_region_ids=[3],
+        metrics=snap("s1", {3}))
+    return coord
+
+
+def test_load_aware_balance_plans_where_count_mode_does_not():
+    coord = _inject_cluster()
+    count_plan = BalanceLeaderScheduler(coord, mode="count").plan()
+    assert count_plan == []       # 2-vs-1 leaders: count's dead band
+    load_plan = BalanceLeaderScheduler(coord, mode="load").plan()
+    assert len(load_plan) == 1
+    op = load_plan[0]
+    # the HOT region moves (heaviest-first), not the warm one
+    assert (op.region_id, op.from_store, op.to_store) == (1, "s0", "s1")
+
+
+def test_load_aware_balance_falls_back_on_stale_metrics():
+    coord = _inject_cluster()
+    # age the metrics past the staleness gate: load mode must fall back to
+    # count (which sees balance) instead of acting on dead figures
+    for sid in list(coord.store_metrics):
+        snap, _at = coord.store_metrics[sid]
+        coord.store_metrics[sid] = (
+            snap, _at - coord.METRICS_STALE_MS - 1000)
+    assert BalanceLeaderScheduler(coord, mode="load").plan() == []
+
+
+def test_load_aware_balance_does_not_ping_pong_single_hot_leader():
+    """One dominant leader, zero-load peer: moving it would mirror the
+    skew exactly and the next tick would move it back — the strict
+    gap-shrink guard must refuse (review fix)."""
+    from dingo_tpu.store.region import RegionDefinition
+
+    coord = CoordinatorControl(MemEngine(), replication=2)
+    coord.register_store("s0")
+    coord.register_store("s1")
+    coord.regions[1] = RegionDefinition(
+        region_id=1, start_key=b"", end_key=b"", peers=["s0", "s1"])
+    coord.store_heartbeat(
+        "s0", region_ids=[1], leader_region_ids=[1],
+        metrics=StoreMetricsSnapshot("s0", regions=[
+            RegionMetricsSnapshot(region_id=1, is_leader=True,
+                                  search_qps=500.0)]))
+    coord.store_heartbeat(
+        "s1", region_ids=[1], leader_region_ids=[],
+        metrics=StoreMetricsSnapshot("s1", regions=[
+            RegionMetricsSnapshot(region_id=1)]))
+    assert BalanceLeaderScheduler(coord, mode="load").plan() == []
+
+
+def test_load_aware_balance_ignores_noise_gaps():
+    # sub-unit load gap (hysteresis floor): no churn over 0.2 QPS skew
+    coord = _inject_cluster(hot_qps=0.2, warm_qps=0.0, cold_qps=0.0)
+    assert BalanceLeaderScheduler(coord, mode="load").plan() == []
+
+
+def test_load_aware_balance_no_op_when_load_is_even():
+    # s0: 5 + 5, s1: 10 — equal measured load, no transfer despite 2-vs-1
+    coord = _inject_cluster(hot_qps=5.0, warm_qps=5.0, cold_qps=10.0)
+    assert BalanceLeaderScheduler(coord, mode="load").plan() == []
+
+
+# ---------------------------------------------------------------------------
+# collector resilience (review fixes)
+# ---------------------------------------------------------------------------
+
+def test_failed_collection_keeps_last_good_snapshot(two_store_cluster):
+    coord, nodes = two_store_cluster
+    node = nodes["s0"]
+    node.heartbeat_once()
+    good = node.metrics.collect()
+    assert good.engine_key_count >= 0
+    # break the engine count: the pass fails, but the last GOOD snapshot
+    # must keep shipping (an empty one would zero the coordinator's view
+    # and bait load-aware balancing toward the malfunctioning store)
+    orig = node.raw.count
+    node.raw.count = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("compaction"))
+    errors_before = node.metrics.collect_errors
+    node.metrics._latest_mono = 0.0
+    got = node.metrics.collect()
+    node.raw.count = orig
+    assert node.metrics.collect_errors > errors_before
+    assert got is good                       # not the broken partial snap
+    assert node.metrics.latest is good
+
+
+def test_dropped_region_series_leave_the_registry(two_store_cluster):
+    coord, nodes = two_store_cluster
+    definition = coord.create_region(
+        start_key=b"", end_key=b"", region_type=RegionType.STORE)
+    rid = definition.region_id
+    leader = drive_until_leader(coord, nodes, rid)
+    from dingo_tpu.common.metrics import METRICS
+
+    leader.metrics.collect()
+    key = f"store.region.key_count{{region={rid}}}"
+    assert key in METRICS.dump()
+    leader.delete_region(rid)
+    leader.metrics.collect()
+    # the region's gauges must not report last values forever
+    assert key not in METRICS.dump()
+
+
+# ---------------------------------------------------------------------------
+# plain-HTTP exposition (scrapers can't speak grpc)
+# ---------------------------------------------------------------------------
+
+def test_metrics_http_server_scrape():
+    import json
+    import urllib.request
+
+    from dingo_tpu.metrics.http import MetricsHttpServer
+
+    m = MetricsRegistry()
+    m.gauge("store.engine.key_count").set(77)
+    m.counter("rpc.requests").add(3)
+    srv = MetricsHttpServer(port=0, registry=m)
+    port = srv.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            series = parse_prometheus(r.read().decode())
+        assert series[("store_engine_key_count", ())] == 77.0
+        assert series[("rpc_requests", ())] == 3.0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/vars", timeout=5
+        ) as r:
+            assert json.load(r)["store.engine.key_count"] == 77.0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        ) as r:
+            assert r.read() == b"ok\n"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# metrics_report tool
+# ---------------------------------------------------------------------------
+
+def test_metrics_report_rates():
+    import importlib
+
+    mr = importlib.import_module("tools.metrics_report")
+    before = {
+        "vector_add{region=1}": 100,
+        "store.region.key_count{region=1}": 500,
+        "vector_search{region=1}": {"count": 10, "qps": 1.0,
+                                    "avg_us": 100.0, "p50_us": 90.0,
+                                    "p99_us": 200.0},
+    }
+    after = {
+        "vector_add{region=1}": 400,
+        "store.region.key_count{region=1}": 800,
+        "vector_search{region=1}": {"count": 110, "qps": 10.0,
+                                    "avg_us": 100.0, "p50_us": 95.0,
+                                    "p99_us": 210.0},
+        "new.series": 7,
+    }
+    text = mr.report(before, after, seconds=10.0)
+    assert "vector_add{region=1}" in text
+    assert "+30.00/s" in text             # (400-100)/10
+    assert "rate=10.00/s" in text         # (110-10)/10 search calls
+    assert "added" in text                # new.series

@@ -1,0 +1,109 @@
+"""Lint metric registration sites: names must be lowercase dotted
+identifiers (`store.region.key_count`).
+
+Why a lint and not a runtime assert: Prometheus exposition mangles dots to
+underscores; a name that's already shaped like an identifier survives
+mangling losslessly, and series can't silently collide or drop after the
+rename. Dynamic names (f-strings like `span.{name}`) can't be checked
+statically — their static prefix is validated and the runtime mangler
+keeps the rest legal — but every literal registration must pass here.
+
+Wired as a tier-1 test (tests/test_metrics_names.py) so a bad name fails
+CI, not the scrape.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC_DIRS = ("dingo_tpu",)
+
+#: the registration methods on MetricsRegistry
+_METHODS = {"counter", "gauge", "latency"}
+
+#: full-name rule (common/metrics.py METRIC_NAME_RE)
+NAME_RE = re.compile(r"^[a-z][a-z0-9_.]*$")
+#: rule for the static prefix of an f-string name: same alphabet, and it
+#: must not end an identifier segment mid-word ambiguity — a trailing
+#: '.'/'_' separator or a clean segment both pass
+PREFIX_RE = re.compile(r"^[a-z][a-z0-9_.]*$")
+
+
+def _name_arg(call: ast.Call):
+    """First positional arg or name= kwarg of a registration call."""
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+def check_file(path: str) -> List[Tuple[int, str]]:
+    with open(path) as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    problems: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr in _METHODS):
+            continue
+        # only registry-shaped receivers: METRICS.counter(...), m.gauge(...),
+        # registry.latency(...) — skip unrelated .counter() methods by
+        # requiring a string-ish name argument
+        arg = _name_arg(node)
+        if arg is None:
+            continue
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            name = arg.value
+            if not NAME_RE.match(name):
+                problems.append((
+                    node.lineno,
+                    f"metric name {name!r} is not a lowercase dotted "
+                    "identifier",
+                ))
+        elif isinstance(arg, ast.JoinedStr):
+            # f-string: validate the leading literal fragment
+            if arg.values and isinstance(arg.values[0], ast.Constant):
+                prefix = str(arg.values[0].value)
+                if prefix and not PREFIX_RE.match(prefix.rstrip("._")):
+                    problems.append((
+                        node.lineno,
+                        f"dynamic metric name prefix {prefix!r} is not a "
+                        "lowercase dotted identifier",
+                    ))
+    return problems
+
+
+def main(argv=None) -> int:
+    bad = 0
+    checked = 0
+    for src in SRC_DIRS:
+        for dirpath, _dirnames, filenames in os.walk(os.path.join(REPO, src)):
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                checked += 1
+                for lineno, msg in check_file(path):
+                    rel = os.path.relpath(path, REPO)
+                    print(f"{rel}:{lineno}: {msg}", file=sys.stderr)
+                    bad += 1
+    if bad:
+        print(f"{bad} bad metric name(s)", file=sys.stderr)
+        return 1
+    print(f"metric names OK ({checked} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,110 @@
+"""Rate-of-change report between two metrics dumps.
+
+Workflow (bvar-style capacity/throughput eyeballing without Prometheus):
+
+    dingo-cli debug metrics > t0.json; sleep 30
+    dingo-cli debug metrics > t1.json
+    python tools/metrics_report.py t0.json t1.json --seconds 30
+
+Counters and latency-series counts render as deltas + per-second rates;
+gauges render old -> new with the delta. Keys only present in one dump are
+reported as added/removed (a restart or region move shows up immediately).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Tuple
+
+
+def _flatten(dump: Dict) -> Tuple[Dict[str, float], Dict[str, float], Dict[str, dict]]:
+    """Split a MetricsDump payload into (counters+gauges, latency counts,
+    latency stat dicts). Scalars are indistinguishable counter-vs-gauge in
+    the dump — deltas are meaningful either way."""
+    scalars: Dict[str, float] = {}
+    lat_counts: Dict[str, float] = {}
+    lat_stats: Dict[str, dict] = {}
+    for key, value in dump.items():
+        if isinstance(value, dict) and "count" in value:
+            lat_counts[key] = float(value.get("count", 0))
+            lat_stats[key] = value
+        elif isinstance(value, (int, float)):
+            scalars[key] = float(value)
+    return scalars, lat_counts, lat_stats
+
+
+def _fmt(v: float) -> str:
+    return f"{v:.2f}".rstrip("0").rstrip(".") if isinstance(v, float) else str(v)
+
+
+def report(before: Dict, after: Dict, seconds: float,
+           min_rate: float = 0.0) -> str:
+    s0, c0, _ = _flatten(before)
+    s1, c1, st1 = _flatten(after)
+    lines = []
+
+    rows = []
+    for key in sorted(set(s0) | set(s1)):
+        if key not in s1:
+            rows.append((key, "removed", "", ""))
+            continue
+        if key not in s0:
+            rows.append((key, "added", _fmt(s1[key]), ""))
+            continue
+        delta = s1[key] - s0[key]
+        rate = delta / seconds
+        if delta == 0 or abs(rate) < min_rate:
+            continue
+        rows.append((key, _fmt(delta), _fmt(s1[key]), f"{rate:+.2f}/s"))
+    if rows:
+        lines.append("== counters / gauges ==")
+        w = max(len(r[0]) for r in rows)
+        for key, delta, now, rate in rows:
+            lines.append(f"{key.ljust(w)}  delta={delta:<12} now={now:<12} {rate}")
+
+    rows = []
+    for key in sorted(set(c0) | set(c1)):
+        d = c1.get(key, 0.0) - c0.get(key, 0.0)
+        rate = d / seconds
+        if d <= 0 or rate < min_rate:
+            continue
+        st = st1.get(key, {})
+        rows.append((
+            key, _fmt(d), f"{rate:.2f}/s",
+            _fmt(st.get("p50_us", 0.0)), _fmt(st.get("p99_us", 0.0)),
+        ))
+    if rows:
+        lines.append("")
+        lines.append("== latency series (window percentiles at t1) ==")
+        w = max(len(r[0]) for r in rows)
+        for key, d, rate, p50, p99 in rows:
+            lines.append(
+                f"{key.ljust(w)}  calls={d:<10} rate={rate:<10} "
+                f"p50_us={p50:<10} p99_us={p99}"
+            )
+    return "\n".join(lines) if lines else "(no movement between dumps)"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="metrics_report")
+    p.add_argument("before", help="earlier `debug metrics` JSON dump")
+    p.add_argument("after", help="later dump")
+    p.add_argument("--seconds", type=float, default=1.0,
+                   help="wall time between the dumps (rates divide by this)")
+    p.add_argument("--min-rate", type=float, default=0.0,
+                   help="hide series moving slower than this per second")
+    args = p.parse_args(argv)
+    if args.seconds <= 0:
+        p.error("--seconds must be positive")
+    with open(args.before) as f:
+        before = json.load(f)
+    with open(args.after) as f:
+        after = json.load(f)
+    print(report(before, after, args.seconds, args.min_rate))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
