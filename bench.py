@@ -204,7 +204,9 @@ def main():
     log(f"operating point: nprobe={nprobe} recall@10={recall:.4f}")
 
     # --- QPS at the operating point (pipelined dispatch) ---
-    idx.search(queries, k, nprobe=nprobe)  # warm compile at this batch
+    # jit-warmup: pre-compile the shape-bucketed programs so neither loop
+    # below pays an XLA compile mid-measurement
+    idx.warmup(batches=(batch,), topk=k, nprobe=nprobe)
     iters = 50
     t0 = time.perf_counter()
     thunks = [idx.search_async(queries, k, nprobe=nprobe) for _ in range(iters)]
@@ -225,6 +227,37 @@ def main():
     p99 = lats[min(lat_iters - 1, int(lat_iters * 0.99))]
     log(f"{platform.upper()} blocking batch={batch}: "
         f"p50={p50:.2f} ms p99={p99:.2f} ms")
+
+    # --- mixed read/write: searches with upserts+deletes in flight ---
+    # The Index role's real workload: raft-applied writes continuously
+    # mutate the region while searches serve. Before incremental view
+    # maintenance every search after a write re-gathered the WHOLE
+    # bucketed view (O(N) host gather + H2D), so this p99 was the rebuild
+    # cliff; with append-in-place + tombstones it must stay near the
+    # read-only p99.
+    from dingo_tpu.common.metrics import METRICS
+
+    wb = int(os.environ.get("DINGO_BENCH_WRITE_BATCH", 256))
+    mixed_iters = 30
+    rebuilds_c = METRICS.counter("ivf.full_rebuild", region_id=1)
+    rebuilds0 = rebuilds_c.get()
+    mlats = []
+    for it in range(mixed_iters):
+        sel = rng.choice(n, wb, replace=False)
+        idx.delete(ids[sel[: wb // 2]])          # half deletes...
+        idx.upsert(ids[sel], x[sel])             # ...re-added + overwrites
+        t0 = time.perf_counter()
+        idx.search(queries, k, nprobe=nprobe)
+        mlats.append((time.perf_counter() - t0) * 1e3)
+    mlats.sort()
+    m_p50 = mlats[mixed_iters // 2]
+    m_p99 = mlats[min(mixed_iters - 1, int(mixed_iters * 0.99))]
+    rebuilds = rebuilds_c.get() - rebuilds0
+    vstats = idx.view_stats() if hasattr(idx, "view_stats") else {}
+    log(f"{platform.upper()} mixed r/w batch={batch} writes={wb}+{wb//2}/iter: "
+        f"p50={m_p50:.2f} ms p99={m_p99:.2f} ms "
+        f"(read-only p99={p99:.2f}; {rebuilds} full rebuilds, "
+        f"{vstats.get('inplace_appends', 0)} in-place appends)")
 
     # --- CPU baseline: numpy/OpenBLAS IVF-flat with same layout ---
     centroids = np.asarray(idx.centroids)
@@ -276,6 +309,19 @@ def main():
         "pipelined_ms_per_batch": round(dt * 1e3, 3),
         "p50_ms": round(p50, 3),
         "p99_ms": round(p99, 3),
+        # rebuild-cliff gate: search latency with writes in flight must
+        # stay within ~2x of the read-only p99 (ISSUE 3 acceptance)
+        "mixed_rw": {
+            "write_batch": wb + wb // 2,
+            "p50_ms": round(m_p50, 3),
+            "p99_ms": round(m_p99, 3),
+            "p99_vs_readonly": round(m_p99 / max(p99, 1e-9), 2),
+            "full_rebuilds": int(rebuilds),
+            "inplace_appends": int(vstats.get("inplace_appends", 0)),
+            "tombstone_ratio": round(
+                float(vstats.get("tombstone_ratio", 0.0)), 4
+            ),
+        },
     }
     if platform == "tpu":
         result["measured_at"] = time.time()

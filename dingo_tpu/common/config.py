@@ -142,6 +142,23 @@ FLAGS.define("slow_query_ms", 500.0, mutable=True,
              help_="a sampled root span slower than this lands in the "
                    "slow-query log (retained separately from the span "
                    "ring so fast-trace churn cannot evict slow evidence)")
+FLAGS.define("ivf_compact_interval_s", 60.0, mutable=True,
+             help_="period of the IVF view-compaction crontab: restores "
+                   "the dense bucket layout (full rebuild) off the search "
+                   "path once tombstone/spill garbage accumulates")
+FLAGS.define("ivf_compact_tombstone_ratio", 0.25, mutable=True,
+             help_="compact an IVF view once tombstoned rows exceed this "
+                   "fraction of (live + tombstoned) — dead rows still burn "
+                   "scan FLOPs until compaction reclaims them")
+FLAGS.define("ivf_compact_spill_ratio", 0.5, mutable=True,
+             help_="compact once incremental appends allocated this many "
+                   "extra spill buckets relative to the dense build — "
+                   "ragged chains cost probe-expansion budget")
+FLAGS.define("ivf_shape_bucketing", True, mutable=True,
+             help_="round (topk, nprobe) up to the {1,1.5}x-pow2 ladder so "
+                   "steady-state serving reuses a handful of compiled "
+                   "programs instead of recompiling per request shape; "
+                   "results are sliced back to the requested topk")
 FLAGS.define("use_pallas_ivf_search", "auto", mutable=True,
              help_="route trained IVF_FLAT searches through the Pallas "
                    "list-DMA kernel (streams only probed buckets to VMEM; "

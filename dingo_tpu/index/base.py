@@ -115,6 +115,26 @@ class FilterSpec:
             and self.exclude_ids is None
         )
 
+    def fingerprint(self) -> bytes:
+        """Stable content digest — the cache key for compiled per-slot
+        masks (the IVF filter-mask cache keys on (fingerprint, view
+        version) so a repeated filter skips the numpy mask build + H2D).
+        Hashing beats keeping the arrays: an include set can be 100k ids
+        and the key must be cheap to compare."""
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=16)
+        for lo, hi in self.ranges or ():
+            h.update(int(lo).to_bytes(8, "little", signed=True))
+            h.update(int(hi).to_bytes(8, "little", signed=True))
+        for tag, ids in ((b"i", self.include_ids), (b"x", self.exclude_ids)):
+            if ids is not None:
+                h.update(tag)
+                h.update(np.ascontiguousarray(
+                    np.asarray(ids, np.int64)
+                ).tobytes())
+        return h.digest()
+
     def slot_mask(self, ids_by_slot: np.ndarray) -> np.ndarray:
         """Compile this filter against the HOST id-by-slot array
         [capacity] int64 (-1 = empty slot) -> bool mask [capacity].

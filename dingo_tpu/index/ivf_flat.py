@@ -13,10 +13,16 @@ TPU-first design:
            convention), deterministic farthest-first init.
   layout — ground truth lives in a flat SlotStore (same arrays as TpuFlat);
            a *bucketed view* [B, cap_list, d] of fixed-width spill buckets
-           (ivf_layout.py) is (re)built lazily after mutations. cap_list
-           tracks the MEAN list size; long lists spill into extra buckets,
-           so HBM is bounded by ~n*d + nlist*cap_list*d regardless of
-           assignment skew.
+           (ivf_layout.py) is maintained INCREMENTALLY: upserts append
+           into free rows of the assigned list's tail bucket via small
+           donated scatters, deletes flip the row invalid, and a deferred
+           compaction (crontab / threshold-driven, see IvfViewMaintenance)
+           restores the dense layout off the hot path. The full rebuild
+           survives only as the compaction/restore fallback — a write
+           between two searches no longer costs an O(N) host gather.
+           cap_list tracks the MEAN list size; long lists spill into extra
+           buckets, so HBM is bounded by ~n*d + nlist*cap_list*d
+           regardless of assignment skew.
   search — [b, nlist] centroid scores -> top-nprobe coarse lists ->
            on-device expansion to virtual bucket probes -> lax.scan over
            probe ranks: gather one bucket per query per rank
@@ -52,9 +58,16 @@ from dingo_tpu.index.base import (
     VectorIndex,
     strip_invalid,
 )
+from dingo_tpu.common.config import FLAGS
+from dingo_tpu.common.metrics import METRICS
 from dingo_tpu.index.flat import BinaryPm1Mixin, _SlotStoreIndex, _pad_batch
-from dingo_tpu.index.ivf_layout import BucketLayout, build_layout, expand_probes
+from dingo_tpu.index.ivf_layout import (
+    MutableIvfView,
+    expand_probes,
+    shape_bucket,
+)
 from dingo_tpu.index.slot_store import SlotStore, _next_pow2
+from dingo_tpu.trace import TRACER
 from dingo_tpu.ops.distance import (
     Metric,
     normalize,
@@ -168,7 +181,267 @@ def _ivf_scan_kernel(
     return scores_to_distances(vals, metric), slots
 
 
-class TpuIvfFlat(_SlotStoreIndex):
+@jax.jit
+def _filter_bucket_mask(slot_mask, bucket_slot):
+    """Expand a [capacity] slot mask to [B, cap_list] ON DEVICE. The
+    filtered path used to build (and upload) the full bucket-shaped mask
+    in numpy per request; uploading the slot-level delta and expanding it
+    against the resident bucket_slot map keeps the per-request H2D at
+    [capacity] bools."""
+    safe = jnp.where(bucket_slot >= 0, bucket_slot, 0)
+    return jnp.take(slot_mask, safe, axis=0) & (bucket_slot >= 0)
+
+
+#: filter-mask cache entries kept per index (distinct live filter shapes
+#: per region are few: the region's base id-window plus ad-hoc id sets)
+FILTER_CACHE_SIZE = 16
+
+
+class IvfViewMaintenance:
+    """Incremental bucketed-view lifecycle shared by TpuIvfFlat and
+    TpuIvfPq: append-in-place upserts, tombstone deletes, deferred
+    compaction, the filter-mask cache, and (batch, k, nprobe) shape
+    bucketing. Subclasses own the bucket-shaped DATA arrays and implement
+    the two hooks `_materialize_view_data` / `_scatter_view_data`.
+
+    Counters/spans (tools/check_metrics_names.py naming contract):
+      ivf.inplace_appends / ivf.tombstones / ivf.full_rebuild /
+      ivf.compactions counters, ivf.tombstone_ratio gauge; spans
+      ivf.append_inplace / ivf.compact / ivf.full_rebuild.
+    """
+
+    _view: Optional[MutableIvfView]
+    _view_dirty: bool
+
+    # -- hooks (owning index's data arrays) --------------------------------
+    def _materialize_view_data(self, view: MutableIvfView) -> None:
+        raise NotImplementedError
+
+    def _scatter_view_data(self, upd, rows) -> None:
+        raise NotImplementedError
+
+    def _warmup_queries(self, b: int) -> np.ndarray:
+        return np.ones((b, self.dimension), np.float32)
+
+    # -- view lifecycle ----------------------------------------------------
+    def _ensure_view(self) -> None:
+        """Hot-path entry: only (re)builds when there is no usable view —
+        steady-state searches find a fresh view and do nothing here."""
+        if self._view is None or self._view_dirty:
+            self._rebuild_view("search")
+
+    def _rebuild_view(self, reason: str = "search") -> None:
+        """Full dense rebuild (build_layout + gather). On the hot path
+        this survives only as the restore fallback (first search after
+        train/load, or a write batch too large to point-scatter); the
+        compaction path runs it deliberately, off the serving path."""
+        compacting = reason == "compact"
+        name = "ivf.compact" if compacting else "ivf.full_rebuild"
+        with TRACER.start_span(name) as span:
+            with self.store.device_lock:
+                # the WHOLE rebuild under one hold: the host snapshot
+                # (assign/valid), the data gather, and the view swap. A
+                # write landing mid-rebuild would otherwise be captured by
+                # neither the snapshot nor the (orphaned) old view — and
+                # nothing would mark the fresh view dirty.
+                view = MutableIvfView.build(
+                    self._assign_h, self.store.valid_h, self.nlist,
+                    self.store.capacity,
+                )
+                self._materialize_view_data(view)
+                self._view = view
+                self._view_dirty = False
+                self._filter_cache.clear()
+            if span.sampled:
+                span.set_attr("region_id", self.id)
+                span.set_attr("buckets", view.nbuckets)
+                span.set_attr("rows", view.live_rows)
+        METRICS.counter(
+            "ivf.compactions" if compacting else "ivf.full_rebuild",
+            region_id=self.id,
+        ).add(1)
+        self._update_view_gauges()
+
+    def _invalidate_view(self) -> None:
+        with self.store.device_lock:
+            # lock pairs with the filtered-search path, which iterates
+            # _filter_cache under the same lock (an unlocked clear() could
+            # land mid-iteration and crash the search)
+            self._view_dirty = True
+            self._filter_cache.clear()
+
+    def _update_view_gauges(self) -> None:
+        v = self._view
+        if v is not None:
+            METRICS.gauge("ivf.tombstone_ratio", region_id=self.id).set(
+                v.tombstone_ratio()
+            )
+
+    # -- incremental write path --------------------------------------------
+    def _view_apply_upsert(self, slots, assign, rows) -> None:
+        from dingo_tpu.ops.scatter import MAX_SCATTER_BATCH
+
+        if len(slots) > MAX_SCATTER_BATCH:
+            # batch big enough to amortize a dense rebuild — defer it
+            self._invalidate_view()
+            return
+        with TRACER.start_span("ivf.append_inplace") as span:
+            # stage (host bookkeeping) + apply (donated scatters) under
+            # ONE device_lock hold: a search dispatching concurrently must
+            # never observe staged host state (max_spill, probe chains)
+            # ahead of the device arrays it describes. self._view re-read
+            # inside the hold: a concurrent compaction may have swapped it.
+            with self.store.device_lock:
+                view = self._view
+                if view is None or self._view_dirty:
+                    self._view_dirty = True   # raced with invalidation
+                    return
+                view.ensure_slot_capacity(self.store.capacity)
+                upd = view.stage_upsert(slots, np.asarray(assign))
+                if upd is None:               # no-op batch
+                    return
+                view.apply_device(upd)
+                self._scatter_view_data(upd, rows)
+            if span.sampled:
+                span.set_attr("region_id", self.id)
+                span.set_attr("rows", int(len(slots)))
+        METRICS.counter("ivf.inplace_appends", region_id=self.id).add(
+            len(upd.appended)
+        )
+        self._update_view_gauges()
+
+    def _view_apply_delete(self, slots) -> None:
+        with self.store.device_lock:
+            view = self._view
+            if view is None or self._view_dirty:
+                self._view_dirty = True
+                return
+            upd = view.stage_delete(slots)
+            if upd is None:
+                return
+            view.apply_device(upd)
+        METRICS.counter("ivf.tombstones", region_id=self.id).add(
+            len(upd.touched)
+        )
+        self._update_view_gauges()
+
+    # -- compaction --------------------------------------------------------
+    def need_compact(self) -> bool:
+        """True when the view accumulated enough garbage (tombstones /
+        spill buckets) for the dense rebuild to pay for itself, or a
+        deferred full rebuild is pending that the compaction crontab can
+        absorb off the hot path."""
+        v = self._view
+        if v is None:
+            return False
+        if self._view_dirty:
+            return True
+        return (
+            v.tombstone_ratio() >= FLAGS.get("ivf_compact_tombstone_ratio")
+            or v.spill_ratio() >= FLAGS.get("ivf_compact_spill_ratio")
+        )
+
+    def compact(self) -> None:
+        """Rebuild the dense layout now (O(N); callers keep this OFF the
+        serving path — crontab / scrub / tests)."""
+        self._rebuild_view("compact")
+
+    def maybe_compact(self) -> bool:
+        if self.need_compact():
+            self.compact()
+            return True
+        return False
+
+    def view_stats(self) -> dict:
+        out = {"built": self._view is not None, "dirty": self._view_dirty}
+        if self._view is not None:
+            out.update(self._view.stats())
+        return out
+
+    # -- filter-mask cache -------------------------------------------------
+    def _prep_filter_mask(self, filter_spec: Optional[FilterSpec]):
+        """Host-side filter work done OUTSIDE the device lock: fingerprint
+        hashing and the O(capacity) numpy slot-mask build can cost
+        milliseconds on big include sets, and must not serialize every
+        concurrent search/write behind the lock. Returns (fp, version,
+        mask_or_None); the in-lock consumer revalidates against the live
+        view version and rebuilds in the (rare) raced case."""
+        if filter_spec is None or filter_spec.is_empty():
+            return None
+        view = self._view
+        fp = filter_spec.fingerprint()
+        ver = view.version if view is not None else -1
+        hit = self._filter_cache.get(fp)
+        if hit is not None and hit[0] == ver:
+            return (fp, ver, None)       # expected cache hit; skip the build
+        return (fp, ver, filter_spec.slot_mask(self.store.ids_by_slot))
+
+    def _bucket_valid_for_filter(
+        self, filter_spec: Optional[FilterSpec], prep=None
+    ):
+        """Device validity mask for the scan kernel. Unfiltered searches
+        reuse the resident bucket_valid (zero per-request H2D); filtered
+        searches hit a (filter-fingerprint, view-version) cache, and a
+        miss uploads only the [capacity] slot mask, expanding it on
+        device (_filter_bucket_mask). Callers hold store.device_lock;
+        pass `prep` from _prep_filter_mask to keep the host work outside
+        the hold."""
+        view = self._view
+        if filter_spec is None or filter_spec.is_empty():
+            return view.bucket_valid
+        fp, ver, mask = prep if prep is not None else (
+            filter_spec.fingerprint(), view.version, None
+        )
+        hit = self._filter_cache.get(fp)
+        if hit is not None and hit[0] == view.version:
+            METRICS.counter("ivf.filter_mask_hits", region_id=self.id).add(1)
+            return hit[1]
+        if mask is None or ver != view.version:
+            # raced with a write since prep (or the expected hit was
+            # evicted): rebuild against the live host state
+            mask = filter_spec.slot_mask(self.store.ids_by_slot)
+        bmask = _filter_bucket_mask(jnp.asarray(mask), view.bucket_slot)
+        if len(self._filter_cache) >= FILTER_CACHE_SIZE:
+            stale = [k for k, (v, _) in self._filter_cache.items()
+                     if v != view.version]
+            for k in stale:
+                del self._filter_cache[k]
+            while len(self._filter_cache) >= FILTER_CACHE_SIZE:
+                self._filter_cache.pop(next(iter(self._filter_cache)))
+        self._filter_cache[fp] = (view.version, bmask)
+        METRICS.counter("ivf.filter_mask_misses", region_id=self.id).add(1)
+        return bmask
+
+    # -- shape bucketing + warmup ------------------------------------------
+    def _shape_buckets(self, topk: int, nprobe: int):
+        """(k_eff, nprobe_eff) on the {1, 1.5}x-pow2 ladder so steady-state
+        serving reuses a handful of compiled programs. k_eff >= topk
+        (resolve slices back); a larger nprobe only adds recall."""
+        if not FLAGS.get("ivf_shape_bucketing"):
+            return topk, nprobe
+        return shape_bucket(topk), min(shape_bucket(nprobe), self.nlist)
+
+    def warmup(self, batches=(1, 8, 64), topk: int = 10,
+               nprobe: Optional[int] = None) -> int:
+        """Pre-compile the steady-state search programs (one per
+        shape-bucketed (batch, k, nprobe) triple) so first real traffic
+        never pays an XLA compile. Returns the number of probe searches
+        issued."""
+        if not self.is_trained():
+            return 0
+        n = 0
+        with TRACER.start_span("ivf.warmup") as span:
+            self._ensure_view()
+            for bsz in batches:
+                self.search(self._warmup_queries(int(bsz)), topk,
+                            nprobe=nprobe)
+                n += 1
+            if span.sampled:
+                span.set_attr("searches", n)
+        return n
+
+
+class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
     #: metric the bucketed scan kernel runs with (the binary subclass scans
     #: with INNER_PRODUCT over ±1 vectors and converts to hamming after)
     _scan_metric: Metric
@@ -187,10 +460,11 @@ class TpuIvfFlat(_SlotStoreIndex):
         self.centroids: Optional[jax.Array] = None       # [nlist, d]
         self._c_sqnorm: Optional[jax.Array] = None
         self._assign_h = np.full((self.store.capacity,), -1, np.int32)
-        self._layout: Optional[BucketLayout] = None
-        self._buckets = None          # [B, cap_list, d]
+        self._view: Optional[MutableIvfView] = None
+        self._buckets = None          # [alloc, cap_list, d]
         self._bucket_sqnorm = None
         self._view_dirty = True
+        self._filter_cache: dict = {}
 
     def _prep_vectors(self, vectors: np.ndarray) -> np.ndarray:
         vectors = np.asarray(vectors, np.float32)
@@ -227,12 +501,24 @@ class TpuIvfFlat(_SlotStoreIndex):
         if self.is_trained():
             assign = np.asarray(kmeans_assign(jnp.asarray(vectors), self.centroids))
             self._assign_h[slots] = assign
-        self._view_dirty = True
+            if self._view is not None and not self._view_dirty:
+                # incremental append-in-place; the next search reuses the
+                # maintained view instead of rebuilding from scratch
+                self._view_apply_upsert(slots, assign, vectors)
+            else:
+                self._invalidate_view()
+        else:
+            self._view_dirty = True
         self.write_count_since_save += len(ids)
 
     def delete(self, ids: np.ndarray) -> None:
-        removed = self.store.remove(np.asarray(ids, np.int64))
-        self._view_dirty = True
+        slots = self.store.remove_slots(np.asarray(ids, np.int64))
+        removed = int((slots >= 0).sum())
+        if removed:
+            if self._view is not None and not self._view_dirty:
+                self._view_apply_delete(slots[slots >= 0])
+            else:
+                self._invalidate_view()
         self.write_count_since_save += removed
 
     # -- training ----------------------------------------------------------
@@ -272,28 +558,41 @@ class TpuIvfFlat(_SlotStoreIndex):
             _, vecs = self.store.gather(self.store.ids_by_slot[live])
             assign = np.asarray(kmeans_assign(jnp.asarray(vecs), self.centroids))
             self._assign_h[live] = assign
-        self._view_dirty = True
+        self._invalidate_view()
 
-    # -- bucketed view ------------------------------------------------------
-    def _rebuild_view(self) -> None:
-        """Group live slots into fixed-width spill buckets (ivf_layout.py)."""
-        lay = build_layout(self._assign_h, self.store.valid_h, self.nlist)
-        self._layout = lay
-        with self.store.device_lock:   # gather reads store.vecs (donatable)
-            self._buckets = lay.gather_rows(self.store.vecs)
-            self._bucket_sqnorm = jnp.take(
-                self.store.sqnorm, lay.gather_idx
-            ).reshape(lay.nbuckets, lay.cap_list)
-        self._view_dirty = False
+    # -- bucketed view (IvfViewMaintenance data hooks) -----------------------
+    def _materialize_view_data(self, view: MutableIvfView) -> None:
+        """Dense gather of the whole store into the bucket coordinates —
+        the O(N) path, reached only via rebuild/compaction. Caller holds
+        device_lock (gather reads store.vecs, which is donatable)."""
+        self._buckets = view.gather_rows(self.store.vecs)
+        self._bucket_sqnorm = view.gather_rows(self.store.sqnorm)
 
-    def _bucket_valid_for_filter(self, filter_spec: Optional[FilterSpec]):
-        if filter_spec is None or filter_spec.is_empty():
-            return self._layout.bucket_valid
-        mask = filter_spec.slot_mask(self.store.ids_by_slot)
-        bucket_slot = self._layout.bucket_slot_h
-        safe = np.where(bucket_slot >= 0, bucket_slot, 0)
-        bmask = mask[safe] & (bucket_slot >= 0)
-        return jnp.asarray(bmask)
+    def _scatter_view_data(self, upd, rows) -> None:
+        """Apply a staged append batch to the data arrays (caller holds
+        device_lock; arrays are donated to the scatter programs)."""
+        from dingo_tpu.ops.scatter import pad_buckets, scatter_bucket_update
+
+        if upd.grew_alloc is not None:
+            self._buckets = pad_buckets(self._buckets, upd.grew_alloc)
+            self._bucket_sqnorm = pad_buckets(
+                self._bucket_sqnorm, upd.grew_alloc
+            )
+        if not upd.appended:
+            return
+        cap = self._view.cap_list
+        pos = np.asarray([p for p, _ in upd.appended], np.int64)
+        src = np.asarray([i for _, i in upd.appended], np.int64)
+        b_idx = (pos // cap).astype(np.int32)
+        r_idx = (pos % cap).astype(np.int32)
+        sel = np.asarray(rows)[src]
+        sq = (sel.astype(np.float32) ** 2).sum(axis=1)
+        self._buckets = scatter_bucket_update(
+            self._buckets, b_idx, r_idx, sel
+        )
+        self._bucket_sqnorm = scatter_bucket_update(
+            self._bucket_sqnorm, b_idx, r_idx, sq
+        )
 
     # -- search -------------------------------------------------------------
     def search(
@@ -315,53 +614,62 @@ class TpuIvfFlat(_SlotStoreIndex):
         if not self.is_trained():
             raise NotTrained("IVF_FLAT not trained")  # reader falls back
         queries = self._prep_queries(queries)
-        if self._view_dirty:
-            self._rebuild_view()
+        self._ensure_view()
         b = queries.shape[0]
+        topk = int(topk)
         nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
+        k_eff, nprobe = self._shape_buckets(topk, nprobe)
         qpad = jnp.asarray(_pad_batch(queries))
-        lay = self._layout
         # lease BEFORE dispatch: kernel slots must stay limbo-parked until
         # resolve translates them (delete+reinsert would misattribute)
         lease = self.store.begin_search()
         try:
             probes = _probe_lists(qpad, self.centroids, self._c_sqnorm, nprobe)
-            vprobes = expand_probes(
-                probes, lay.probe_table, nprobe, lay.max_spill
-            )
-            valid = self._bucket_valid_for_filter(filter_spec)
+            fprep = self._prep_filter_mask(filter_spec)
             from dingo_tpu.common.config import pallas_ivf_enabled
 
-            if (
-                pallas_ivf_enabled(self.dimension)
-                and self.metric in (
-                    Metric.L2, Metric.INNER_PRODUCT, Metric.COSINE
+            # view snapshot + dispatch under the device lock: the
+            # incremental write path DONATES bucket arrays to its scatter
+            # programs, so a concurrent write must not invalidate a
+            # captured reference between here and dispatch (same contract
+            # as slot_store.put); reading self._view inside the same hold
+            # keeps view metadata and self._buckets consistent
+            with self.store.device_lock:
+                view = self._view
+                vprobes = expand_probes(
+                    probes, view.probe_table, nprobe, view.max_spill
                 )
-                and self.store.vecs.dtype in (jnp.float32, jnp.bfloat16)
-                # kernel keeps top-k in a 128-lane output block; larger k
-                # (and its unrolled select rounds) stays on the XLA path
-                and int(topk) <= 64
-            ):
-                from dingo_tpu.ops.distance import metric_ascending
-                from dingo_tpu.ops.pallas_ivf import ivf_list_search
+                valid = self._bucket_valid_for_filter(filter_spec, fprep)
+                if (
+                    pallas_ivf_enabled(self.dimension)
+                    and self.metric in (
+                        Metric.L2, Metric.INNER_PRODUCT, Metric.COSINE
+                    )
+                    and self.store.vecs.dtype in (jnp.float32, jnp.bfloat16)
+                    # kernel keeps top-k in a 128-lane output block; larger
+                    # k (and its unrolled select rounds) stays on XLA
+                    and k_eff <= 64
+                ):
+                    from dingo_tpu.ops.distance import metric_ascending
+                    from dingo_tpu.ops.pallas_ivf import ivf_list_search
 
-                vals, slots = ivf_list_search(
-                    vprobes, qpad, self._buckets, self._bucket_sqnorm,
-                    valid, lay.bucket_slot, k=int(topk),
-                    ascending=metric_ascending(self._scan_metric),
-                )
-                dists = scores_to_distances(vals, self._scan_metric)
-            else:
-                dists, slots = _ivf_scan_kernel(
-                    self._buckets,
-                    self._bucket_sqnorm,
-                    valid,
-                    lay.bucket_slot,
-                    vprobes,
-                    qpad,
-                    k=int(topk),
-                    metric=self._scan_metric,
-                )
+                    vals, slots = ivf_list_search(
+                        vprobes, qpad, self._buckets, self._bucket_sqnorm,
+                        valid, view.bucket_slot, k=k_eff,
+                        ascending=metric_ascending(self._scan_metric),
+                    )
+                    dists = scores_to_distances(vals, self._scan_metric)
+                else:
+                    dists, slots = _ivf_scan_kernel(
+                        self._buckets,
+                        self._bucket_sqnorm,
+                        valid,
+                        view.bucket_slot,
+                        vprobes,
+                        qpad,
+                        k=k_eff,
+                        metric=self._scan_metric,
+                    )
         except Exception:
             lease.release()
             raise
@@ -371,9 +679,10 @@ class TpuIvfFlat(_SlotStoreIndex):
         def resolve() -> List[SearchResult]:
             try:
                 dists_h, slots_h = jax.device_get((dists, slots))
-                ids = store.ids_of_slots(slots_h[:b])
-                dists_h = self._convert_distances(dists_h)
-                return [strip_invalid(i, d) for i, d in zip(ids, dists_h[:b])]
+                # shape bucketing may have run a larger k; slice back
+                ids = store.ids_of_slots(slots_h[:b, :topk])
+                dists_h = self._convert_distances(dists_h[:b, :topk])
+                return [strip_invalid(i, d) for i, d in zip(ids, dists_h)]
             finally:
                 lease.release()
 
@@ -426,7 +735,9 @@ class TpuIvfFlat(_SlotStoreIndex):
             self._c_sqnorm = squared_norms(self.centroids)
             self._assign_h[slots] = data["assign"]
         self.apply_log_id = meta["apply_log_id"]
+        self._view = None
         self._view_dirty = True
+        self._filter_cache.clear()
         self.write_count_since_save = 0
 
 
@@ -456,6 +767,9 @@ class TpuBinaryIvfFlat(BinaryPm1Mixin, TpuIvfFlat):
         self._assign_h = np.full((self.store.capacity,), -1, np.int32)
 
     # packed <-> ±1 codec + distance conversion come from BinaryPm1Mixin
+
+    def _warmup_queries(self, b: int) -> np.ndarray:
+        return np.ones((b, self.nbytes), np.uint8)   # wire format is packed
 
     def train(self, vectors: Optional[np.ndarray] = None) -> None:
         """Float k-means over ±1 space. An explicit train set arrives
@@ -516,5 +830,7 @@ class TpuBinaryIvfFlat(BinaryPm1Mixin, TpuIvfFlat):
             self._c_sqnorm = squared_norms(self.centroids)
             self._assign_h[slots] = data["assign"]
         self.apply_log_id = meta["apply_log_id"]
+        self._view = None
         self._view_dirty = True
+        self._filter_cache.clear()
         self.write_count_since_save = 0

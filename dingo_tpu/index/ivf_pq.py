@@ -44,8 +44,8 @@ from dingo_tpu.index.base import (
     strip_invalid,
 )
 from dingo_tpu.index.flat import _SlotStoreIndex, _flat_search_kernel, _pad_batch
-from dingo_tpu.index.ivf_flat import _probe_lists
-from dingo_tpu.index.ivf_layout import build_layout, expand_probes_ranked
+from dingo_tpu.index.ivf_flat import IvfViewMaintenance, _probe_lists
+from dingo_tpu.index.ivf_layout import MutableIvfView, expand_probes_ranked
 from dingo_tpu.index.slot_store import HostSlotStore, SlotStore, _next_pow2
 from dingo_tpu.ops.distance import Metric, normalize, pairwise_l2sqr, squared_norms
 from dingo_tpu.ops.kmeans import (
@@ -242,7 +242,7 @@ def _ivfpq_scan_kernel(
     return -vals, slots    # wire convention: squared-L2-approx ascending
 
 
-class TpuIvfPq(_SlotStoreIndex):
+class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
     def __init__(self, index_id: int, parameter: IndexParameter):
         VectorIndex.__init__(self, index_id, parameter)
         p = parameter
@@ -266,9 +266,10 @@ class TpuIvfPq(_SlotStoreIndex):
         self.codebooks: Optional[jax.Array] = None       # [m, ksub, dsub]
         self._assign_h = np.full((self.store.capacity,), -1, np.int32)
         self._codes: Optional[jax.Array] = None          # [capacity, m] uint8
-        self._code_buckets = None                        # [B, cap_list, m]
-        self._layout = None
+        self._code_buckets = None                        # [alloc, cap_list, m]
+        self._view: Optional[MutableIvfView] = None
         self._view_dirty = True
+        self._filter_cache: dict = {}
         self._kernel_metric = p.metric
         self._kernel_nbits = 0
 
@@ -318,14 +319,27 @@ class TpuIvfPq(_SlotStoreIndex):
             dv = jnp.asarray(vectors)
             assign = kmeans_assign(dv, self.centroids)
             codes = _encode_residual(dv, assign, self.centroids, self.codebooks)
-            self._assign_h[slots] = np.asarray(assign)
+            assign_h = np.asarray(assign)
+            self._assign_h[slots] = assign_h
             self._codes = self._codes.at[jnp.asarray(slots, jnp.int32)].set(codes)
-        self._view_dirty = True
+            if self._view is not None and not self._view_dirty:
+                # incremental: scatter the fresh codes into the bucketed
+                # view instead of invalidating it (rows = device codes)
+                self._view_apply_upsert(slots, assign_h, codes)
+            else:
+                self._invalidate_view()
+        else:
+            self._view_dirty = True
         self.write_count_since_save += len(ids)
 
     def delete(self, ids: np.ndarray) -> None:
-        removed = self.store.remove(np.asarray(ids, np.int64))
-        self._view_dirty = True
+        slots = self.store.remove_slots(np.asarray(ids, np.int64))
+        removed = int((slots >= 0).sum())
+        if removed:
+            if self._view is not None and not self._view_dirty:
+                self._view_apply_delete(slots[slots >= 0])
+            else:
+                self._invalidate_view()
         self.write_count_since_save += removed
 
     # -- training ------------------------------------------------------------
@@ -390,22 +404,33 @@ class TpuIvfPq(_SlotStoreIndex):
             codes = _encode_residual(dvv, a, self.centroids, self.codebooks)
             self._assign_h[sl] = np.asarray(a)
             self._codes = self._codes.at[jnp.asarray(sl, jnp.int32)].set(codes)
-        self._view_dirty = True
+        self._invalidate_view()
 
-    # -- bucketed view -------------------------------------------------------
-    def _rebuild_view(self) -> None:
-        lay = build_layout(self._assign_h, self.store.valid_h, self.nlist)
-        self._layout = lay
-        self._code_buckets = lay.gather_rows(self._codes)
-        self._view_dirty = False
+    # -- bucketed view (IvfViewMaintenance data hooks) -----------------------
+    def _materialize_view_data(self, view: MutableIvfView) -> None:
+        self._code_buckets = view.gather_rows(self._codes)
 
-    def _bucket_valid_for_filter(self, filter_spec: Optional[FilterSpec]):
-        if filter_spec is None or filter_spec.is_empty():
-            return self._layout.bucket_valid
-        mask = filter_spec.slot_mask(self.store.ids_by_slot)
-        bucket_slot = self._layout.bucket_slot_h
-        safe = np.where(bucket_slot >= 0, bucket_slot, 0)
-        return jnp.asarray(mask[safe] & (bucket_slot >= 0))
+    def _scatter_view_data(self, upd, rows) -> None:
+        """Scatter freshly-encoded codes ([n, m] uint8, device-resident)
+        into the bucketed code view; caller holds device_lock."""
+        from dingo_tpu.ops.scatter import pad_buckets, scatter_bucket_update
+
+        if upd.grew_alloc is not None:
+            self._code_buckets = pad_buckets(
+                self._code_buckets, upd.grew_alloc
+            )
+        if not upd.appended:
+            return
+        cap = self._view.cap_list
+        pos = np.asarray([p for p, _ in upd.appended], np.int64)
+        src = np.asarray([i for _, i in upd.appended], np.int64)
+        sel = jnp.take(rows, jnp.asarray(src, jnp.int32), axis=0)
+        self._code_buckets = scatter_bucket_update(
+            self._code_buckets,
+            (pos // cap).astype(np.int32),
+            (pos % cap).astype(np.int32),
+            sel,
+        )
 
     # -- search --------------------------------------------------------------
     def search(
@@ -460,19 +485,15 @@ class TpuIvfPq(_SlotStoreIndex):
                             k=int(topk), metric=self.metric, nbits=0,
                         )
             else:
-                if self._view_dirty:
-                    self._rebuild_view()
+                self._ensure_view()
                 nprobe = min(
                     nprobe or self.parameter.default_nprobe, self.nlist
                 )
-                lay = self._layout
+                k_eff, nprobe = self._shape_buckets(int(topk), nprobe)
                 probes = _probe_lists(
                     qpad, self.centroids, self._c_sqnorm, nprobe
                 )
-                vprobes, coarse_pos = expand_probes_ranked(
-                    probes, lay.probe_table, nprobe, lay.max_spill
-                )
-                valid = self._bucket_valid_for_filter(filter_spec)
+                fprep = self._prep_filter_mask(filter_spec)
                 # share one residual LUT across a list's spill buckets when
                 # the [b, nprobe, m, ksub] table fits comfortably in HBM
                 lut_bytes = qpad.shape[0] * nprobe * self.m * self.ksub * 4
@@ -483,22 +504,31 @@ class TpuIvfPq(_SlotStoreIndex):
                 kprime = (
                     min(len(store),
                         int(topk) * FLAGS.get("ivfpq_rerank_factor"))
-                    if rerank else int(topk)
+                    if rerank else k_eff
                 )
-                dists, slots = _ivfpq_scan_kernel(
-                    self._code_buckets,
-                    valid,
-                    lay.bucket_slot,
-                    lay.bucket_coarse,
-                    probes,
-                    vprobes,
-                    coarse_pos,
-                    qpad,
-                    self.centroids,
-                    self.codebooks,
-                    k=max(int(topk), kprime),
-                    precompute_lut=lut_bytes <= 256 * 1024 * 1024,
-                )
+                # view snapshot + dispatch under the device lock:
+                # incremental writes donate the bucket arrays to their
+                # scatter programs (see ivf_flat.search_async)
+                with store.device_lock:
+                    view = self._view
+                    vprobes, coarse_pos = expand_probes_ranked(
+                        probes, view.probe_table, nprobe, view.max_spill
+                    )
+                    valid = self._bucket_valid_for_filter(filter_spec, fprep)
+                    dists, slots = _ivfpq_scan_kernel(
+                        self._code_buckets,
+                        valid,
+                        view.bucket_slot,
+                        view.bucket_coarse,
+                        probes,
+                        vprobes,
+                        coarse_pos,
+                        qpad,
+                        self.centroids,
+                        self.codebooks,
+                        k=max(k_eff, kprime),
+                        precompute_lut=lut_bytes <= 256 * 1024 * 1024,
+                    )
         except Exception:
             lease.release()
             raise
@@ -519,8 +549,12 @@ class TpuIvfPq(_SlotStoreIndex):
                     dists_h, slots_h = jax.device_get((d_r, s_r))
                 else:
                     dists_h, slots_h = jax.device_get((dists, slots))
-                ids = store.ids_of_slots(slots_h[:b])
-                return [strip_invalid(i, d) for i, d in zip(ids, dists_h[:b])]
+                # shape bucketing may have run a larger k; slice back
+                ids = store.ids_of_slots(slots_h[:b, : int(topk)])
+                return [
+                    strip_invalid(i, d)
+                    for i, d in zip(ids, dists_h[:b, : int(topk)])
+                ]
             finally:
                 lease.release()
 
@@ -562,6 +596,9 @@ class TpuIvfPq(_SlotStoreIndex):
             self._c_sqnorm = squared_norms(self.centroids)
             self.codebooks = jnp.asarray(data["codebooks"])
             self._codes = jnp.zeros((self.store.capacity, self.m), jnp.uint8)
+        self._view = None
+        self._view_dirty = True
+        self._filter_cache.clear()
         if len(data["ids"]):
             self.upsert(data["ids"], data["vectors"])
         self.apply_log_id = meta["apply_log_id"]

@@ -267,9 +267,14 @@ class VectorIndexManager:
         wrapper = region.vector_index_wrapper
         if wrapper is None:
             return {}
+        own = wrapper.own_index
         actions = {
             "need_rebuild": wrapper.need_to_rebuild(),
             "need_save": wrapper.need_to_save(),
+            "need_compact": bool(
+                own is not None and getattr(own, "need_compact", None)
+                and own.need_compact()
+            ),
         }
         if act:
             try:
@@ -278,6 +283,13 @@ class VectorIndexManager:
                         actions["rebuilt"] = True
                     else:
                         actions["skipped_busy"] = True
+                elif actions["need_compact"]:
+                    # IVF view compaction: restore the dense bucket layout
+                    # here, on the maintenance thread, so the search path
+                    # never pays the O(N) rebuild (ivf_flat.py
+                    # IvfViewMaintenance)
+                    own.compact()
+                    actions["compacted"] = True
                 elif actions["need_save"] and self.snapshot_root:
                     self.save_index(region)
                     actions["saved"] = True
@@ -286,6 +298,28 @@ class VectorIndexManager:
                 # tick retries (wrapper.build_error carries the state)
                 actions["error"] = str(e)
         return actions
+
+    # ---------------- IVF view compaction ----------------
+    def compact_views(self, regions) -> int:
+        """Crontab entry point (server registers it at
+        FLAGS.ivf_compact_interval_s): compact every region index whose
+        incrementally-maintained IVF view crossed its tombstone/spill
+        thresholds. Cheaper cadence than scrub (no rebuild/save checks)
+        so garbage never waits a full scrub period."""
+        n = 0
+        for region in regions:
+            wrapper = region.vector_index_wrapper
+            own = wrapper.own_index if wrapper is not None else None
+            if own is None or not hasattr(own, "maybe_compact"):
+                continue
+            try:
+                if own.maybe_compact():
+                    n += 1
+                    region_log(_log, region.id).info("ivf view compacted")
+            except Exception:  # noqa: BLE001 — best-effort maintenance
+                _log.exception("view compaction failed (region %d)",
+                               region.id)
+        return n
 
     # ---------------- helpers ----------------
     def _reader(self, region: Region) -> VectorReader:

@@ -212,18 +212,27 @@ class SlotStore:
 
     def remove(self, ids: np.ndarray) -> int:
         """Tombstone rows; returns number actually removed."""
+        return int((self.remove_slots(ids) >= 0).sum())
+
+    def remove_slots(self, ids: np.ndarray) -> np.ndarray:
+        """Tombstone rows; returns the slot each id occupied (-1 for ids
+        that were not present). Incremental view maintenance needs the
+        freed slots to tombstone the matching bucket rows — returning them
+        here avoids a second id->slot resolution pass before removal."""
+        slots = np.full(len(ids), -1, np.int64)
         removed = 0
         dest = self._limbo if self._inflight > 0 else self._free
-        for vid in ids:
+        for i, vid in enumerate(ids):
             s = self._id_to_slot.pop(int(vid), None)
             if s is not None:
                 self.ids_by_slot[s] = -1
                 self.valid_h[s] = False
                 dest.append(s)
+                slots[i] = s
                 removed += 1
         if removed:
             self._dmask = None
-        return removed
+        return slots
 
     # -- in-flight search accounting --------------------------------------
     def begin_search(self) -> "SearchLease":

@@ -278,8 +278,7 @@ class VectorReader:
             out.append(row)
         if with_vector_data or with_scalar_data:
             t0 = _time.perf_counter_ns()
-            for row in out:
-                self._backfill(row, with_vector_data, with_scalar_data)
+            self._backfill_many(out, with_vector_data, with_scalar_data)
             backfill_ns = _time.perf_counter_ns() - t0
         if stage_us is not None:
             total_ns = _time.perf_counter_ns() - t_start
@@ -307,10 +306,19 @@ class VectorReader:
         with_vector_data: bool = True,
         with_scalar_data: bool = False,
     ) -> List[Optional[VectorWithData]]:
+        keys = {
+            int(vid): vcodec.encode_vector_key(self.ctx.partition_id, int(vid))
+            for vid in vector_ids
+        }
+        data_map = self._data.kv_batch_get(keys.values(), self.ctx.read_ts)
+        scalar_map = (
+            self._scalar.kv_batch_get(keys.values(), self.ctx.read_ts)
+            if with_scalar_data else {}
+        )
         out: List[Optional[VectorWithData]] = []
         for vid in vector_ids:
-            key = vcodec.encode_vector_key(self.ctx.partition_id, int(vid))
-            blob = self._data.kv_get(key, self.ctx.read_ts)
+            key = keys[int(vid)]
+            blob = data_map.get(key)
             if blob is None:
                 out.append(None)
                 continue
@@ -318,7 +326,7 @@ class VectorReader:
             if with_vector_data and self.ctx.parameter:
                 v.vector = self._deser(blob)
             if with_scalar_data:
-                sb = self._scalar.kv_get(key, self.ctx.read_ts)
+                sb = scalar_map.get(key)
                 v.scalar = deserialize_scalar(sb) if sb else {}
             out.append(v)
         return out
@@ -538,12 +546,39 @@ class VectorReader:
     ) -> None:
         """Backfill vectors/scalars from the engine by id
         (vector_reader.cc:243-266)."""
-        for v in row:
-            key = vcodec.encode_vector_key(self.ctx.partition_id, v.id)
+        self._backfill_many([row], with_vector, with_scalar)
+
+    def _backfill_many(
+        self,
+        rows: List[List[VectorWithData]],
+        with_vector: bool,
+        with_scalar: bool,
+    ) -> None:
+        """Batched backfill over every result row at once: ONE multi-get
+        per column source (data / scalar) for the whole batch instead of
+        the per-id kv_get N+1 loop — batch*topk ids used to cost up to
+        2*batch*topk engine point lookups per search response."""
+        hits = [v for row in rows for v in row]
+        if not hits:
+            return
+        keys = {
+            v.id: vcodec.encode_vector_key(self.ctx.partition_id, v.id)
+            for v in hits
+        }
+        data_map = (
+            self._data.kv_batch_get(keys.values(), self.ctx.read_ts)
+            if with_vector and self.ctx.parameter else {}
+        )
+        scalar_map = (
+            self._scalar.kv_batch_get(keys.values(), self.ctx.read_ts)
+            if with_scalar else {}
+        )
+        for v in hits:
+            key = keys[v.id]
             if with_vector and self.ctx.parameter:
-                blob = self._data.kv_get(key, self.ctx.read_ts)
+                blob = data_map.get(key)
                 if blob is not None:
                     v.vector = self._deser(blob)
             if with_scalar:
-                sb = self._scalar.kv_get(key, self.ctx.read_ts)
+                sb = scalar_map.get(key)
                 v.scalar = deserialize_scalar(sb) if sb else {}

@@ -9,7 +9,7 @@ and honor value flags (kDelete hides the key; kPutTTL hides it after expiry).
 from __future__ import annotations
 
 import time
-from typing import Iterator, List, Optional, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 from dingo_tpu.engine.raw_engine import RawEngine
 from dingo_tpu.mvcc.codec import Codec, ValueFlag
@@ -80,6 +80,44 @@ class Reader:
 
     def kv_count(self, start_key: bytes, end_key: bytes, ts: int) -> int:
         return sum(1 for _ in self.iter_visible(start_key, end_key, ts))
+
+    #: batch-get window heuristic: one range scan when the covering window
+    #: holds at most this many engine rows per requested key (+ slack)
+    _BATCH_SCAN_FACTOR = 4
+
+    def kv_batch_get(
+        self, user_keys: Iterable[bytes], ts: int
+    ) -> Dict[bytes, Optional[bytes]]:
+        """Multi-get: newest visible version for many keys in one call
+        (rocksdb MultiGet analog). Dense key sets resolve with a single
+        range scan over the covering window (one engine iterator instead
+        of an N+1 per-key loop — the VectorReader backfill pattern);
+        sparse sets fall back to per-key point lookups so a handful of
+        scattered ids can't trigger a whole-region walk. The density test
+        uses the engine's O(log n) row count for the window."""
+        uniq = sorted(set(user_keys))
+        out: Dict[bytes, Optional[bytes]] = {k: None for k in uniq}
+        if not uniq:
+            return out
+        end = uniq[-1] + b"\x00"     # immediate successor: inclusive last
+        try:
+            window_rows = self.engine.count(
+                self.cf,
+                Codec.encode_bytes(uniq[0]),
+                Codec.encode_bytes(end),
+            )
+        except Exception:  # noqa: BLE001 — engines without cheap count
+            window_rows = None
+        budget = self._BATCH_SCAN_FACTOR * len(uniq) + 64
+        if window_rows is not None and window_rows <= budget:
+            wanted = set(uniq)
+            for uk, payload in self.iter_visible(uniq[0], end, ts):
+                if uk in wanted:
+                    out[uk] = payload
+            return out
+        for k in uniq:
+            out[k] = self.kv_get(k, ts)
+        return out
 
 
 class Writer:

@@ -1,0 +1,101 @@
+"""Donated scatter/pad helpers for incrementally-maintained device views.
+
+The bucketed IVF view (index/ivf_layout.py MutableIvfView) turns
+upserts/deletes into O(batch) point updates of the device-resident
+[B, cap_list, ...] arrays instead of an O(N) host gather + re-upload.
+TPU scatter is the slow path for BULK writes (SURVEY.md measurements led
+slot_store.py to contiguous dynamic_update_slice), but a serving-path
+write batch touches a handful of scattered (bucket, row) coordinates —
+one small scatter program beats rebuilding the whole view by ~N/batch.
+
+Conventions shared by every helper here:
+  * the destination is DONATED — callers must hold the owning store's
+    device_lock across the call so a concurrent search cannot dispatch
+    with the invalidated reference (same contract as slot_store._write_run);
+  * update batches are padded to pow2 sizes with out-of-range indices
+    (mode="drop") so the jit cache stays bounded per destination shape.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1)).bit_length()
+
+
+#: scatter batches larger than this fall back to the caller's full-rebuild
+#: path (a write that big amortizes a dense rebuild anyway)
+MAX_SCATTER_BATCH = 8192
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_bucket_rows(dst, b_idx, r_idx, vals):
+    """dst[b_idx[i], r_idx[i]] = vals[i]; out-of-range indices dropped.
+
+    Works for [B, cap] masks/slots (vals [n]) and [B, cap, d] data
+    (vals [n, d]) alike; vals are cast to the destination dtype."""
+    return dst.at[b_idx, r_idx].set(vals.astype(dst.dtype), mode="drop")
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_axis0(dst, idx, vals):
+    return dst.at[idx].set(vals.astype(dst.dtype), mode="drop")
+
+
+def _pad_pow2(arr, n_pad, fill):
+    if isinstance(arr, jax.Array):
+        pad_width = ((0, n_pad),) + ((0, 0),) * (arr.ndim - 1)
+        return jnp.pad(arr, pad_width, constant_values=fill)
+    arr = np.asarray(arr)
+    pad = np.full((n_pad,) + arr.shape[1:], fill, arr.dtype)
+    return np.concatenate([arr, pad])
+
+
+def scatter_bucket_update(dst, b_idx, r_idx, vals):
+    """Point-update a donated [B, cap, ...] view array at (bucket, row)
+    coordinates. Batch is padded to pow2 with dropped indices; returns the
+    new array (caller must rebind under its device lock)."""
+    n = len(b_idx)
+    if n == 0:
+        return dst
+    m = _next_pow2(n)
+    if m != n:
+        drop = dst.shape[0]          # out of range -> mode="drop"
+        b_idx = _pad_pow2(np.asarray(b_idx, np.int32), m - n, drop)
+        r_idx = _pad_pow2(np.asarray(r_idx, np.int32), m - n, 0)
+        vals = _pad_pow2(vals, m - n, 0)
+    return _scatter_bucket_rows(
+        dst, jnp.asarray(b_idx, jnp.int32), jnp.asarray(r_idx, jnp.int32),
+        jnp.asarray(vals),
+    )
+
+
+def scatter_axis0_update(dst, idx, vals):
+    """Point-update a donated [B, ...] array along axis 0 (bucket_coarse)."""
+    n = len(idx)
+    if n == 0:
+        return dst
+    m = _next_pow2(n)
+    if m != n:
+        idx = _pad_pow2(np.asarray(idx, np.int32), m - n, dst.shape[0])
+        vals = _pad_pow2(vals, m - n, 0)
+    return _scatter_axis0(
+        dst, jnp.asarray(idx, jnp.int32), jnp.asarray(vals)
+    )
+
+
+def pad_buckets(arr, new_b, fill=0):
+    """Grow a [B, ...] device array to [new_b, ...] (spill-bucket
+    allocation outran the physical allocation). Plain concatenate: growth
+    is rare (pow2-ladder alloc sizes) and stays device-side."""
+    b = arr.shape[0]
+    if new_b <= b:
+        return arr
+    pad = jnp.full((new_b - b,) + arr.shape[1:], fill, arr.dtype)
+    return jnp.concatenate([arr, pad])

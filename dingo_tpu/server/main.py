@@ -242,6 +242,16 @@ def serve_store(args) -> None:
         t.start()
 
     crontab.add("scrub_vector_index", 60.0, scrub_all)
+    # IVF view compaction: restores the dense bucket layout once the
+    # incrementally-maintained view accumulates tombstone/spill garbage —
+    # off the search path (index/manager.py compact_views)
+    crontab.add(
+        "ivf_compact",
+        float(FLAGS.get("ivf_compact_interval_s")),
+        lambda: node.index_manager.compact_views(
+            node.meta.get_all_regions()
+        ),
+    )
     # metrics collection rides its own crontab so heartbeats reuse the
     # cached snapshot instead of paying a full region sweep per beat
     crontab.add(

@@ -145,7 +145,9 @@ def test_scrub_reports_needs(tmp_path):
     mgr = VectorIndexManager(raw, str(tmp_path))
     w = region.vector_index_wrapper
     actions = mgr.scrub(region)
-    assert actions == {"need_rebuild": False, "need_save": False}
+    assert actions == {
+        "need_rebuild": False, "need_save": False, "need_compact": False,
+    }
     w.write_count = 1_000_000
     assert mgr.scrub(region)["need_save"]
 

@@ -1,0 +1,455 @@
+"""Incremental IVF view maintenance (ISSUE 3): append-in-place upserts,
+tombstone deletes, deferred compaction, filter-mask caching.
+
+The acceptance contract: a single upsert or delete between two searches
+must NOT trigger the full O(N) view rebuild (asserted via the
+ivf.full_rebuild counter), and incremental-maintenance search results
+must equal full-rebuild (compacted) results across interleaved
+upsert/delete/search sequences for IVF_FLAT, binary IVF, and IVF_PQ.
+"""
+
+import numpy as np
+import pytest
+
+from dingo_tpu.common.config import FLAGS
+from dingo_tpu.common.metrics import METRICS
+from dingo_tpu.index.base import FilterSpec, IndexParameter, IndexType
+from dingo_tpu.index.ivf_flat import TpuBinaryIvfFlat, TpuIvfFlat
+from dingo_tpu.index.ivf_layout import (
+    MutableIvfView,
+    alloc_buckets,
+    build_layout,
+    shape_bucket,
+)
+from dingo_tpu.index.ivf_pq import TpuIvfPq
+
+RNG = np.random.default_rng(7)
+_REGION = iter(range(7000, 8000))
+
+
+def _rebuilds(region_id):
+    return METRICS.counter("ivf.full_rebuild", region_id=region_id).get()
+
+
+def _make(kind, region_id, nlist=8):
+    if kind == "ivf_flat":
+        d = 24
+        idx = TpuIvfFlat(region_id, IndexParameter(
+            index_type=IndexType.IVF_FLAT, dimension=d, ncentroids=nlist,
+            default_nprobe=nlist,
+        ))
+        gen = lambda n: RNG.standard_normal((n, d)).astype(np.float32)  # noqa: E731
+    elif kind == "binary":
+        d = 64
+        idx = TpuBinaryIvfFlat(region_id, IndexParameter(
+            index_type=IndexType.BINARY_IVF_FLAT, dimension=d,
+            ncentroids=nlist, default_nprobe=nlist,
+        ))
+        gen = lambda n: RNG.integers(0, 256, (n, d // 8)).astype(np.uint8)  # noqa: E731
+    else:
+        d = 32
+        idx = TpuIvfPq(region_id, IndexParameter(
+            index_type=IndexType.IVF_PQ, dimension=d, ncentroids=nlist,
+            nsubvector=4, default_nprobe=nlist,
+        ))
+        gen = lambda n: RNG.standard_normal((n, d)).astype(np.float32)  # noqa: E731
+    return idx, gen
+
+
+def _assert_same_results(a, b, context=""):
+    for ra, rb in zip(a, b):
+        assert set(ra.ids) == set(rb.ids), (
+            f"{context}: ids diverged {sorted(ra.ids)} vs {sorted(rb.ids)}"
+        )
+        assert np.allclose(
+            np.sort(ra.distances), np.sort(rb.distances), atol=1e-3
+        ), context
+
+
+@pytest.mark.parametrize("kind", ["ivf_flat", "binary", "ivf_pq"])
+def test_incremental_vs_full_rebuild_parity(kind):
+    """Interleaved upserts/deletes/searches: the incrementally-maintained
+    view must return exactly what a fresh dense rebuild (compact) of the
+    same logical content returns — and none of the intermediate searches
+    may pay a full rebuild."""
+    region = next(_REGION)
+    idx, gen = _make(kind, region)
+    n = 400
+    ids = np.arange(n, dtype=np.int64)
+    data = gen(n)
+    idx.upsert(ids, data)
+    idx.train()
+    queries = data[:3]
+    idx.search(queries, 5)                    # builds the view once
+    base = _rebuilds(region)
+
+    next_id = n
+    live = dict(zip(ids.tolist(), range(n)))
+    extra_rows = {}
+    for step in range(4):
+        # new inserts
+        fresh = np.arange(next_id, next_id + 17, dtype=np.int64)
+        rows = gen(len(fresh))
+        idx.upsert(fresh, rows)
+        for j, vid in enumerate(fresh):
+            extra_rows[int(vid)] = rows[j]
+            live[int(vid)] = None
+        next_id += len(fresh)
+        # deletes of random live ids
+        doom = RNG.choice(sorted(live), 9, replace=False)
+        idx.delete(np.asarray(doom, np.int64))
+        for vid in doom:
+            live.pop(int(vid))
+        # overwrite a few live ids with new vectors (tombstone + append)
+        redo = RNG.choice(sorted(live), 5, replace=False)
+        rows = gen(len(redo))
+        idx.upsert(np.asarray(redo, np.int64), rows)
+        for j, vid in enumerate(redo):
+            extra_rows[int(vid)] = rows[j]
+        res = idx.search(queries, 10)
+        assert all(len(r.ids) <= 10 for r in res)
+
+    assert _rebuilds(region) == base, "incremental path paid a full rebuild"
+    pre = idx.search(queries, 10)
+    idx.compact()                             # dense rebuild, off hot path
+    post = idx.search(queries, 10)
+    _assert_same_results(pre, post, f"{kind} parity")
+    assert METRICS.counter("ivf.compactions", region_id=region).get() >= 1
+    # deleted ids never resurface
+    all_hits = idx.search(queries, len(live) + 50)
+    for r in all_hits:
+        assert not (set(r.ids.tolist()) - set(live)), "ghost ids after compact"
+
+
+@pytest.mark.parametrize("kind", ["ivf_flat", "binary", "ivf_pq"])
+def test_single_write_between_searches_no_rebuild(kind):
+    """The ISSUE 3 acceptance check, per index family."""
+    region = next(_REGION)
+    idx, gen = _make(kind, region)
+    ids = np.arange(300, dtype=np.int64)
+    idx.upsert(ids, gen(300))
+    idx.train()
+    q = gen(2)
+    idx.search(q, 5)
+    base = _rebuilds(region)
+    inplace = METRICS.counter("ivf.inplace_appends", region_id=region)
+    i0 = inplace.get()
+
+    idx.upsert(np.array([9001], np.int64), gen(1))
+    idx.search(q, 5)
+    idx.delete(np.array([3], np.int64))
+    idx.search(q, 5)
+
+    assert _rebuilds(region) == base
+    assert inplace.get() == i0 + 1
+    assert METRICS.counter("ivf.tombstones", region_id=region).get() >= 1
+
+    # a no-op write (deleting absent ids) must neither rebuild nor
+    # invalidate the maintained view
+    idx.delete(np.array([123456, 654321], np.int64))
+    assert not idx.view_stats()["dirty"]
+    idx.search(q, 5)
+    assert _rebuilds(region) == base
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["ivf_flat", "binary", "ivf_pq"])
+def test_incremental_parity_long_random_sequence(kind):
+    """Longer randomized soak: many interleaved write/search rounds with
+    occasional threshold compactions, checking parity at every round."""
+    region = next(_REGION)
+    idx, gen = _make(kind, region, nlist=16)
+    n = 1500
+    ids = np.arange(n, dtype=np.int64)
+    idx.upsert(ids, gen(n))
+    idx.train()
+    queries = gen(4)
+    idx.search(queries, 10)
+    live = set(ids.tolist())
+    next_id = n
+    for step in range(12):
+        op = RNG.integers(0, 3)
+        if op == 0:
+            fresh = np.arange(next_id, next_id + 40, dtype=np.int64)
+            idx.upsert(fresh, gen(len(fresh)))
+            live |= set(fresh.tolist())
+            next_id += len(fresh)
+        elif op == 1 and len(live) > 100:
+            doom = RNG.choice(sorted(live), 30, replace=False)
+            idx.delete(np.asarray(doom, np.int64))
+            live -= set(int(v) for v in doom)
+        else:
+            redo = RNG.choice(sorted(live), 20, replace=False)
+            idx.upsert(np.asarray(redo, np.int64), gen(len(redo)))
+        if step % 4 == 3:
+            pre = idx.search(queries, 10)
+            idx.compact()
+            _assert_same_results(
+                pre, idx.search(queries, 10), f"{kind} step {step}"
+            )
+    hits = idx.search(queries, len(live) + 100)
+    for r in hits:
+        assert not (set(r.ids.tolist()) - live)
+
+
+def test_compaction_trigger_thresholds():
+    region = next(_REGION)
+    idx, gen = _make("ivf_flat", region)
+    ids = np.arange(500, dtype=np.int64)
+    idx.upsert(ids, gen(500))
+    idx.train()
+    idx.search(gen(1), 3)
+    assert not idx.need_compact()
+    old_ratio = FLAGS.get("ivf_compact_tombstone_ratio")
+    try:
+        FLAGS.set("ivf_compact_tombstone_ratio", 0.2)
+        idx.delete(ids[:200])                 # 40% tombstones
+        assert idx.view_stats()["tombstone_ratio"] > 0.2
+        assert idx.need_compact()
+        assert idx.maybe_compact()
+        assert not idx.need_compact()
+        assert idx.view_stats()["tombstones"] == 0
+        res = idx.search(gen(1), 500)
+        assert set(res[0].ids) == set(range(200, 500))
+    finally:
+        FLAGS.set("ivf_compact_tombstone_ratio", old_ratio)
+    # gauge reflects the compacted state
+    assert METRICS.gauge(
+        "ivf.tombstone_ratio", region_id=region
+    ).get() == 0.0
+
+
+def test_spill_bucket_allocation_and_growth():
+    """Hammering one coarse list must allocate spill buckets incrementally
+    (no full rebuild) and keep every row reachable."""
+    region = next(_REGION)
+    idx = TpuIvfFlat(region, IndexParameter(
+        index_type=IndexType.IVF_FLAT, dimension=8, ncentroids=2,
+        default_nprobe=2,
+    ))
+    base_rows = RNG.standard_normal((300, 8)).astype(np.float32)
+    idx.upsert(np.arange(300, dtype=np.int64), base_rows)
+    idx.train()
+    idx.search(base_rows[:1], 3)
+    rebuilds = _rebuilds(region)
+    st0 = idx.view_stats()
+    hot = np.asarray(idx.centroids)[0]
+    extra = hot + 0.01 * RNG.standard_normal((400, 8)).astype(np.float32)
+    for i in range(0, 400, 40):
+        idx.upsert(np.arange(1000 + i, 1040 + i, dtype=np.int64),
+                   extra[i:i + 40])
+    st1 = idx.view_stats()
+    assert st1["buckets_added"] > 0
+    assert st1["nbuckets"] > st0["nbuckets"]
+    assert _rebuilds(region) == rebuilds
+    res = idx.search(base_rows[:1], 700, nprobe=2)
+    assert set(res[0].ids) == set(range(300)) | set(range(1000, 1400))
+
+
+def test_filter_mask_cache_hits_and_invalidation():
+    region = next(_REGION)
+    idx, gen = _make("ivf_flat", region)
+    ids = np.arange(400, dtype=np.int64)
+    data = gen(400)
+    idx.upsert(ids, data)
+    idx.train()
+    q = data[:2]
+    spec = FilterSpec(ranges=[(0, 100)])
+    hits = METRICS.counter("ivf.filter_mask_hits", region_id=region)
+    idx.search(q, 5, filter_spec=spec)
+    h0 = hits.get()
+    r_cached = idx.search(q, 5, filter_spec=spec)
+    assert hits.get() == h0 + 1
+    assert all((r.ids < 100).all() for r in r_cached)
+    # a write bumps the view version -> the cached mask must NOT serve a
+    # stale view (the deleted id would resurface)
+    idx.delete(np.array([int(r_cached[0].ids[0])], np.int64))
+    r_after = idx.search(q, 5, filter_spec=spec)
+    assert hits.get() == h0 + 1, "stale mask served after write"
+    assert int(r_cached[0].ids[0]) not in set(r_after[0].ids)
+    # distinct fingerprints get distinct entries
+    other = FilterSpec(ranges=[(100, 200)])
+    r_other = idx.search(q, 5, filter_spec=other)
+    assert all(((r.ids >= 100) & (r.ids < 200)).all() for r in r_other)
+
+
+def test_shape_bucket_ladder():
+    assert [shape_bucket(v) for v in (1, 3, 5, 8, 10, 13, 16, 20, 48, 100)] \
+        == [1, 3, 6, 8, 12, 16, 16, 24, 48, 128]
+    # requested topk is honored even when the kernel runs a larger k
+    region = next(_REGION)
+    idx, gen = _make("ivf_flat", region)
+    idx.upsert(np.arange(300, dtype=np.int64), gen(300))
+    idx.train()
+    res = idx.search(gen(2), 10)
+    assert all(len(r.ids) == 10 for r in res)
+
+
+def test_alloc_buckets_ladder_bounds_waste():
+    for n in (1, 3, 9, 17, 33, 100, 1000):
+        a = alloc_buckets(n)
+        assert a >= n
+        assert a <= max(8, int(n * 1.25) + 1), (n, a)
+
+
+def test_mutable_view_matches_dense_layout():
+    """A view built from (assign, valid) must cover exactly the live slots
+    the dense layout covers, with consistent slot_pos back-pointers."""
+    nlist = 8
+    assign = RNG.integers(0, nlist, 512).astype(np.int32)
+    valid = RNG.random(512) < 0.8
+    lay = build_layout(assign, valid, nlist)
+    view = MutableIvfView(lay, nlist, 512)
+    flat = view.bucket_slot_h.reshape(-1)
+    live = flat[flat >= 0]
+    assert sorted(live) == sorted(np.flatnonzero(valid & (assign >= 0)))
+    for s in live:
+        pos = view.slot_pos[s]
+        assert flat[pos] == s
+
+
+def test_warmup_compiles_and_counts():
+    region = next(_REGION)
+    idx, gen = _make("ivf_flat", region)
+    assert idx.warmup() == 0                  # untrained: no-op
+    idx.upsert(np.arange(300, dtype=np.int64), gen(300))
+    idx.train()
+    assert idx.warmup(batches=(1, 4), topk=5) == 2
+    # warmed index serves without a further rebuild
+    base = _rebuilds(region)
+    idx.search(gen(1), 5)
+    assert _rebuilds(region) == base
+
+
+def test_concurrent_writes_and_searches():
+    """Searches dispatched while another thread appends/tombstones must
+    neither crash (donated-buffer invalidation, staged-vs-applied view
+    skew) nor return ids that were never inserted."""
+    import threading
+
+    region = next(_REGION)
+    idx, gen = _make("ivf_flat", region)
+    ids = np.arange(600, dtype=np.int64)
+    data = gen(600)
+    idx.upsert(ids, data)
+    idx.train()
+    queries = data[:4]
+    idx.search(queries, 5)
+    inserted = {int(i) for i in ids}
+    errors = []
+    stop = threading.Event()
+
+    def writer():
+        rng = np.random.default_rng(11)
+        nid = 10_000
+        try:
+            while not stop.is_set():
+                fresh = np.arange(nid, nid + 8, dtype=np.int64)
+                rows = gen(8)
+                inserted.update(int(v) for v in fresh)
+                idx.upsert(fresh, rows)
+                nid += 8
+                idx.delete(rng.integers(0, nid, 4).astype(np.int64))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(25):
+            for r in idx.search(queries, 10):
+                bogus = set(int(i) for i in r.ids) - inserted
+                assert not bogus, f"ghost ids {bogus}"
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert not errors, errors
+
+
+def test_kv_batch_get_matches_point_gets():
+    """Multi-get parity on both the dense (range-scan) and sparse
+    (point-lookup) paths, including deletes and missing keys."""
+    from dingo_tpu.engine.raw_engine import CF_DEFAULT, MemEngine
+    from dingo_tpu.mvcc.reader import Reader, Writer
+
+    eng = MemEngine()
+    w = Writer(eng, CF_DEFAULT)
+    r = Reader(eng, CF_DEFAULT)
+    keys = [b"k%04d" % i for i in range(50)]
+    for i, k in enumerate(keys):
+        w.kv_put(k, b"v%d" % i, ts=10 + i)
+    w.kv_delete(keys[7], ts=100)
+    w.kv_put(keys[3], b"newer", ts=200)
+
+    wanted = keys[::5] + [b"missing", keys[3], keys[7]]
+    got = r.kv_batch_get(wanted, ts=500)
+    for k in wanted:
+        assert got[k] == r.kv_get(k, ts=500), k
+    assert got[b"missing"] is None
+    assert got[keys[7]] is None
+    assert got[keys[3]] == b"newer"
+    # sparse path: few keys over a wide window
+    sparse = [keys[0], keys[-1]]
+    got2 = r.kv_batch_get(sparse, ts=500)
+    assert got2 == {k: r.kv_get(k, ts=500) for k in sparse}
+
+
+def test_backfill_uses_batched_multiget(monkeypatch):
+    """_backfill_many must resolve the whole response with one multi-get
+    per column source instead of per-id kv_gets."""
+    from dingo_tpu.engine.raw_engine import CF_DEFAULT, MemEngine
+    from dingo_tpu.index import codec as vcodec
+    from dingo_tpu.index.vector_reader import (
+        ReaderContext,
+        VectorReader,
+        VectorWithData,
+        serialize_scalar,
+        serialize_vector,
+    )
+    from dingo_tpu.mvcc.reader import Reader as MvccReader
+    from dingo_tpu.mvcc.reader import Writer
+
+    eng = MemEngine()
+    dim = 4
+    param = IndexParameter(index_type=IndexType.FLAT, dimension=dim)
+    from dingo_tpu.engine.raw_engine import CF_VECTOR_SCALAR
+
+    dw = Writer(eng, CF_DEFAULT)
+    sw = Writer(eng, CF_VECTOR_SCALAR)
+    vecs = {}
+    for vid in range(20):
+        key = vcodec.encode_vector_key(1, vid)
+        vecs[vid] = RNG.standard_normal(dim).astype(np.float32)
+        dw.kv_put(key, serialize_vector(vecs[vid]), ts=5)
+        sw.kv_put(key, serialize_scalar({"tag": vid}), ts=5)
+    lo, hi = 0, 1 << 40
+    reader = VectorReader(ReaderContext(
+        region_id=1, partition_id=1,
+        start_key=vcodec.encode_vector_key(1, lo),
+        end_key=vcodec.encode_vector_key(1, hi),
+        index_wrapper=None, engine=eng, parameter=param,
+    ))
+    calls = {"get": 0, "batch": 0}
+    orig_get, orig_batch = MvccReader.kv_get, MvccReader.kv_batch_get
+
+    def spy_get(self, k, ts):
+        calls["get"] += 1
+        return orig_get(self, k, ts)
+
+    def spy_batch(self, ks, ts):
+        calls["batch"] += 1
+        return orig_batch(self, ks, ts)
+
+    monkeypatch.setattr(MvccReader, "kv_get", spy_get)
+    monkeypatch.setattr(MvccReader, "kv_batch_get", spy_batch)
+    rows = [
+        [VectorWithData(i) for i in (0, 3, 5)],
+        [VectorWithData(i) for i in (2, 3, 19)],
+    ]
+    reader._backfill_many(rows, with_vector=True, with_scalar=True)
+    assert calls["batch"] == 2          # one per column source
+    assert calls["get"] == 0            # dense window -> single range scan
+    for row in rows:
+        for v in row:
+            assert np.allclose(v.vector, vecs[v.id])
+            assert v.scalar == {"tag": v.id}
