@@ -85,6 +85,134 @@ def load_tpu_cache(max_age_h: float = 12.0):
         return None
 
 
+def precision_sweep_and_hybrid(platform):
+    """ISSUE 4: (a) fp32/bf16/sq8 sweep — QPS, recall@10, device bytes per
+    vector — on one reduced-scale IVF_FLAT config; (b) first measurement
+    of benchmark-matrix ROW 5 (hybrid scalar-filtered IVF search) at the
+    same reduced scale, labeled as such. Scale knobs env-tunable
+    (DINGO_BENCH_SWEEP_N/_D/_NLIST); both sections share one corpus +
+    ground truth so the whole block stays a few CPU-minutes."""
+    import time as _time
+
+    from dingo_tpu.common.config import FLAGS
+    from dingo_tpu.index import IndexParameter, IndexType, new_index
+    from dingo_tpu.index.base import FilterSpec
+
+    n = int(os.environ.get("DINGO_BENCH_SWEEP_N", 50_000))
+    d = int(os.environ.get("DINGO_BENCH_SWEEP_D", 256))
+    nlist = int(os.environ.get("DINGO_BENCH_SWEEP_NLIST", 128))
+    # 30 timed iterations: the bf16-vs-fp32 QPS ratio gate sits near 0.9
+    # and 20-iteration runs showed ~10% run-to-run noise on the 1-core box
+    nprobe, batch, k, iters = 16, 64, 10, 30
+    rng = np.random.default_rng(7)
+    ncl = max(64, n // 1000)
+    centers = rng.standard_normal((ncl, d), dtype=np.float32)
+    x = centers[rng.integers(0, ncl, n)] + 0.35 * rng.standard_normal(
+        (n, d)
+    ).astype(np.float32)
+    ids = np.arange(n, dtype=np.int64)
+    queries = x[rng.choice(n, batch, replace=False)] + 0.05 * (
+        rng.standard_normal((batch, d)).astype(np.float32)
+    )
+    qs = queries[:16]
+
+    def exact_topk(cand_mask=None):
+        xs = x if cand_mask is None else x[cand_mask]
+        xids = ids if cand_mask is None else ids[cand_mask]
+        dmat = (
+            (qs ** 2).sum(1)[:, None] - 2.0 * qs @ xs.T
+            + (xs ** 2).sum(1)[None, :]
+        )
+        return xids[np.argsort(dmat, axis=1)[:, :k]]
+
+    gt = exact_topk()
+
+    def recall_of(res, truth):
+        return float(np.mean(
+            [len(set(r.ids) & set(g)) / k for r, g in zip(res, truth)]
+        ))
+
+    cache_rows = int(os.environ.get("DINGO_BENCH_RERANK_ROWS", 4096))
+    sweep = {}
+    fp32_qps = None
+    fp32_index = None
+    for tier in ("fp32", "bf16", "sq8"):
+        # rerank cache rides the sq8 run (the tier whose recall gate the
+        # rerank stage exists for); bf16 holds recall without it
+        FLAGS.set("rerank_cache_rows", cache_rows if tier == "sq8" else 0)
+        FLAGS.set("rerank_cache_dtype", "bfloat16")
+        idx = new_index(100 + ("fp32", "bf16", "sq8").index(tier),
+                        IndexParameter(
+                            index_type=IndexType.IVF_FLAT, dimension=d,
+                            ncentroids=nlist, default_nprobe=nprobe,
+                            precision=tier,
+                        ))
+        idx.store.reserve(n)
+        idx.upsert(ids, x)
+        idx.train()
+        idx.warmup(batches=(batch,), topk=k, nprobe=nprobe)
+        rec = recall_of(idx.search(qs, k, nprobe=nprobe), gt)
+        for t in [idx.search_async(queries, k, nprobe=nprobe)
+                  for _ in range(3)]:
+            t()          # untimed pipelined burst: settle caches/allocator
+        t0 = _time.perf_counter()
+        thunks = [idx.search_async(queries, k, nprobe=nprobe)
+                  for _ in range(iters)]
+        for t in thunks:
+            t()
+        dt = (_time.perf_counter() - t0) / iters
+        qps = batch / dt
+        bytes_per_vec = idx.get_device_memory_size() / max(1, idx.get_count())
+        if tier == "fp32":
+            fp32_qps = qps
+            fp32_index = idx
+        sweep[tier] = {
+            "qps": round(qps, 1),
+            "qps_vs_fp32": round(qps / fp32_qps, 3),
+            "recall_at_10": round(rec, 4),
+            "device_bytes_per_vector": round(bytes_per_vec, 1),
+            "bytes_vs_fp32": round(
+                sweep["fp32"]["device_bytes_per_vector"] / bytes_per_vec, 2
+            ) if tier != "fp32" else 1.0,
+            "rerank_cache_rows": cache_rows if tier == "sq8" else 0,
+        }
+        log(f"sweep {tier}: {qps:,.0f} QPS recall@10={rec:.4f} "
+            f"{bytes_per_vec:.0f} B/vec")
+    FLAGS.set("rerank_cache_rows", 0)
+    FLAGS.set("rerank_cache_dtype", "float32")
+
+    # ---- ROW 5 (reduced scale): hybrid scalar-filtered IVF search ----
+    # Scalar predicate: category = id % 16 == 3 (the compiled include-set
+    # FilterSpec the scalar pre-filter path produces, vector_reader.cc:853
+    # analog). Ground truth restricted to the matching subset.
+    cat_mask = (ids % 16) == 3
+    spec = FilterSpec(include_ids=ids[cat_mask])
+    gt_f = exact_topk(cat_mask)
+    # 1/16 selectivity thins every probed list ~16x, so the hybrid
+    # operating point probes wider than the unfiltered sweep
+    nprobe_f = min(nlist, max(nprobe * 4, 64))
+    rec_f = recall_of(fp32_index.search(qs, k, spec, nprobe=nprobe_f), gt_f)
+    fp32_index.search(queries, k, spec, nprobe=nprobe_f)  # warm compile+mask
+    t0 = _time.perf_counter()
+    thunks = [fp32_index.search_async(queries, k, spec, nprobe=nprobe_f)
+              for _ in range(iters)]
+    for t in thunks:
+        t()
+    dt = (_time.perf_counter() - t0) / iters
+    hybrid = {
+        # row 5 spec is 10M x 768 over 3 mesh regions; this is the
+        # REDUCED-SCALE first fill of the cell, labeled as such
+        "config": f"row5_hybrid_ivf_scalar_filter_reduced_{n//1000}k_x{d}"
+                  f"_nlist{nlist}_nprobe{nprobe_f}",
+        "selectivity": round(float(cat_mask.mean()), 4),
+        "qps": round(batch / dt, 1),
+        "recall_at_10": round(rec_f, 4),
+    }
+    log(f"row5 hybrid (reduced): {hybrid['qps']:,.0f} QPS "
+        f"recall@10={rec_f:.4f} sel={hybrid['selectivity']}")
+    return sweep, hybrid
+
+
 def main():
     # With a cached TPU result on hand a short probe suffices; without one,
     # keep the generous window — a live run is strictly better than a cache.
@@ -259,6 +387,9 @@ def main():
         f"(read-only p99={p99:.2f}; {rebuilds} full rebuilds, "
         f"{vstats.get('inplace_appends', 0)} in-place appends)")
 
+    # --- precision sweep (fp32/bf16/sq8) + row-5 hybrid (ISSUE 4) ---
+    sweep, hybrid = precision_sweep_and_hybrid(platform)
+
     # --- CPU baseline: numpy/OpenBLAS IVF-flat with same layout ---
     centroids = np.asarray(idx.centroids)
     assign = idx._assign_h[np.asarray(idx.store.slots_of(ids))]
@@ -322,6 +453,12 @@ def main():
                 float(vstats.get("tombstone_ratio", 0.0)), 4
             ),
         },
+        # fp32/bf16/sq8 at one reduced-scale IVF config: QPS, recall@10,
+        # device bytes/vector (the precision-tier capacity win)
+        "precision_sweep": sweep,
+        # benchmark-matrix row 5 (hybrid scalar-filtered IVF), first fill
+        # — reduced scale, labeled in the config string
+        "hybrid_row5": hybrid,
     }
     if platform == "tpu":
         result["measured_at"] = time.time()

@@ -159,6 +159,28 @@ FLAGS.define("ivf_shape_bucketing", True, mutable=True,
                    "steady-state serving reuses a handful of compiled "
                    "programs instead of recompiling per request shape; "
                    "results are sliced back to the requested topk")
+FLAGS.define("vector_precision", "fp32", mutable=True,
+             help_="default precision tier for float FLAT/IVF_FLAT region "
+                   "indexes when VectorIndexParameter.precision is unset: "
+                   "'fp32' (exact storage+compute), 'bf16' (bf16 storage, "
+                   "bf16 MXU multiplies, fp32 accumulate — 2x HBM "
+                   "capacity), 'sq8' (uint8 scalar-quantized storage, "
+                   "decode-on-the-fly bf16 compute, fp32 accumulate — 4x "
+                   "HBM capacity). Per-index override via the parameter")
+FLAGS.define("rerank_cache_rows", 0, mutable=True,
+             help_="device-resident exact-rerank row cache size (rows per "
+                   "bf16/sq8 index; 0 disables the cache). Cached rows "
+                   "rerank quantized shortlists ON DEVICE (no host "
+                   "gather); uncached candidates keep their quantized "
+                   "score, so a partial cache only improves ranking")
+FLAGS.define("rerank_cache_dtype", "float32", mutable=True,
+             help_="dtype of the rerank row cache: 'float32' (exact "
+                   "rerank) or 'bfloat16' (half the cache HBM; rerank is "
+                   "then bf16-exact, still above SQ8 fidelity)")
+FLAGS.define("quantized_rerank_factor", 4, mutable=True,
+             help_="bf16/sq8 searches with a non-empty rerank cache scan "
+                   "topk*factor candidates and rerank them exactly on "
+                   "device (1 disables the stage)")
 FLAGS.define("use_pallas_ivf_search", "auto", mutable=True,
              help_="route trained IVF_FLAT searches through the Pallas "
                    "list-DMA kernel (streams only probed buckets to VMEM; "
@@ -168,6 +190,19 @@ FLAGS.define("use_pallas_ivf_search", "auto", mutable=True,
                    "kernel is 4.9x the XLA path (33 vs 163 ms/batch), but "
                    "at 100Kx128/nlist=64 it LOSES 1.3x (18 vs 14) — thin "
                    "rows starve the per-bucket DMA. True/False force.")
+
+
+def bf16_compute_native() -> bool:
+    """True where bf16 is native matmul currency (TPU MXU) and the bf16
+    tier should SCAN bf16-resident data directly. XLA CPU converts bf16
+    scalar-ly (~500M elt/s measured on this image — a [64,512,256] rank
+    gather pays ~17 ms of convert alone), so the CPU arm keeps the bf16
+    tier's SCAN arrays f32: rows still quantize to bf16 at the write
+    boundary (identical recall semantics), only the resident compute copy
+    widens. Same backend-crossover discipline as use_pallas_ivf_search."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "axon")
 
 
 def pallas_ivf_enabled(dimension: int) -> bool:

@@ -81,6 +81,11 @@ class IndexParameter:
     nlinks: int = 32              # M
     # storage dtype for device-resident vectors
     dtype: str = "float32"
+    # precision tier for float FLAT/IVF_FLAT storage+compute: "" (defer to
+    # the vector.precision conf default), "fp32", "bf16" (bf16 storage,
+    # fp32 accumulate), or "sq8" (uint8 scalar-quantized storage with
+    # device-resident exact rerank). See resolve_precision().
+    precision: str = ""
     # keep full vectors in HOST memory (IVF_PQ/DiskANN-class indexes whose
     # search path reads only codes; lifts the HBM cap at 10M x 768 scale)
     host_vectors: bool = False
@@ -90,6 +95,38 @@ class IndexParameter:
     # ScalarSchema.enable_speed_up + VectorIndexUtils::SplitVectorScalarData,
     # raft_apply_handler.cc:1115)
     scalar_speedup_keys: Tuple[str, ...] = ()
+
+
+#: canonical precision tier names (ARCHITECTURE.md "Precision tiers")
+PRECISION_TIERS = ("fp32", "bf16", "sq8")
+
+_PRECISION_ALIASES = {
+    "": "fp32", "fp32": "fp32", "f32": "fp32", "float32": "fp32",
+    "bf16": "bf16", "bfloat16": "bf16",
+    "sq8": "sq8", "int8": "sq8", "uint8": "sq8",
+}
+
+
+def resolve_precision(parameter: IndexParameter) -> str:
+    """Effective precision tier for an index: the per-index parameter wins,
+    else the `vector.precision` conf default. A legacy parameter that sets
+    dtype='bfloat16' directly (pre-tier configs, bench rounds 1-5) resolves
+    to the bf16 tier so its behavior is unchanged."""
+    p = (parameter.precision or "").strip().lower()
+    if not p:
+        from dingo_tpu.common.config import FLAGS
+
+        try:
+            p = str(FLAGS.get("vector_precision")).strip().lower()
+        except KeyError:  # registry not populated (unit contexts)
+            p = "fp32"
+    tier = _PRECISION_ALIASES.get(p)
+    if tier is None:
+        raise InvalidParameter(f"unknown precision tier {p!r} "
+                               f"(want one of {PRECISION_TIERS})")
+    if tier == "fp32" and parameter.dtype in ("bfloat16", "bf16"):
+        return "bf16"
+    return tier
 
 
 @dataclasses.dataclass

@@ -27,9 +27,11 @@ from dingo_tpu.index.base import (
     NotSupported,
     SearchResult,
     VectorIndex,
+    resolve_precision,
     strip_invalid,
 )
-from dingo_tpu.index.slot_store import SlotStore, _next_pow2
+from dingo_tpu.index.rerank_cache import DeviceRerankCache
+from dingo_tpu.index.slot_store import SlotStore, SqSlotStore, _next_pow2
 from dingo_tpu.ops.distance import Metric, normalize, score_matrix, scores_to_distances
 from dingo_tpu.ops.topk import topk_scores
 
@@ -50,6 +52,32 @@ def _flat_search_kernel(vecs, sqnorm, mask, queries, k, metric, nbits):
     return scores_to_distances(vals, metric), slots
 
 
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _sq_flat_search_kernel(codes, vmin, scale, sqnorm, mask, queries, k,
+                           metric):
+    """SQ8 whole-index scan: decode-on-the-fly bf16 compute over uint8
+    codes, fp32 accumulate (ops/sq.py), then the same masked top-k."""
+    from dingo_tpu.ops.sq import sq_score_matrix
+
+    scores = sq_score_matrix(
+        queries, codes, vmin, scale, metric, x_sqnorm=sqnorm
+    )
+    vals, slots = topk_scores(scores, k, valid=mask)
+    return scores_to_distances(vals, metric), slots
+
+
+def _new_tier_store(precision: str, dim: int, parameter: IndexParameter,
+                    capacity: int = 0):
+    """SlotStore for a precision tier: fp32/bf16 are dtype choices on the
+    float store; sq8 swaps in the quantizing store."""
+    kw = {"capacity": capacity} if capacity else {}
+    if precision == "sq8":
+        return SqSlotStore(dim, **kw)
+    dtype = jnp.bfloat16 if precision == "bf16" \
+        else jnp.dtype(parameter.dtype)
+    return SlotStore(dim, dtype, **kw)
+
+
 def _pad_batch(q: np.ndarray) -> np.ndarray:
     b = q.shape[0]
     bb = _next_pow2(max(1, b))
@@ -65,6 +93,79 @@ class _SlotStoreIndex(VectorIndex):
     store: SlotStore
     _kernel_metric: Metric
     _kernel_nbits: int
+    #: precision tier ("fp32"/"bf16"/"sq8"); binary indexes stay "fp32"
+    _precision: str = "fp32"
+    #: bounded device row cache for exact rerank of quantized shortlists
+    _rerank_cache = None
+
+    # -- precision tier / rerank plumbing ---------------------------------
+    def _init_precision(self, parameter: IndexParameter,
+                        tier: Optional[str] = None) -> None:
+        """Resolve the tier and (for quantized tiers) attach the rerank
+        cache. Call AFTER self.store exists — the cache shares its lock.
+        Pass `tier` to pin an already-resolved tier (reload paths must not
+        re-consult the mutable conf default mid-life)."""
+        from dingo_tpu.common.config import FLAGS
+
+        self._precision = tier or resolve_precision(parameter)
+        self._rerank_cache = None
+        if self._precision in ("bf16", "sq8"):
+            rows = int(FLAGS.get("rerank_cache_rows"))
+            if rows > 0:
+                self._rerank_cache = DeviceRerankCache(
+                    self.dimension,
+                    rows,
+                    dtype=jnp.dtype(str(FLAGS.get("rerank_cache_dtype"))),
+                    device_lock=self.store.device_lock,
+                )
+
+    def _offer_rerank(self, slots, vectors) -> None:
+        if self._rerank_cache is not None:
+            self._rerank_cache.offer(slots, vectors)
+
+    def _invalidate_rerank(self, slots) -> None:
+        if self._rerank_cache is not None:
+            self._rerank_cache.invalidate(slots[slots >= 0])
+
+    def _rerank_shortlist(self, topk: int):
+        """k' to over-fetch for the rerank stage, or None when the stage
+        is off (fp32 tier, no cache, empty cache, or factor <= 1)."""
+        cache = self._rerank_cache
+        if cache is None or not len(cache):
+            return None
+        from dingo_tpu.common.config import FLAGS
+
+        factor = int(FLAGS.get("quantized_rerank_factor"))
+        if factor <= 1:
+            return None
+        return topk * factor
+
+    def _dispatch_rerank(self, qpad, dists, slots, topk: int):
+        """Exact rerank of the quantized shortlist against the device row
+        cache; caller holds store.device_lock (cache arrays are donated by
+        its write programs under the same lock)."""
+        from dingo_tpu.ops.rerank import cached_rerank_device
+
+        cache = self._rerank_cache
+        return cached_rerank_device(
+            cache.vecs,
+            cache.sqnorm,
+            cache.device_map(self.store.capacity),
+            dists,
+            slots,
+            qpad,
+            k=topk,
+            metric=self.metric,
+        )
+
+    def _count_search(self) -> None:
+        from dingo_tpu.common.metrics import METRICS
+
+        METRICS.counter(
+            "vector.search_by_precision",
+            region_id=self.id,
+            labels={"precision": self._precision},
+        ).add(1)
 
     # subclasses set these
     def _prep_vectors(self, vectors: np.ndarray) -> np.ndarray:
@@ -90,11 +191,14 @@ class _SlotStoreIndex(VectorIndex):
         vectors = self._prep_vectors(vectors)
         if len(ids) != len(vectors):
             raise InvalidParameter("ids/vectors length mismatch")
-        self.store.put(np.asarray(ids, np.int64), vectors)
+        slots = self.store.put(np.asarray(ids, np.int64), vectors)
+        self._offer_rerank(slots, vectors)
         self.write_count_since_save += len(ids)
 
     def delete(self, ids: np.ndarray) -> None:
-        removed = self.store.remove(np.asarray(ids, np.int64))
+        slots = self.store.remove_slots(np.asarray(ids, np.int64))
+        removed = int((slots >= 0).sum())
+        self._invalidate_rerank(slots)
         self.write_count_since_save += removed
 
     # -- search ------------------------------------------------------------
@@ -126,6 +230,7 @@ class _SlotStoreIndex(VectorIndex):
         # lease BEFORE dispatch: kernel-produced slots must stay limbo-
         # parked (not reassigned) until resolve translates them
         lease = store.begin_search()
+        self._count_search()
         try:
             with store.device_lock:
                 # mask capture AND dispatch under the device lock: a
@@ -137,10 +242,25 @@ class _SlotStoreIndex(VectorIndex):
                     mask = jnp.asarray(
                         filter_spec.slot_mask(store.ids_by_slot)
                     )
-                dists, slots = self._run_search_kernel(qpad, mask, int(topk))
+                kprime = self._rerank_shortlist(int(topk))
+                dists, slots = self._run_search_kernel(
+                    qpad, mask, kprime or int(topk)
+                )
+                if kprime is not None:
+                    # exact rerank of the quantized shortlist, still under
+                    # the lock (cache arrays share it) and still async
+                    dists, slots = self._dispatch_rerank(
+                        qpad, dists, slots, int(topk)
+                    )
         except Exception:
             lease.release()
             raise
+        if kprime is not None:
+            # sampled traces get a true ops.rerank kernel-time span
+            # (outside the lock; no-op when the request isn't sampled)
+            from dingo_tpu.ops.distance import device_wait_span
+
+            device_wait_span("rerank", (dists, slots))
         # Start the D2H copy as soon as the kernel finishes: the tunnel's
         # fetch RTT then overlaps across in-flight searches instead of
         # serializing at resolve time.
@@ -174,6 +294,26 @@ class _SlotStoreIndex(VectorIndex):
         from dingo_tpu.common.config import FLAGS
         from dingo_tpu.ops.distance import metric_ascending
 
+        if self._precision == "sq8":
+            if self.store.sq_params is None:
+                # empty untrained store: nothing valid to scan; identity
+                # codec keeps the kernel well-defined WITHOUT installing
+                # params (the first real write must still train them)
+                vmin = jnp.zeros((self.dimension,), jnp.float32)
+                scale = jnp.ones((self.dimension,), jnp.float32)
+            else:
+                vmin = self.store.sq_vmin_d
+                scale = self.store.sq_scale_d
+            return _sq_flat_search_kernel(
+                self.store.vecs,
+                vmin,
+                scale,
+                self.store.sqnorm,
+                mask,
+                qpad,
+                k=k,
+                metric=self._kernel_metric,
+            )
         use_fused = (
             FLAGS.get("use_pallas_fused_search")
             and self._kernel_metric in (Metric.L2, Metric.INNER_PRODUCT)
@@ -215,6 +355,7 @@ class _SlotStoreIndex(VectorIndex):
             "metric": self.metric.value,
             "apply_log_id": self.apply_log_id,
             "count": self.get_count(),
+            "precision": self._precision,
         }
 
     def _check_meta(self, meta: dict) -> None:
@@ -226,6 +367,17 @@ class _SlotStoreIndex(VectorIndex):
             raise InvalidParameter(
                 f"snapshot metric {meta['metric']} != {self.metric.value}"
             )
+        snap_p = meta.get("precision")
+        if snap_p is not None and snap_p != self._precision:
+            # fp32<->bf16 snapshots share the f32-on-disk row format, so a
+            # tier flip (conf default change) loads fine — rows re-cast
+            # into the new store. sq8 is a different CONTAINER (codes +
+            # codec params), so crossing it is a hard error. Pre-tier
+            # snapshots have no key and load under any tier.
+            if "sq8" in (snap_p, self._precision):
+                raise InvalidParameter(
+                    f"snapshot precision {snap_p} != {self._precision}"
+                )
 
     def need_to_save(self, last_save_log_behind: int) -> bool:
         """Reference wrapper policy (vector_index.h:497-500): save when the
@@ -245,9 +397,24 @@ class TpuFlat(_SlotStoreIndex):
         super().__init__(index_id, parameter)
         if parameter.dimension <= 0:
             raise InvalidParameter(f"dimension {parameter.dimension}")
-        self.store = SlotStore(parameter.dimension, jnp.dtype(parameter.dtype))
+        precision = resolve_precision(parameter)
+        if precision == "sq8" and parameter.metric is Metric.HAMMING:
+            raise InvalidParameter("sq8 tier needs a float metric")
+        self.store = _new_tier_store(
+            precision, parameter.dimension, parameter
+        )
+        self._init_precision(parameter)
         self._kernel_metric = parameter.metric
         self._kernel_nbits = 0
+
+    def train(self, vectors: Optional[np.ndarray] = None) -> None:
+        """FLAT needs no geometric training, but the sq8 tier can install
+        its per-dim min/max codec from an explicit train set BEFORE ingest
+        (otherwise the first write batch trains it — faiss's
+        train-once-clip-later convention). need_train() stays False so the
+        manager never blocks on this."""
+        if self._precision == "sq8" and vectors is not None:
+            self.store.maybe_train(self._prep_vectors(vectors))
 
     def _prep_vectors(self, vectors: np.ndarray) -> np.ndarray:
         vectors = np.asarray(vectors, np.float32)
@@ -273,7 +440,28 @@ class TpuFlat(_SlotStoreIndex):
 
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
-        np.savez(os.path.join(path, "flat.npz"), **self.store.to_host())
+        if self._precision == "sq8" and self.store.sq_params is not None:
+            # codes + codec params persist verbatim (1 byte/dim on disk,
+            # bit-exact restore — the SQ analog of PQ codebooks riding
+            # ivf_pq.npz); a decoded save would re-encode on load and
+            # silently double the quantization error
+            snap = self.store.codes_to_host()
+            np.savez(
+                os.path.join(path, "flat.npz"),
+                ids=snap["ids"],
+                codes=snap["codes"],
+                sq_vmin=self.store.sq_params.vmin,
+                sq_scale=self.store.sq_params.scale,
+            )
+        else:
+            snap = self.store.to_host()
+            np.savez(
+                os.path.join(path, "flat.npz"),
+                ids=snap["ids"],
+                # f32 on disk: numpy's savez can't serialize ml_dtypes
+                # bfloat16, and widening loses nothing
+                vectors=np.asarray(snap["vectors"], np.float32),
+            )
         with open(os.path.join(path, "meta.json"), "w") as f:
             json.dump(self._save_meta(), f)
 
@@ -282,12 +470,28 @@ class TpuFlat(_SlotStoreIndex):
             meta = json.load(f)
         self._check_meta(meta)
         data = np.load(os.path.join(path, "flat.npz"))
-        self.store = SlotStore.from_host(
-            self.dimension,
-            jnp.dtype(self.parameter.dtype),
-            data["ids"],
-            data["vectors"],
+        self.store = _new_tier_store(
+            self._precision, self.dimension, self.parameter,
+            capacity=max(len(data["ids"]), 1),
         )
+        # fresh rerank cache sharing the NEW store's lock; rows refill as
+        # post-restore writes arrive
+        self._init_precision(self.parameter, tier=self._precision)
+        if "codes" in data.files:
+            from dingo_tpu.ops.sq import SqParams
+
+            self.store.set_params(SqParams(
+                np.asarray(data["sq_vmin"], np.float32),
+                np.asarray(data["sq_scale"], np.float32),
+            ))
+            if len(data["ids"]):
+                self.store.put_codes(
+                    np.asarray(data["ids"], np.int64),
+                    np.asarray(data["codes"], np.uint8),
+                )
+        elif len(data["ids"]):
+            self.store.put(np.asarray(data["ids"], np.int64),
+                           data["vectors"])
         self.apply_log_id = meta["apply_log_id"]
         self.write_count_since_save = 0
 

@@ -56,6 +56,7 @@ from dingo_tpu.index.base import (
     NotTrained,
     SearchResult,
     VectorIndex,
+    resolve_precision,
     strip_invalid,
 )
 from dingo_tpu.common.config import FLAGS
@@ -108,7 +109,8 @@ _probe_lists = jax.jit(coarse_probes, static_argnames=("nprobe",))
 
 
 def ivf_scan_scores(
-    buckets, bucket_sqnorm, bucket_valid, bucket_slot, probes, queries, k, metric
+    buckets, bucket_sqnorm, bucket_valid, bucket_slot, probes, queries, k,
+    metric, sq_vmin=None, sq_scale=None,
 ):
     """Scan nprobe bucket ranks per query with a running top-k.
 
@@ -116,6 +118,9 @@ def ivf_scan_scores(
     bucket_*:    [nlist, cap_list] (sqnorm f32 / valid bool / slot int32)
     probes:      [b, nprobe] int32
     queries:     [b, d]
+    sq_*:        [d] SQ8 codec params when buckets hold uint8 codes —
+                 gathered buckets decode on the fly (ops/sq.py) with fp32
+                 accumulation; bucket_sqnorm then caches DECODED norms
     Returns raw SCORES (descending-better) + slots — shard_map-safe (no
     jit, no distance conversion) so the mesh-sharded IVF can merge scores
     across shards before converting; `_ivf_scan_kernel` is the single-
@@ -131,7 +136,7 @@ def ivf_scan_scores(
         rank_ok = lists_r >= 0
         lists_c = jnp.where(rank_ok, lists_r, 0)
         data = jnp.take(buckets, lists_c, axis=0)
-        if not jnp.issubdtype(data.dtype, jnp.floating):
+        if sq_vmin is None and not jnp.issubdtype(data.dtype, jnp.floating):
             # int8 stores (binary ivf): promote after the gather; float
             # stores (incl. bf16) keep their dtype — the einsum accumulates
             # in f32 via preferred_element_type either way
@@ -140,7 +145,13 @@ def ivf_scan_scores(
         val = jnp.take(bucket_valid, lists_c, axis=0) & rank_ok[:, None]
         slot = jnp.take(bucket_slot, lists_c, axis=0)
         # per-query distance to its own bucket: einsum over d
-        if metric is Metric.L2:
+        if sq_vmin is not None:
+            from dingo_tpu.ops.sq import sq_bucket_scores
+
+            scores = sq_bucket_scores(
+                queries, data, sq, sq_vmin, sq_scale, metric
+            )
+        elif metric is Metric.L2:
             dots = jnp.einsum(
                 "bd,bcd->bc", queries, data,
                 preferred_element_type=jnp.float32,
@@ -177,6 +188,19 @@ def _ivf_scan_kernel(
     vals, slots = ivf_scan_scores(
         buckets, bucket_sqnorm, bucket_valid, bucket_slot, probes, queries,
         k, metric,
+    )
+    return scores_to_distances(vals, metric), slots
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _ivf_scan_kernel_sq(
+    buckets, bucket_sqnorm, bucket_valid, bucket_slot, sq_vmin, sq_scale,
+    probes, queries, k, metric
+):
+    """SQ8 variant: buckets hold uint8 codes, decoded on the fly."""
+    vals, slots = ivf_scan_scores(
+        buckets, bucket_sqnorm, bucket_valid, bucket_slot, probes, queries,
+        k, metric, sq_vmin=sq_vmin, sq_scale=sq_scale,
     )
     return scores_to_distances(vals, metric), slots
 
@@ -455,7 +479,12 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         if parameter.metric is Metric.HAMMING and type(self) is TpuIvfFlat:
             raise InvalidParameter("use BINARY_IVF_FLAT for hamming")
         self._scan_metric = parameter.metric
-        self.store = SlotStore(parameter.dimension, jnp.dtype(parameter.dtype))
+        from dingo_tpu.index.flat import _new_tier_store
+
+        self.store = _new_tier_store(
+            resolve_precision(parameter), parameter.dimension, parameter
+        )
+        self._init_precision(parameter)
         self.nlist = parameter.ncentroids
         self.centroids: Optional[jax.Array] = None       # [nlist, d]
         self._c_sqnorm: Optional[jax.Array] = None
@@ -494,6 +523,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         if len(ids) != len(vectors):
             raise InvalidParameter("ids/vectors length mismatch")
         slots = self.store.put(np.asarray(ids, np.int64), vectors)
+        self._offer_rerank(slots, vectors)
         if self._assign_h.shape[0] < self.store.capacity:
             grown = np.full((self.store.capacity,), -1, np.int32)
             grown[: self._assign_h.shape[0]] = self._assign_h
@@ -514,6 +544,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
     def delete(self, ids: np.ndarray) -> None:
         slots = self.store.remove_slots(np.asarray(ids, np.int64))
         removed = int((slots >= 0).sum())
+        self._invalidate_rerank(slots)
         if removed:
             if self._view is not None and not self._view_dirty:
                 self._view_apply_delete(slots[slots >= 0])
@@ -533,8 +564,13 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         the stored vectors (VectorIndexManager::TrainForBuild samples the
         region, vector_index_manager.cc:1365)."""
         if vectors is None:
-            snap = self.store.to_host()
+            snap = self.store.to_host()   # SqSlotStore decodes here
             vectors = snap["vectors"]
+        elif self._precision == "sq8":
+            # an explicit train set reaches the codec BEFORE any encode
+            # happened — per-dim min/max from the true distribution beats
+            # first-batch lazy training
+            self.store.maybe_train(self._prep_vectors(vectors))
         vectors = np.asarray(vectors, np.float32)
         if len(vectors) < self.nlist:
             raise NotTrained(
@@ -566,7 +602,17 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         the O(N) path, reached only via rebuild/compaction. Caller holds
         device_lock (gather reads store.vecs, which is donatable)."""
         self._buckets = view.gather_rows(self.store.vecs)
+        if self._bf16_widen_view():
+            # CPU arm of the bf16 tier: rows are already bf16-quantized in
+            # the store; widening the SCAN copy once per rebuild dodges
+            # XLA CPU's scalar bf16 convert on every probe gather
+            self._buckets = self._buckets.astype(jnp.float32)
         self._bucket_sqnorm = view.gather_rows(self.store.sqnorm)
+
+    def _bf16_widen_view(self) -> bool:
+        from dingo_tpu.common.config import bf16_compute_native
+
+        return self._precision == "bf16" and not bf16_compute_native()
 
     def _scatter_view_data(self, upd, rows) -> None:
         """Apply a staged append batch to the data arrays (caller holds
@@ -586,7 +632,18 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         b_idx = (pos // cap).astype(np.int32)
         r_idx = (pos % cap).astype(np.int32)
         sel = np.asarray(rows)[src]
-        sq = (sel.astype(np.float32) ** 2).sum(axis=1)
+        if self._precision == "sq8":
+            # bucket view mirrors the store: scatter CODES, cache DECODED
+            # norms (same codec → bit-identical to the store rows)
+            sel = self.store.encode(sel)
+            deq = self.store.decode(sel)
+            sq = (deq ** 2).sum(axis=1).astype(np.float32)
+        else:
+            sq = (sel.astype(np.float32) ** 2).sum(axis=1)
+            if self._bf16_widen_view():
+                # widened-view arm: quantize through bf16 first so the f32
+                # scan copy matches the store rows bit-for-bit
+                sel = sel.astype(jnp.bfloat16).astype(np.float32)
         self._buckets = scatter_bucket_update(
             self._buckets, b_idx, r_idx, sel
         )
@@ -615,10 +672,12 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
             raise NotTrained("IVF_FLAT not trained")  # reader falls back
         queries = self._prep_queries(queries)
         self._ensure_view()
+        self._count_search()
         b = queries.shape[0]
         topk = int(topk)
         nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
-        k_eff, nprobe = self._shape_buckets(topk, nprobe)
+        kprime = self._rerank_shortlist(topk)
+        k_eff, nprobe = self._shape_buckets(max(topk, kprime or 0), nprobe)
         qpad = jnp.asarray(_pad_batch(queries))
         # lease BEFORE dispatch: kernel slots must stay limbo-parked until
         # resolve translates them (delete+reinsert would misattribute)
@@ -659,6 +718,19 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
                         ascending=metric_ascending(self._scan_metric),
                     )
                     dists = scores_to_distances(vals, self._scan_metric)
+                elif self._precision == "sq8":
+                    dists, slots = _ivf_scan_kernel_sq(
+                        self._buckets,
+                        self._bucket_sqnorm,
+                        valid,
+                        view.bucket_slot,
+                        self.store.sq_vmin_d,
+                        self.store.sq_scale_d,
+                        vprobes,
+                        qpad,
+                        k=k_eff,
+                        metric=self._scan_metric,
+                    )
                 else:
                     dists, slots = _ivf_scan_kernel(
                         self._buckets,
@@ -670,9 +742,22 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
                         k=k_eff,
                         metric=self._scan_metric,
                     )
+                if kprime is not None:
+                    # exact rerank of the quantized shortlist against the
+                    # device row cache, dispatched under the same lock
+                    # (cache arrays share it); still fully async
+                    dists, slots = self._dispatch_rerank(
+                        qpad, dists, slots, topk
+                    )
         except Exception:
             lease.release()
             raise
+        if kprime is not None:
+            from dingo_tpu.ops.distance import device_wait_span
+
+            # sampled traces time the scan+rerank chain as ops.rerank
+            # (outside the lock; no-op for unsampled requests)
+            device_wait_span("rerank", (dists, slots))
         store = self.store
         dists.copy_to_host_async()
         slots.copy_to_host_async()
@@ -691,7 +776,15 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
     # -- lifecycle -----------------------------------------------------------
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
-        snap = self.store.to_host()
+        if self._precision == "sq8" and self.store.sq_params is not None:
+            snap = self.store.codes_to_host()
+            # codes + codec params ride the snapshot exactly like PQ
+            # codebooks: bit-exact restore, 1 byte/dim on disk
+            snap["sq_vmin"] = self.store.sq_params.vmin
+            snap["sq_scale"] = self.store.sq_params.scale
+        else:
+            snap = self.store.to_host()
+            snap["vectors"] = np.asarray(snap["vectors"], np.float32)
         extras = {}
         if self.is_trained():
             extras["centroids"] = np.asarray(self.centroids)
@@ -705,6 +798,8 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
             json.dump(meta, f)
 
     def load(self, path: str) -> None:
+        from dingo_tpu.index.flat import _new_tier_store
+
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
         self._check_meta(meta)
@@ -713,12 +808,26 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
                 f"snapshot nlist {meta['nlist']} != {self.nlist}"
             )
         data = np.load(os.path.join(path, "ivf_flat.npz"))
-        self.store = SlotStore(self.dimension, jnp.dtype(self.parameter.dtype),
-                               max(len(data["ids"]), 1))
+        self.store = _new_tier_store(
+            self._precision, self.dimension, self.parameter,
+            capacity=max(len(data["ids"]), 1),
+        )
+        self._init_precision(self.parameter, tier=self._precision)
         self._assign_h = np.full((self.store.capacity,), -1, np.int32)
         self.centroids = None
         self._c_sqnorm = None
-        if len(data["ids"]):
+        if "codes" in data.files:
+            from dingo_tpu.ops.sq import SqParams
+
+            self.store.set_params(SqParams(
+                np.asarray(data["sq_vmin"], np.float32),
+                np.asarray(data["sq_scale"], np.float32),
+            ))
+            slots = self.store.put_codes(
+                np.asarray(data["ids"], np.int64),
+                np.asarray(data["codes"], np.uint8),
+            ) if len(data["ids"]) else np.empty(0, np.int64)
+        elif len(data["ids"]):
             # bypass upsert's assignment (we restore it directly)
             vecs = data["vectors"]
             if self.metric is Metric.COSINE:
@@ -763,6 +872,10 @@ class TpuBinaryIvfFlat(BinaryPm1Mixin, TpuIvfFlat):
         super().__init__(index_id, parameter)
         self.nbytes = parameter.dimension // 8
         self.store = SlotStore(parameter.dimension, jnp.int8)
+        # the ±1 int8 store IS the binary family's quantized form; the
+        # float precision tiers don't apply on top of it
+        self._precision = "fp32"
+        self._rerank_cache = None
         self._scan_metric = Metric.INNER_PRODUCT
         self._assign_h = np.full((self.store.capacity,), -1, np.int32)
 

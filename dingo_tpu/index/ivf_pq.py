@@ -256,8 +256,19 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
             raise InvalidParameter("only nbits=8 supported (uint8 codes)")
         if p.metric is Metric.HAMMING:
             raise InvalidParameter("hamming not valid for IVF_PQ")
+        from dingo_tpu.index.base import resolve_precision
+
+        self._precision = resolve_precision(p)
+        if self._precision == "sq8":
+            raise InvalidParameter(
+                "IVF_PQ codes are already quantized; sq8 applies to "
+                "FLAT/IVF_FLAT (use bf16 here for a smaller exact store)"
+            )
+        store_dtype = (
+            jnp.bfloat16 if self._precision == "bf16" else jnp.dtype(p.dtype)
+        )
         store_cls = HostSlotStore if p.host_vectors else SlotStore
-        self.store = store_cls(p.dimension, jnp.dtype(p.dtype))
+        self.store = store_cls(p.dimension, store_dtype)
         self.nlist = p.ncentroids
         self.m = p.nsubvector
         self.ksub = 1 << p.nbits_per_idx
@@ -457,6 +468,7 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
         # must stay stable (limbo-parked, not reassigned) until resolve
         # translates and, in rerank mode, gathers host rows for them
         lease = store.begin_search()
+        self._count_search()
         try:
             rerank = False
             if not self.is_trained():
@@ -497,14 +509,19 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
                 # share one residual LUT across a list's spill buckets when
                 # the [b, nprobe, m, ksub] table fits comfortably in HBM
                 lut_bytes = qpad.shape[0] * nprobe * self.m * self.ksub * 4
-                rerank = (
-                    isinstance(store, HostSlotStore)
-                    and FLAGS.get("ivfpq_rerank_factor") > 1
+                factor = FLAGS.get("ivfpq_rerank_factor")
+                # ADC prune + exact rerank: host-resident rows rerank at
+                # resolve time (host gather); DEVICE-resident rows rerank
+                # on device right after the scan — no host gather, no
+                # pipeline stall (ops/rerank.py)
+                rerank = isinstance(store, HostSlotStore) and factor > 1
+                rerank_dev = (
+                    not isinstance(store, HostSlotStore) and factor > 1
+                    and len(store) > 0
                 )
                 kprime = (
-                    min(len(store),
-                        int(topk) * FLAGS.get("ivfpq_rerank_factor"))
-                    if rerank else k_eff
+                    min(len(store), int(topk) * factor)
+                    if (rerank or rerank_dev) else k_eff
                 )
                 # view snapshot + dispatch under the device lock:
                 # incremental writes donate the bucket arrays to their
@@ -529,6 +546,19 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
                         k=max(k_eff, kprime),
                         precompute_lut=lut_bytes <= 256 * 1024 * 1024,
                     )
+                    if rerank_dev:
+                        from dingo_tpu.ops.rerank import exact_rerank_device
+
+                        # store.vecs captured under the SAME lock hold the
+                        # scan dispatched in (donated write safety)
+                        dists, slots = exact_rerank_device(
+                            store.vecs,
+                            store.sqnorm,
+                            qpad,
+                            slots,
+                            k=int(topk),
+                            metric=self.metric,
+                        )
         except Exception:
             lease.release()
             raise
@@ -564,6 +594,8 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
         snap = self.store.to_host()
+        # f32 on disk: numpy savez can't serialize ml_dtypes bfloat16
+        snap["vectors"] = np.asarray(snap["vectors"], np.float32)
         extras = {}
         if self.is_trained():
             extras["centroids"] = np.asarray(self.centroids)
@@ -584,7 +616,11 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
         store_cls = (
             HostSlotStore if self.parameter.host_vectors else SlotStore
         )
-        self.store = store_cls(self.dimension, jnp.dtype(self.parameter.dtype),
+        store_dtype = (
+            jnp.bfloat16 if self._precision == "bf16"
+            else jnp.dtype(self.parameter.dtype)
+        )
+        self.store = store_cls(self.dimension, store_dtype,
                                max(len(data["ids"]), 1))
         self._assign_h = np.full((self.store.capacity,), -1, np.int32)
         self._codes = None

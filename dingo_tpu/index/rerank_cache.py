@@ -1,0 +1,113 @@
+"""Bounded device-resident row cache backing exact rerank of quantized
+(bf16/SQ8) shortlists — the "compacted full-precision row cache" of the
+precision tier (ISSUE 4 tentpole part 3).
+
+Quantized tiers deliberately do NOT keep full-precision rows on device
+(the whole point is the HBM saved), so an exact rerank needs a separate,
+BOUNDED source of true rows. This cache reuses the SlotStore machinery
+(donation-safe contiguous writes, cached norms, pow2 capacity) with the
+OWNING store's slot numbers as keys:
+
+  offer()       — write path hands over the rows it already has in hand
+                  (no extra gather): rows for already-cached slots always
+                  refresh (overwrite correctness), new slots fill until
+                  max_rows.
+  invalidate()  — deletes drop the row (a reused slot must never rerank
+                  against a dead vector).
+  device_map()  — [store_capacity] int32 slot->cache-row table, maintained
+                  host-side and uploaded lazily exactly like SlotStore's
+                  validity bitmap, so the rerank kernel
+                  (ops/rerank.py cached_rerank_device) dispatches with
+                  zero host synchronization or per-request H2D beyond one
+                  int32 vector when the cache changed.
+
+The cache shares the owning store's device_lock: its arrays are donated by
+its own write programs, and the rerank kernel captures them at search
+dispatch — one lock serializes both, the same contract SlotStore documents
+for vecs/sqnorm.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dingo_tpu.index.slot_store import SlotStore, _next_pow2
+
+
+class DeviceRerankCache:
+    def __init__(self, dim: int, max_rows: int, dtype=jnp.float32,
+                 device_lock: Optional[threading.RLock] = None):
+        if max_rows <= 0:
+            raise ValueError(f"max_rows {max_rows}")
+        self.max_rows = int(max_rows)
+        self.inner = SlotStore(dim, dtype, capacity=_next_pow2(max_rows))
+        if device_lock is not None:
+            self.inner.device_lock = device_lock
+        self._dmap: Optional[jax.Array] = None
+        self._map_capacity = 0
+
+    def __len__(self) -> int:
+        return len(self.inner)
+
+    @property
+    def vecs(self) -> jax.Array:
+        return self.inner.vecs
+
+    @property
+    def sqnorm(self) -> jax.Array:
+        return self.inner.sqnorm
+
+    def offer(self, slots: np.ndarray, rows: np.ndarray) -> int:
+        """Insert/refresh rows keyed by owning-store slots; returns how
+        many landed. Already-cached slots ALWAYS update (an upsert that
+        moved a vector must not leave the stale row serving reranks); new
+        slots are admitted only while the cache has room."""
+        slots = np.asarray(slots, np.int64)
+        if not len(slots):
+            return 0
+        present = self.inner.slots_of(slots) >= 0
+        take = present.copy()
+        room = self.max_rows - len(self.inner)
+        if room > 0:
+            fresh = np.flatnonzero(~present)
+            # admit at most `room` DISTINCT new slots (a slot may repeat
+            # within one batch — every row of an admitted slot lands so
+            # last-write-wins matches the store)
+            uniq, first = np.unique(slots[fresh], return_index=True)
+            admitted = uniq[np.argsort(first)][:room]
+            take[fresh] = np.isin(slots[fresh], admitted)
+        if not take.any():
+            return 0
+        self.inner.put(slots[take], np.asarray(rows)[take])
+        self._dmap = None
+        return int(take.sum())
+
+    def invalidate(self, slots: np.ndarray) -> int:
+        n = self.inner.remove(np.asarray(slots, np.int64))
+        if n:
+            self._dmap = None
+        return n
+
+    def device_map(self, store_capacity: int) -> jax.Array:
+        """[store_capacity] int32: owning-store slot -> cache row, -1 when
+        absent. Rebuilt host-side + uploaded only when the cache changed
+        or the owning store grew."""
+        if self._dmap is None or self._map_capacity != store_capacity:
+            m = np.full((store_capacity,), -1, np.int32)
+            cache_rows = np.flatnonzero(self.inner.ids_by_slot >= 0)
+            if len(cache_rows):
+                store_slots = self.inner.ids_by_slot[cache_rows]
+                # drop entries pointing past a (shrunk/reloaded) store
+                ok = store_slots < store_capacity
+                m[store_slots[ok]] = cache_rows[ok].astype(np.int32)
+            self._dmap = jnp.asarray(m)
+            self._map_capacity = store_capacity
+        return self._dmap
+
+    def memory_size(self) -> int:
+        return self.inner.memory_size()

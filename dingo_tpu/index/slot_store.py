@@ -70,6 +70,28 @@ def _write_run(vecs, sqnorm, rows, start, lo, hi, nrows):
     return vecs, sqnorm
 
 
+@functools.partial(
+    jax.jit, static_argnames=("nrows",), donate_argnums=(0, 1)
+)
+def _write_run_presq(vecs, sqnorm, rows, row_sq, start, lo, hi, nrows):
+    """`_write_run` variant taking PRECOMPUTED row norms: quantized stores
+    write uint8 codes but must cache the norms of the DECODED rows (the
+    values the distance kernels actually scan), which the device cannot
+    derive from the codes row-dtype-agnostically. Same window/blend/donate
+    contract as _write_run."""
+    d = vecs.shape[1]
+    old = lax.dynamic_slice(vecs, (start, 0), (nrows, d))
+    idx = jnp.arange(nrows)
+    keep = (idx >= lo) & (idx < hi)
+    blend = jnp.where(keep[:, None], rows.astype(vecs.dtype), old)
+    vecs = lax.dynamic_update_slice(vecs, blend, (start, 0))
+    old_sq = lax.dynamic_slice(sqnorm, (start,), (nrows,))
+    sqnorm = lax.dynamic_update_slice(
+        sqnorm, jnp.where(keep, row_sq, old_sq), (start,)
+    )
+    return vecs, sqnorm
+
+
 class SlotStore:
     def __init__(self, dim: int, dtype=jnp.float32, capacity: int = MIN_CAPACITY):
         self.dim = dim
@@ -199,16 +221,21 @@ class SlotStore:
                 lo = win_start + bucket - self.capacity
                 win_start = self.capacity - bucket
                 padded = np.roll(padded, lo, axis=0)
-            self.vecs, self.sqnorm = _write_run(
-                self.vecs,
-                self.sqnorm,
-                jnp.asarray(padded),
-                jnp.int32(win_start),
-                jnp.int32(lo),
-                jnp.int32(lo + chunk),
-                nrows=bucket,
-            )
+            self._dispatch_write(padded, win_start, lo, chunk, bucket)
             off += chunk
+
+    def _dispatch_write(self, padded, win_start, lo, chunk, bucket) -> None:
+        """One donated write program over a padded pow2 window (quantized
+        stores override to supply precomputed decoded-row norms)."""
+        self.vecs, self.sqnorm = _write_run(
+            self.vecs,
+            self.sqnorm,
+            jnp.asarray(padded),
+            jnp.int32(win_start),
+            jnp.int32(lo),
+            jnp.int32(lo + chunk),
+            nrows=bucket,
+        )
 
     def remove(self, ids: np.ndarray) -> int:
         """Tombstone rows; returns number actually removed."""
@@ -358,3 +385,117 @@ class HostSlotStore(SlotStore):
     def memory_size(self) -> int:
         # host bytes; device footprint is the caller's codes/centroids
         return int(self.vecs.nbytes + self.sqnorm.nbytes)
+
+
+class SqSlotStore(SlotStore):
+    """SlotStore whose device rows are SQ8 codes (uint8, 1 byte/dim —
+    4x the vectors per chip vs f32; ops/sq.py codec).
+
+    The external contract stays FLOAT: put()/gather()/to_host() speak f32
+    rows (encode at the write boundary, decode at the read boundary), so
+    index code above — training, reassignment, exact fallbacks — runs
+    unchanged. Only the search kernels look at codes directly (via .vecs +
+    .sq_vmin_d/.sq_scale_d), and sqnorm caches ||x̂||^2 of the DECODED
+    surrogate so L2/cosine scores stay self-consistent with what the
+    kernels scan.
+
+    Codec params train lazily on the first write batch (min/max + margin,
+    faiss train-once-clip-later convention) unless maybe_train()/
+    set_params() installed them earlier (index.train with an explicit
+    train set, or a snapshot load)."""
+
+    def __init__(self, dim: int, dtype=jnp.uint8, capacity: int = MIN_CAPACITY):
+        if jnp.dtype(dtype) != jnp.uint8:
+            raise ValueError("SqSlotStore stores uint8 codes")
+        super().__init__(dim, jnp.uint8, capacity)
+        self.sq_params = None            # ops.sq.SqParams (host)
+        self._sq_vmin_d = None           # lazy device copies
+        self._sq_scale_d = None
+
+    # -- codec lifecycle ---------------------------------------------------
+    def set_params(self, params) -> None:
+        if self.sq_params is not None and len(self):
+            raise RuntimeError(
+                "cannot swap SQ params under live codes (re-ingest instead)"
+            )
+        self.sq_params = params
+        self._sq_vmin_d = None
+        self._sq_scale_d = None
+
+    def maybe_train(self, rows: np.ndarray) -> None:
+        """Install params from `rows` when none exist yet (no-op after)."""
+        if self.sq_params is None and len(rows):
+            from dingo_tpu.ops.sq import sq_train
+
+            self.set_params(sq_train(np.asarray(rows, np.float32)))
+
+    @property
+    def sq_vmin_d(self) -> jax.Array:
+        if self._sq_vmin_d is None:
+            self._sq_vmin_d = jnp.asarray(self.sq_params.vmin)
+        return self._sq_vmin_d
+
+    @property
+    def sq_scale_d(self) -> jax.Array:
+        if self._sq_scale_d is None:
+            self._sq_scale_d = jnp.asarray(self.sq_params.scale)
+        return self._sq_scale_d
+
+    def encode(self, rows: np.ndarray) -> np.ndarray:
+        from dingo_tpu.ops.sq import sq_encode
+
+        return sq_encode(rows, self.sq_params)
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        from dingo_tpu.ops.sq import sq_decode
+
+        return sq_decode(codes, self.sq_params)
+
+    # -- float-facing mutation/read paths ----------------------------------
+    def put(self, ids: np.ndarray, vectors: np.ndarray) -> np.ndarray:
+        self.maybe_train(vectors)
+        return super().put(ids, self.encode(np.asarray(vectors, np.float32)))
+
+    def put_codes(self, ids: np.ndarray, codes: np.ndarray) -> np.ndarray:
+        """Raw-code ingest (snapshot load): bypasses encode so a saved
+        code array round-trips bit-exactly."""
+        assert self.sq_params is not None, "set_params before put_codes"
+        return super().put(ids, np.asarray(codes, np.uint8))
+
+    def _dispatch_write(self, padded, win_start, lo, chunk, bucket) -> None:
+        # padded rows are CODES here; norms come from the decoded surrogate
+        deq = self.decode(padded)
+        row_sq = np.einsum("ld,ld->l", deq, deq).astype(np.float32)
+        self.vecs, self.sqnorm = _write_run_presq(
+            self.vecs,
+            self.sqnorm,
+            jnp.asarray(padded),
+            jnp.asarray(row_sq),
+            jnp.int32(win_start),
+            jnp.int32(lo),
+            jnp.int32(lo + chunk),
+            nrows=bucket,
+        )
+
+    def gather(self, ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        found, codes = super().gather(ids)
+        return found, self.decode(np.asarray(codes, np.uint8))
+
+    def to_host(self) -> dict:
+        """Decoded float snapshot — the safe default for callers that mean
+        'give me the vectors' (train sampling, rebuild). Use
+        codes_to_host() for the compact persistence form."""
+        snap = super().to_host()
+        if self.sq_params is None:
+            # untrained codec == no writes ever happened; the live set is
+            # empty and there is nothing to decode (an unconditional
+            # decode would dereference the missing params)
+            snap["vectors"] = np.zeros_like(snap["vectors"], np.float32)
+        else:
+            snap["vectors"] = self.decode(snap["vectors"])
+        return snap
+
+    def codes_to_host(self) -> dict:
+        """Compacted {ids, codes} of live rows (save path; 1 byte/dim)."""
+        snap = super().to_host()   # base returns raw device rows = codes
+        return {"ids": snap["ids"], "codes": snap["vectors"]}

@@ -192,6 +192,12 @@ class StoreMetricsCollector:
                 rm.vector_memory_bytes)
             g("store.region.device_memory_bytes", rid).set(
                 rm.device_memory_bytes)
+            # HBM bytes per resident vector: the precision-tier capacity
+            # win (fp32 -> bf16 -> sq8) as one scrapeable number; an
+            # emptied region reports 0, never its last live value
+            g("store.region.device_bytes_per_vector", rid).set(
+                rm.device_memory_bytes / rm.vector_count
+                if rm.vector_count else 0.0)
             g("store.region.apply_lag", rid).set(rm.apply_lag)
             g("store.region.is_leader", rid).set(1.0 if rm.is_leader else 0.0)
             g("store.region.index_ready", rid).set(

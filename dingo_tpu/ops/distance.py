@@ -62,7 +62,14 @@ def squared_norms(x: jax.Array) -> jax.Array:
 
 
 def _dot(q: jax.Array, x: jax.Array, precision=None) -> jax.Array:
-    """[b,d] @ [n,d]^T with f32 accumulation regardless of storage dtype."""
+    """[b,d] @ [n,d]^T with f32 accumulation regardless of storage dtype.
+
+    bf16-resident databases (the bf16 precision tier) pair the query down
+    to bf16 so the contraction is a native bf16 MXU matmul instead of XLA
+    materializing an f32 upcast of the whole [n, d] operand; accumulation
+    stays f32 via preferred_element_type either way."""
+    if x.dtype == jnp.bfloat16:
+        q = q.astype(jnp.bfloat16)
     return jnp.einsum(
         "bd,nd->bn",
         q,

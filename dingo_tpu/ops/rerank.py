@@ -1,0 +1,117 @@
+"""Device-resident exact rerank of quantized/approximate shortlists.
+
+The host rerank (`ivf_pq._exact_rerank_host`) pays a per-candidate host
+fancy-index + H2D upload at RESOLVE time — the right call when the full
+rows only exist in host RAM (host_vectors mode), and the wrong one when
+the rows (or a cached subset) are already resident in HBM: the gather is
+then one device `take`, the whole rerank dispatches in the same stream as
+the scan kernel, and search_async keeps pipelining instead of
+synchronizing on a host round-trip.
+
+Two kernels, both in the WIRE distance convention (L2 ascending, IP/cos
+descending) so they drop in right after any scan kernel:
+
+  exact_rerank_device   — rows for EVERY candidate are on device (fp32 or
+                          bf16 SlotStore; IVF_PQ's non-host store). ADC /
+                          quantized scores are discarded and recomputed
+                          exactly.
+  cached_rerank_device  — only a bounded row cache is resident
+                          (index/rerank_cache.py). Candidates present in
+                          the cache get exact scores; the rest keep their
+                          quantized score, so a partial cache can only
+                          IMPROVE the ranking, never lose a candidate.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from dingo_tpu.ops.distance import (
+    Metric,
+    metric_ascending,
+    scores_to_distances,
+    squared_norms,
+)
+
+
+def _exact_candidate_scores(vecs, sqnorm, queries, rows, metric):
+    """Exact 'larger is better' scores [b, k'] for candidate row indices
+    [b, k'] into vecs (callers pre-clamp negatives to 0)."""
+    cand = jnp.take(vecs, rows, axis=0)                 # [b, k', d]
+    qd = queries.astype(jnp.float32)
+    dots = jnp.einsum(
+        "bd,bkd->bk",
+        qd,
+        cand.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    if metric is Metric.L2:
+        c_sq = jnp.take(sqnorm, rows, axis=0)           # [b, k']
+        return -(squared_norms(qd)[:, None] - 2.0 * dots + c_sq)
+    if metric is Metric.COSINE:
+        c_sq = jnp.take(sqnorm, rows, axis=0)
+        inv = jax.lax.rsqrt(jnp.maximum(c_sq, 1e-30))
+        return dots * inv
+    return dots
+
+
+def _topk_epilogue(scores, cand_slots, k, metric):
+    """Shared tail of both rerank kernels: mask padding, top-k over the
+    shortlist, -1 the empty winners, pad out to k, convert to the wire
+    distance convention."""
+    scores = jnp.where(cand_slots >= 0, scores, jnp.float32(-jnp.inf))
+    kk = min(k, int(cand_slots.shape[1]))
+    vals, pos = jax.lax.top_k(scores, kk)
+    slots = jnp.take_along_axis(cand_slots, pos, axis=1)
+    slots = jnp.where(jnp.isneginf(vals), -1, slots)
+    if kk < k:
+        vals = jnp.pad(vals, ((0, 0), (0, k - kk)),
+                       constant_values=float("-inf"))
+        slots = jnp.pad(slots, ((0, 0), (0, k - kk)), constant_values=-1)
+    return scores_to_distances(vals, metric), slots
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def exact_rerank_device(
+    vecs, sqnorm, queries, cand_slots, k, metric
+):
+    """Exact top-k over the candidate slots, rows gathered ON DEVICE.
+
+    vecs/sqnorm  — the full store arrays [capacity, d] / [capacity]
+    cand_slots   — [b, k'] int32 shortlist (-1 pad)
+    Returns (wire distances [b, k], slots [b, k]); same contract as
+    `_exact_rerank_host`, minus the host gather."""
+    safe = jnp.where(cand_slots >= 0, cand_slots, 0)
+    scores = _exact_candidate_scores(vecs, sqnorm, queries, safe, metric)
+    return _topk_epilogue(scores, cand_slots, k, metric)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def cached_rerank_device(
+    cache_vecs, cache_sqnorm, cache_map,
+    cand_dists, cand_slots, queries, k, metric,
+):
+    """Rerank against a BOUNDED device row cache with quantized-score
+    fallback.
+
+    cache_map  — [store_capacity] int32: store slot -> cache row (-1 when
+                 the row is not cached); maintained host-side and uploaded
+                 lazily (index/rerank_cache.py), so this whole kernel
+                 dispatches with zero host synchronization.
+    cand_dists — [b, k'] WIRE distances from the quantized scan; kept
+                 verbatim for uncached candidates.
+    """
+    safe_slot = jnp.where(cand_slots >= 0, cand_slots, 0)
+    rows = jnp.take(cache_map, safe_slot, axis=0)       # [b, k'] (-1 miss)
+    cached = (rows >= 0) & (cand_slots >= 0)
+    exact = _exact_candidate_scores(
+        cache_vecs, cache_sqnorm, queries, jnp.where(cached, rows, 0),
+        metric,
+    )
+    quant = -cand_dists if metric_ascending(metric) else cand_dists
+    scores = jnp.where(cached, exact, quant)
+    return _topk_epilogue(scores, cand_slots, k, metric)

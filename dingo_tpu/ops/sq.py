@@ -1,0 +1,175 @@
+"""SQ8 scalar quantizer: per-dim min/max train, uint8 codes, quantized
+distance kernels with fp32 accumulation.
+
+The faiss analog is IndexScalarQuantizer / IndexIVFScalarQuantizer with
+QT_8bit ("The Faiss library" §4.2): store 1 byte/dim instead of 4, decode
+on the fly inside the distance kernel, and let a cheap exact rerank absorb
+the quantization noise. On TPU the decode is VPU elementwise work fused
+ahead of an MXU contraction, so the win is pure HBM capacity + bandwidth:
+4x fewer bytes per region vector (the binding constraint on how many
+vectors fit per chip — ISSUE 4 / ROADMAP north star).
+
+Codec (faiss QT_8bit convention, per-dimension affine):
+
+    scale[d] = (vmax[d] - vmin[d]) / 255        (floored at EPS_SPAN)
+    code     = round((x - vmin) / scale)  clipped to [0, 255]
+    decode   = vmin + scale * code
+
+Training is per-dim min/max over a sample with a small symmetric MARGIN so
+values slightly outside the training range still encode without clipping
+(train-once-clip-later, faiss's RangeStat_minmax behavior). Distances are
+computed against the DECODED surrogate x̂: the multiplies run in a compute
+dtype (bf16 on the MXU by default) while every accumulation stays fp32 via
+``preferred_element_type`` — the same accumulate contract as
+ops/distance.py. PQ's fp32 LUT rule (ops/pq.py:124) is unaffected: SQ8
+applies to coarse/flat distance evaluation, never to LUT accumulation.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dingo_tpu.ops.distance import Metric, squared_norms
+
+#: minimum per-dim span — a constant dimension still gets a valid scale
+EPS_SPAN = 1e-12
+#: symmetric range widening applied at train time (fraction of the span)
+TRAIN_MARGIN = 0.05
+
+
+class SqParams(NamedTuple):
+    """Trained per-dim affine codec; both arrays are [d] float32 (host
+    numpy — they ride persistence as plain npz arrays and upload per
+    kernel call, like centroids)."""
+
+    vmin: np.ndarray
+    scale: np.ndarray
+
+    @property
+    def dim(self) -> int:
+        return int(self.vmin.shape[0])
+
+
+def sq_train(x: np.ndarray, margin: float = TRAIN_MARGIN) -> SqParams:
+    """Per-dim min/max over the sample, widened by `margin` per side."""
+    x = np.asarray(x, np.float32)
+    if x.ndim != 2 or not len(x):
+        raise ValueError(f"sq_train needs [n, d] rows, got {x.shape}")
+    vmin = x.min(axis=0)
+    vmax = x.max(axis=0)
+    span = vmax - vmin
+    vmin = vmin - margin * span
+    span = span * (1.0 + 2.0 * margin)
+    scale = np.maximum(span, EPS_SPAN) / 255.0
+    return SqParams(vmin.astype(np.float32), scale.astype(np.float32))
+
+
+def sq_encode(x: np.ndarray, params: SqParams) -> np.ndarray:
+    """f32 rows [n, d] -> uint8 codes [n, d]; out-of-range values clip."""
+    x = np.asarray(x, np.float32)
+    q = np.rint((x - params.vmin[None, :]) / params.scale[None, :])
+    return np.clip(q, 0.0, 255.0).astype(np.uint8)
+
+
+def sq_decode(codes: np.ndarray, params: SqParams) -> np.ndarray:
+    """uint8 codes -> decoded f32 surrogate x̂ (host side)."""
+    return (
+        np.asarray(codes, np.float32) * params.scale[None, :]
+        + params.vmin[None, :]
+    )
+
+
+def sq_decode_device(
+    codes: jax.Array,
+    vmin: jax.Array,
+    scale: jax.Array,
+    dtype=jnp.bfloat16,
+) -> jax.Array:
+    """On-device decode [..., d] -> compute-dtype surrogate rows.
+
+    uint8 values are exactly representable in bf16 (integers <= 256), so
+    the only rounding is the affine itself — decode in f32, THEN downcast,
+    so vmin/scale precision isn't lost before the multiply-add."""
+    deq = codes.astype(jnp.float32) * scale + vmin
+    return deq.astype(dtype)
+
+
+def sq_score_matrix(
+    q: jax.Array,
+    codes: jax.Array,
+    vmin: jax.Array,
+    scale: jax.Array,
+    metric: Metric,
+    x_sqnorm: Optional[jax.Array] = None,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """'Larger is better' score matrix [b, n] over SQ8 codes [n, d].
+
+    The dot runs compute_dtype x compute_dtype with fp32 accumulation
+    (preferred_element_type) — on TPU that is a native bf16 MXU matmul fed
+    by 1-byte HBM reads. x_sqnorm must be ||x̂||^2 of the DECODED rows
+    (SqSlotStore caches exactly that), so L2/cosine stay consistent with
+    what the kernel actually scans."""
+    xhat = sq_decode_device(codes, vmin, scale, compute_dtype)
+    qd = q.astype(jnp.float32)
+    dots = jnp.einsum(
+        "bd,nd->bn",
+        qd.astype(compute_dtype),
+        xhat,
+        preferred_element_type=jnp.float32,
+    )
+    if metric is Metric.L2:
+        if x_sqnorm is None:
+            x_sqnorm = squared_norms(xhat)
+        return -(squared_norms(qd)[:, None] - 2.0 * dots + x_sqnorm[None, :])
+    if metric is Metric.INNER_PRODUCT:
+        return dots
+    if metric is Metric.COSINE:
+        # queries arrive pre-normalized (index _prep); decoded rows are
+        # only approximately unit, so divide by the cached decoded norm
+        if x_sqnorm is None:
+            x_sqnorm = squared_norms(xhat)
+        inv = jax.lax.rsqrt(jnp.maximum(x_sqnorm, 1e-30))
+        return dots * inv[None, :]
+    raise ValueError(f"SQ8 does not support metric {metric}")
+
+
+def sq_bucket_scores(
+    queries: jax.Array,
+    data: jax.Array,
+    sq: jax.Array,
+    vmin: jax.Array,
+    scale: jax.Array,
+    metric: Metric,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Per-query bucket scores [b, cap] for the IVF list scan: data is the
+    gathered uint8 code bucket [b, cap, d], sq the decoded-norm cache
+    [b, cap]. Mirrors the float arm of ivf_flat.ivf_scan_scores."""
+    xhat = sq_decode_device(data, vmin, scale, compute_dtype)
+    qd = queries.astype(jnp.float32)
+    dots = jnp.einsum(
+        "bd,bcd->bc",
+        qd.astype(compute_dtype),
+        xhat,
+        preferred_element_type=jnp.float32,
+    )
+    if metric is Metric.L2:
+        return -(squared_norms(qd)[:, None] - 2.0 * dots + sq)
+    if metric is Metric.COSINE:
+        inv = jax.lax.rsqrt(jnp.maximum(sq, 1e-30))
+        return dots * inv
+    return dots
+
+
+def params_close(a: SqParams, b: SqParams, atol: float = 0.0) -> bool:
+    """Exact-enough equality for persistence round-trip checks."""
+    return (
+        a.vmin.shape == b.vmin.shape
+        and np.allclose(a.vmin, b.vmin, atol=atol)
+        and np.allclose(a.scale, b.scale, atol=atol)
+    )

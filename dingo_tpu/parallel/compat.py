@@ -1,0 +1,29 @@
+"""jax version compatibility for the mesh-sharded index family.
+
+`shard_map` moved over jax releases: newer jax exports `jax.shard_map`
+(keyword `check_vma`), while 0.4.x only ships
+`jax.experimental.shard_map.shard_map` (keyword `check_rep`). The bare
+`from jax import shard_map` used to take down every `parallel/sharded_*`
+module — and with them four whole tier-1 test files — at import time on
+0.4.37. This shim presents ONE surface: the modern keyword names, mapped
+onto whichever implementation exists.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5: top-level export, `check_vma` keyword
+    from jax import shard_map as _shard_map
+
+    _REP_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental module, `check_rep` keyword
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _REP_KW = "check_rep"
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+    """`jax.shard_map` signature regardless of the installed jax."""
+    kwargs[_REP_KW] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

@@ -39,6 +39,7 @@ from dingo_tpu.index.base import (
     InvalidParameter,
     SearchResult,
     VectorIndex,
+    resolve_precision,
     strip_invalid,
 )
 from dingo_tpu.ops.distance import Metric
@@ -82,8 +83,21 @@ class TpuShardedFlat(VectorIndex):
                 f"dimension {parameter.dimension} not divisible by mesh "
                 f"dim axis {mesh.shape['dim']}"
             )
+        # precision tier over the mesh: bf16 shards the rows at half the
+        # HBM; sq8 stays single-device (code scatter over 'data' + per-dim
+        # affine replication is future work, not silently approximated)
+        self._precision = resolve_precision(parameter)
+        if self._precision == "sq8":
+            raise InvalidParameter(
+                "sq8 tier is not supported on mesh-sharded FLAT "
+                "(use bf16, or a single-device FLAT region)"
+            )
+        self._dtype = (
+            jnp.bfloat16 if self._precision == "bf16" else jnp.float32
+        )
         self._store = ShardedFlatStore(
-            mesh, dim=parameter.dimension, metric=parameter.metric
+            mesh, dim=parameter.dimension, metric=parameter.metric,
+            dtype=self._dtype,
         )
         self.cap_per_shard = 0
         self.ids_by_gslot = np.empty(0, np.int64)
@@ -107,7 +121,7 @@ class TpuShardedFlat(VectorIndex):
         sharding2d = NamedSharding(self.mesh, P("data", "dim"))
         sharding1d = NamedSharding(self.mesh, P("data"))
         if old_cap == 0:
-            z = jnp.zeros((S * cap, d), jnp.float32)
+            z = jnp.zeros((S * cap, d), self._dtype)
             self._store.vecs = jax.device_put(z, sharding2d)
             self._store.sqnorm = jax.device_put(
                 jnp.zeros((S * cap,), jnp.float32), sharding1d
@@ -265,7 +279,8 @@ class TpuShardedFlat(VectorIndex):
             self._store.vecs, self._store.sqnorm, self._store.valid = (
                 _scatter_rows(
                     self._store.vecs, self._store.sqnorm, self._store.valid,
-                    jnp.asarray(slots, jnp.int32), jnp.asarray(vectors),
+                    jnp.asarray(slots, jnp.int32),
+                    jnp.asarray(vectors, dtype=self._dtype),
                     jnp.asarray(row_sq), jnp.ones(len(ids), bool),
                 )
             )
@@ -293,7 +308,7 @@ class TpuShardedFlat(VectorIndex):
                 self._free_per_shard[s // self.cap_per_shard].append(s)
         if doomed:
             slots = jnp.asarray(np.asarray(doomed, np.int64), jnp.int32)
-            zrows = jnp.zeros((len(doomed), self.dimension), jnp.float32)
+            zrows = jnp.zeros((len(doomed), self.dimension), self._dtype)
             with self._device_lock:
                 self._store.vecs, self._store.sqnorm, self._store.valid = (
                     _scatter_rows(
@@ -363,11 +378,14 @@ class TpuShardedFlat(VectorIndex):
         return len(self._id_to_gslot)
 
     def get_memory_size(self) -> int:
-        return int(self.total_slots * self.dimension * 4)
+        return int(
+            self.total_slots * self.dimension * jnp.dtype(self._dtype).itemsize
+        )
 
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
-        vecs = np.asarray(jax.device_get(self._store.vecs))
+        # f32 on disk regardless of tier (savez can't take ml_dtypes bf16)
+        vecs = np.asarray(jax.device_get(self._store.vecs), np.float32)
         live = np.flatnonzero(self.ids_by_gslot >= 0)
         np.savez(
             os.path.join(path, "sharded_flat.npz"),

@@ -43,7 +43,7 @@ from typing import List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from dingo_tpu.parallel.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dingo_tpu.index.base import (

@@ -22,10 +22,10 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dingo_tpu.ops.distance import Metric
+from dingo_tpu.parallel.compat import shard_map
 from dingo_tpu.ops.topk import merge_sharded_topk, topk_scores
 
 
@@ -43,8 +43,14 @@ def make_mesh(n_devices: Optional[int] = None, data: Optional[int] = None,
 def _local_search(vecs, sqnorm, valid, queries, k, ascending):
     """Per-device block: partial dots psum'd over 'dim', local top-k over the
     row shard, then all_gather + merge over 'data'. Runs inside shard_map."""
+    if vecs.dtype == jnp.bfloat16:
+        # bf16 precision tier: pair the query down so the contraction is a
+        # native bf16 MXU matmul (accumulation stays f32 below)
+        queries_c = queries.astype(jnp.bfloat16)
+    else:
+        queries_c = queries
     dots = jnp.einsum(
-        "bd,nd->bn", queries, vecs,
+        "bd,nd->bn", queries_c, vecs,
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     )
@@ -106,12 +112,16 @@ def _kmeans_step(vecs, valid, centroids):
 class ShardedFlatStore:
     """A region's vectors sharded [data, dim] with replicated metadata."""
 
-    def __init__(self, mesh: Mesh, dim: int, metric: Metric = Metric.L2):
+    def __init__(self, mesh: Mesh, dim: int, metric: Metric = Metric.L2,
+                 dtype=jnp.float32):
         if metric not in (Metric.L2, Metric.INNER_PRODUCT, Metric.COSINE):
             raise ValueError(f"unsupported sharded metric {metric}")
         self.mesh = mesh
         self.dim = dim
         self.metric = metric
+        #: row storage dtype (f32, or bf16 for the bf16 precision tier —
+        #: norms/accumulation stay f32)
+        self.dtype = jnp.dtype(dtype)
         self.n_data = mesh.shape["data"]
         self.n_dim = mesh.shape["dim"]
         assert dim % self.n_dim == 0, "dim must divide over mesh 'dim' axis"
@@ -143,7 +153,8 @@ class ShardedFlatStore:
         )
         self.cap_per_shard = cap
         self.vecs = jax.device_put(
-            vecs, NamedSharding(self.mesh, P("data", "dim"))
+            vecs.astype(self.dtype),
+            NamedSharding(self.mesh, P("data", "dim"))
         )
         self.sqnorm = jax.device_put(
             sqnorm, NamedSharding(self.mesh, P("data"))

@@ -57,6 +57,7 @@ def index_parameter_to_pb(p: Optional[IndexParameter]) -> pb.VectorIndexParamete
     out.nlinks = p.nlinks
     out.host_vectors = p.host_vectors
     out.scalar_speedup_keys.extend(p.scalar_speedup_keys)
+    out.precision = p.precision
     return out
 
 
@@ -75,6 +76,7 @@ def index_parameter_from_pb(m: pb.VectorIndexParameter) -> Optional[IndexParamet
         nlinks=m.nlinks or 32,
         host_vectors=m.host_vectors,
         scalar_speedup_keys=tuple(m.scalar_speedup_keys),
+        precision=m.precision,
     )
 
 

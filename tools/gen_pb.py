@@ -98,6 +98,11 @@ NEW_MESSAGES = {
 
 #: fields appended to existing messages
 NEW_FIELDS = {
+    # precision tier for float FLAT/IVF_FLAT storage+compute (ISSUE 4):
+    # "" (conf default) / "fp32" / "bf16" / "sq8"
+    "VectorIndexParameter": [
+        ("precision", 13, T.TYPE_STRING, None, False),
+    ],
     # heartbeat transport for the metrics payload
     "StoreHeartbeatRequest": [
         ("metrics", 11, T.TYPE_MESSAGE, ".dingo_tpu.StoreMetrics", False),
