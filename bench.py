@@ -95,8 +95,10 @@ def precision_sweep_and_hybrid(platform):
     import time as _time
 
     from dingo_tpu.common.config import FLAGS
+    from dingo_tpu.common.metrics import METRICS
     from dingo_tpu.index import IndexParameter, IndexType, new_index
     from dingo_tpu.index.base import FilterSpec
+    from dingo_tpu.obs import HBM
 
     n = int(os.environ.get("DINGO_BENCH_SWEEP_N", 50_000))
     d = int(os.environ.get("DINGO_BENCH_SWEEP_D", 256))
@@ -155,6 +157,10 @@ def precision_sweep_and_hybrid(platform):
         for t in [idx.search_async(queries, k, nprobe=nprobe)
                   for _ in range(3)]:
             t()          # untimed pipelined burst: settle caches/allocator
+        # recompile sentinel: the timed loop below must be trace-free
+        # after warmup (the monitored invariant; 0 expected per tier)
+        recompiles_c = METRICS.counter("xla.recompiles")
+        recompiles0 = recompiles_c.get()
         t0 = _time.perf_counter()
         thunks = [idx.search_async(queries, k, nprobe=nprobe)
                   for _ in range(iters)]
@@ -162,6 +168,11 @@ def precision_sweep_and_hybrid(platform):
             t()
         dt = (_time.perf_counter() - t0) / iters
         qps = batch / dt
+        steady_recompiles = recompiles_c.get() - recompiles0
+        # HBM ledger: per-owner attribution + high-watermark for this
+        # tier's index (live jax.Array bytes — meaningful on CPU too)
+        HBM.account_index(100 + ("fp32", "bf16", "sq8").index(tier), idx)
+        hbm_peak = HBM.region_peak(100 + ("fp32", "bf16", "sq8").index(tier))
         bytes_per_vec = idx.get_device_memory_size() / max(1, idx.get_count())
         if tier == "fp32":
             fp32_qps = qps
@@ -175,9 +186,14 @@ def precision_sweep_and_hybrid(platform):
                 sweep["fp32"]["device_bytes_per_vector"] / bytes_per_vec, 2
             ) if tier != "fp32" else 1.0,
             "rerank_cache_rows": cache_rows if tier == "sq8" else 0,
+            # monitored invariant: the timed steady-state loop ran with
+            # zero jit-cache misses (warmup covered every shape bucket)
+            "steady_state_recompiles": int(steady_recompiles),
+            "hbm_peak_bytes": int(hbm_peak),
         }
         log(f"sweep {tier}: {qps:,.0f} QPS recall@10={rec:.4f} "
-            f"{bytes_per_vec:.0f} B/vec")
+            f"{bytes_per_vec:.0f} B/vec "
+            f"{steady_recompiles} steady-state recompiles")
     FLAGS.set("rerank_cache_rows", 0)
     FLAGS.set("rerank_cache_dtype", "float32")
 
@@ -335,6 +351,11 @@ def main():
     # jit-warmup: pre-compile the shape-bucketed programs so neither loop
     # below pays an XLA compile mid-measurement
     idx.warmup(batches=(batch,), topk=k, nprobe=nprobe)
+    from dingo_tpu.common.metrics import METRICS
+    from dingo_tpu.obs import HBM
+
+    ro_recompiles_c = METRICS.counter("xla.recompiles")
+    ro_recompiles0 = ro_recompiles_c.get()
     iters = 50
     t0 = time.perf_counter()
     thunks = [idx.search_async(queries, k, nprobe=nprobe) for _ in range(iters)]
@@ -353,8 +374,10 @@ def main():
     lats.sort()
     p50 = lats[lat_iters // 2]
     p99 = lats[min(lat_iters - 1, int(lat_iters * 0.99))]
+    ro_recompiles = ro_recompiles_c.get() - ro_recompiles0
     log(f"{platform.upper()} blocking batch={batch}: "
-        f"p50={p50:.2f} ms p99={p99:.2f} ms")
+        f"p50={p50:.2f} ms p99={p99:.2f} ms "
+        f"({ro_recompiles} steady-state recompiles)")
 
     # --- mixed read/write: searches with upserts+deletes in flight ---
     # The Index role's real workload: raft-applied writes continuously
@@ -367,8 +390,17 @@ def main():
 
     wb = int(os.environ.get("DINGO_BENCH_WRITE_BATCH", 256))
     mixed_iters = 30
+    # one untimed mixed round warms the WRITE-path shape buckets (scatter
+    # ladders, tombstone flips) the read-only warmup can't reach; the
+    # measured loop below must then be recompile-free
+    wsel = rng.choice(n, wb, replace=False)
+    idx.delete(ids[wsel[: wb // 2]])
+    idx.upsert(ids[wsel], x[wsel])
+    idx.search(queries, k, nprobe=nprobe)
     rebuilds_c = METRICS.counter("ivf.full_rebuild", region_id=1)
     rebuilds0 = rebuilds_c.get()
+    m_recompiles_c = METRICS.counter("xla.recompiles")
+    m_recompiles0 = m_recompiles_c.get()
     mlats = []
     for it in range(mixed_iters):
         sel = rng.choice(n, wb, replace=False)
@@ -381,13 +413,18 @@ def main():
     m_p50 = mlats[mixed_iters // 2]
     m_p99 = mlats[min(mixed_iters - 1, int(mixed_iters * 0.99))]
     rebuilds = rebuilds_c.get() - rebuilds0
+    m_recompiles = m_recompiles_c.get() - m_recompiles0
+    HBM.account_index(1, idx)
     vstats = idx.view_stats() if hasattr(idx, "view_stats") else {}
     log(f"{platform.upper()} mixed r/w batch={batch} writes={wb}+{wb//2}/iter: "
         f"p50={m_p50:.2f} ms p99={m_p99:.2f} ms "
         f"(read-only p99={p99:.2f}; {rebuilds} full rebuilds, "
-        f"{vstats.get('inplace_appends', 0)} in-place appends)")
+        f"{vstats.get('inplace_appends', 0)} in-place appends, "
+        f"{m_recompiles} steady-state recompiles)")
 
     # --- precision sweep (fp32/bf16/sq8) + row-5 hybrid (ISSUE 4) ---
+    from dingo_tpu.metrics.device import device_memory_stats
+
     sweep, hybrid = precision_sweep_and_hybrid(platform)
 
     # --- CPU baseline: numpy/OpenBLAS IVF-flat with same layout ---
@@ -440,6 +477,15 @@ def main():
         "pipelined_ms_per_batch": round(dt * 1e3, 3),
         "p50_ms": round(p50, 3),
         "p99_ms": round(p99, 3),
+        # jit-cache misses across BOTH read-only measurement loops after
+        # warmup (the PR 3 shape-bucketing invariant, now observed)
+        "steady_state_recompiles": int(ro_recompiles),
+        # HBM high-watermark: allocator peak on TPU, live-array ledger
+        # peak everywhere (region 1 = the bench index)
+        "hbm_high_watermark_bytes": int(
+            max(device_memory_stats()["peak_bytes_in_use"],
+                HBM.region_peak(1))
+        ),
         # rebuild-cliff gate: search latency with writes in flight must
         # stay within ~2x of the read-only p99 (ISSUE 3 acceptance)
         "mixed_rw": {
@@ -448,6 +494,8 @@ def main():
             "p99_ms": round(m_p99, 3),
             "p99_vs_readonly": round(m_p99 / max(p99, 1e-9), 2),
             "full_rebuilds": int(rebuilds),
+            "steady_state_recompiles": int(m_recompiles),
+            "hbm_peak_bytes": int(HBM.region_peak(1)),
             "inplace_appends": int(vstats.get("inplace_appends", 0)),
             "tombstone_ratio": round(
                 float(vstats.get("tombstone_ratio", 0.0)), 4

@@ -72,6 +72,7 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
             str(sum(r.vector_count for r in m.regions)),
             _fmt_bytes(sum(r.vector_memory_bytes for r in m.regions)),
             _fmt_bytes(sum(r.device_memory_bytes for r in m.regions)),
+            _fmt_bytes(sum(r.device_peak_bytes for r in m.regions)),
             _fmt_bytes(m.device_bytes_in_use),
             f"{sum(r.search_qps for r in m.regions if r.is_leader):.1f}",
         ])
@@ -93,6 +94,7 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
                 str(r.vector_count),
                 _fmt_bytes(r.vector_memory_bytes),
                 _fmt_bytes(r.device_memory_bytes),
+                _fmt_bytes(r.device_peak_bytes),
                 str(r.apply_lag),
                 f"{r.search_qps:.1f}",
                 ",".join(flags) or "-",
@@ -101,13 +103,13 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
     out = [
         _render_table(
             ["STORE", "METRICS", "REGIONS", "LEADERS", "KEYS", "VECTORS",
-             "MEM", "DEVMEM", "DEV-IN-USE", "QPS"],
+             "MEM", "DEVMEM", "DEVPEAK", "DEV-IN-USE", "QPS"],
             store_rows,
         ),
         "",
         _render_table(
             ["REGION", "STORE", "ROLE", "KEYS", "VECTORS", "MEM", "DEVMEM",
-             "LAG", "QPS", "FLAGS"],
+             "DEVPEAK", "LAG", "QPS", "FLAGS"],
             region_rows,
         ),
     ]

@@ -181,6 +181,23 @@ FLAGS.define("quantized_rerank_factor", 4, mutable=True,
              help_="bf16/sq8 searches with a non-empty rerank cache scan "
                    "topk*factor candidates and rerank them exactly on "
                    "device (1 disables the stage)")
+FLAGS.define("obs_flight_buffer_s", 30.0, mutable=True,
+             help_="flight-recorder metrics window: bundles carry metric "
+                   "deltas over the last this-many seconds of ticks (the "
+                   "store-metrics crontab drives the tick ring)")
+FLAGS.define("obs_flight_max_bundles", 16, mutable=True,
+             help_="flight-recorder retention: newest N compressed "
+                   "bundles kept in memory (0 disables capturing)")
+FLAGS.define("obs_exemplars", True, mutable=True,
+             help_="attach trace-id exemplars to latency-series outliers "
+                   "in the Prometheus exposition (OpenMetrics syntax) so "
+                   "a scrape links a bad bucket to its trace/flight "
+                   "bundle")
+FLAGS.define("hbm_watermark_interval_s", 10.0, mutable=True,
+             help_="period of the process HBM watermark poll (allocator "
+                   "bytes-in-use/limit/peak -> hbm.* gauges); per-region "
+                   "owner ledgers additionally refresh with every "
+                   "store-metrics collection pass")
 FLAGS.define("use_pallas_ivf_search", "auto", mutable=True,
              help_="route trained IVF_FLAT searches through the Pallas "
                    "list-DMA kernel (streams only probed buckets to VMEM; "

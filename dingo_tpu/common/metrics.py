@@ -71,6 +71,11 @@ class Gauge:
 #: windowed-QPS horizon: per-second hit buckets retained this many seconds
 QPS_WINDOW_S = 16
 
+#: exemplar retention: an outlier exemplar not beaten by a larger sample
+#: is replaced by the next traced sample after this long, so the scrape
+#: follows CURRENT outliers instead of the all-time worst
+EXEMPLAR_WINDOW_S = 60.0
+
 
 class LatencyRecorder:
     """bvar::LatencyRecorder analog: ring of recent samples with
@@ -93,9 +98,20 @@ class LatencyRecorder:
         # more than QPS_WINDOW_S ago) are excluded at read time
         self._sec_hits = [0] * QPS_WINDOW_S
         self._sec_id = [-1] * QPS_WINDOW_S
+        # exemplar: (value_us, trace_id, unix_ts) of a recent outlier
+        # sample that carried a trace id — the Prometheus exposition
+        # attaches it to the p99 series (OpenMetrics exemplar syntax) so a
+        # scrape links a bad bucket straight to its trace/flight bundle
+        self._ex_us = 0.0
+        self._ex_trace = ""
+        self._ex_ts = 0.0
+        # a pinned exemplar (sample the flight recorder bundled) is sticky:
+        # merely-larger unbundled samples can't displace it inside the
+        # window, so the scrape keeps linking to a bundle that exists
+        self._ex_pinned = False
         self._lock = threading.Lock()
 
-    def observe_us(self, us: float) -> None:
+    def observe_us(self, us: float, trace_id: str = "") -> None:
         with self._lock:
             if len(self._samples) < self._window:
                 self._samples.append(us)
@@ -110,6 +126,35 @@ class LatencyRecorder:
                 self._sec_id[i] = now_s
                 self._sec_hits[i] = 0
             self._sec_hits[i] += 1
+            if trace_id:
+                now = time.time()
+                expired = now - self._ex_ts > EXEMPLAR_WINDOW_S
+                if expired or (not self._ex_pinned and us >= self._ex_us):
+                    self._ex_us = us
+                    self._ex_trace = trace_id
+                    self._ex_ts = now
+                    self._ex_pinned = False
+
+    def pin_exemplar(self, us: float, trace_id: str) -> None:
+        """Force this sample to be the exemplar regardless of magnitude.
+        The slow-query path pins the sample it just flight-recorded so the
+        scrape's exemplar always links to a CAPTURED bundle's trace, not
+        merely the window's largest sample (e.g. a warmup compile)."""
+        if not trace_id:
+            return
+        with self._lock:
+            self._ex_us = us
+            self._ex_trace = trace_id
+            self._ex_ts = time.time()
+            self._ex_pinned = True
+
+    def exemplar(self):
+        """(value_us, trace_id, unix_ts) of the retained outlier, or None
+        when no traced sample has been observed."""
+        with self._lock:
+            if not self._ex_trace:
+                return None
+            return (self._ex_us, self._ex_trace, self._ex_ts)
 
     class _Timer:
         __slots__ = ("rec", "t0")
@@ -294,11 +339,17 @@ class MetricsRegistry:
             out[k] = lr.stats()
         return out
 
-    def render_prometheus(self) -> str:
+    def render_prometheus(self, exemplars: Optional[bool] = None) -> str:
         """Prometheus text exposition format (v0.0.4): counters and gauges
         as-is, latency windows as summaries (quantile labels + lifetime
         _sum/_count). Dotted names mangle to underscores; series sharing a
-        base name group under one # TYPE header."""
+        base name group under one # TYPE header.
+
+        `exemplars` controls the OpenMetrics trace-id exemplar suffix on
+        p99 series: None follows the obs.exemplars flag (the in-band
+        DebugService dump and tools default), False strips them — the
+        CLASSIC text format cannot carry exemplars, so the HTTP sidecar
+        passes False unless the scraper negotiated OpenMetrics."""
         with self._lock:
             counters = list(self._counters.items())
             gauges = list(self._gauges.items())
@@ -325,15 +376,27 @@ class MetricsRegistry:
             emit("gauge", key,
                  lambda pn, pairs, b, v=v:
                  b.append(f"{pn}{_prom_label_str(pairs)} {_fmt(v)}"))
+        exemplars_on = _exemplars_enabled() if exemplars is None \
+            else (exemplars and _exemplars_enabled())
         for key, lr in lats:
             st = lr.stats()
+            ex = lr.exemplar() if exemplars_on else None
 
-            def render(pn, pairs, b, st=st):
+            def render(pn, pairs, b, st=st, ex=ex):
                 for q, field in (("0.5", "p50_us"), ("0.99", "p99_us")):
-                    b.append(
+                    line = (
                         f"{pn}{_prom_label_str(list(pairs) + [('quantile', q)])}"
                         f" {_fmt(st[field])}"
                     )
+                    if q == "0.99" and ex is not None:
+                        # OpenMetrics exemplar: trace id of a recent
+                        # outlier sample rides the p99 series
+                        val, trace_id, ts = ex
+                        line += (
+                            f' # {{trace_id="{trace_id}"}} '
+                            f"{_fmt(round(val, 3))} {_fmt(round(ts, 3))}"
+                        )
+                    b.append(line)
                 ls = _prom_label_str(pairs)
                 b.append(f"{pn}_sum{ls} {_fmt(st['sum_us'])}")
                 b.append(f"{pn}_count{ls} {int(st['count'])}")
@@ -350,6 +413,17 @@ class MetricsRegistry:
         for pname in sorted(by_name):
             lines.extend(by_name[pname])
         return "\n".join(lines) + "\n"
+
+
+def _exemplars_enabled() -> bool:
+    """obs.exemplars flag (lazy import: config must stay import-light and
+    cycle-free from this module)."""
+    try:
+        from dingo_tpu.common.config import FLAGS
+
+        return bool(FLAGS.get("obs_exemplars"))
+    except Exception:  # noqa: BLE001 — registry usable standalone
+        return False
 
 
 def _fmt(v: float) -> str:

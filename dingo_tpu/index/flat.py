@@ -10,7 +10,6 @@ searches hit cached executables.
 
 from __future__ import annotations
 
-import functools
 import json
 import os
 from typing import Callable, List, Optional
@@ -34,9 +33,10 @@ from dingo_tpu.index.rerank_cache import DeviceRerankCache
 from dingo_tpu.index.slot_store import SlotStore, SqSlotStore, _next_pow2
 from dingo_tpu.ops.distance import Metric, normalize, score_matrix, scores_to_distances
 from dingo_tpu.ops.topk import topk_scores
+from dingo_tpu.obs.sentinel import sentinel_jit
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric", "nbits"))
+@sentinel_jit("index.flat.search", static_argnames=("k", "metric", "nbits"))
 def _flat_search_kernel(vecs, sqnorm, mask, queries, k, metric, nbits):
     """Whole-index scan + masked top-k; returns distances and SLOT indices
     (host translates slots -> 64-bit external ids, see slot_store.py)."""
@@ -52,7 +52,7 @@ def _flat_search_kernel(vecs, sqnorm, mask, queries, k, metric, nbits):
     return scores_to_distances(vals, metric), slots
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric"))
+@sentinel_jit("index.flat.search_sq", static_argnames=("k", "metric"))
 def _sq_flat_search_kernel(codes, vmin, scale, sqnorm, mask, queries, k,
                            metric):
     """SQ8 whole-index scan: decode-on-the-fly bf16 compute over uint8

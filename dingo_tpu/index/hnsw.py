@@ -18,7 +18,6 @@ final ordering matches the flat index bit-for-bit.
 from __future__ import annotations
 
 import ctypes
-import functools
 import json
 import os
 from typing import List, Optional
@@ -39,6 +38,7 @@ from dingo_tpu.index.flat import _SlotStoreIndex, _pad_batch
 from dingo_tpu.index.slot_store import SlotStore
 from dingo_tpu.ops.distance import Metric, normalize
 from dingo_tpu.ops.topk import topk_scores
+from dingo_tpu.obs.sentinel import sentinel_jit
 
 _LIB = None
 
@@ -52,7 +52,7 @@ def _lib():
     return _LIB
 
 
-@functools.partial(jax.jit, static_argnames=("k", "ascending"))
+@sentinel_jit("index.hnsw.rerank", static_argnames=("k", "ascending"))
 def _rerank_kernel(vecs, sqnorm, queries, cand_slots, cand_valid, k, ascending):
     """Exact re-rank of per-query candidate slots.
 

@@ -39,13 +39,14 @@ the reference buffers train data similarly).
 
 from __future__ import annotations
 
-import functools
 import json
 import os
 from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
+
+from dingo_tpu.obs.sentinel import sentinel_jit
 import numpy as np
 from jax import lax
 
@@ -105,7 +106,8 @@ def coarse_probes(queries, centroids, c_sqnorm, nprobe):
     return idx.astype(jnp.int32)
 
 
-_probe_lists = jax.jit(coarse_probes, static_argnames=("nprobe",))
+_probe_lists = sentinel_jit("index.ivf.probe_lists", coarse_probes,
+                            static_argnames=("nprobe",))
 
 
 def ivf_scan_scores(
@@ -181,7 +183,7 @@ def ivf_scan_scores(
     return vals, slots
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric"))
+@sentinel_jit("index.ivf.scan", static_argnames=("k", "metric"))
 def _ivf_scan_kernel(
     buckets, bucket_sqnorm, bucket_valid, bucket_slot, probes, queries, k, metric
 ):
@@ -192,7 +194,7 @@ def _ivf_scan_kernel(
     return scores_to_distances(vals, metric), slots
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric"))
+@sentinel_jit("index.ivf.scan_sq", static_argnames=("k", "metric"))
 def _ivf_scan_kernel_sq(
     buckets, bucket_sqnorm, bucket_valid, bucket_slot, sq_vmin, sq_scale,
     probes, queries, k, metric
@@ -205,7 +207,7 @@ def _ivf_scan_kernel_sq(
     return scores_to_distances(vals, metric), slots
 
 
-@jax.jit
+@sentinel_jit("index.ivf.filter_mask")
 def _filter_bucket_mask(slot_mask, bucket_slot):
     """Expand a [capacity] slot mask to [B, cap_list] ON DEVICE. The
     filtered path used to build (and upload) the full bucket-shaped mask

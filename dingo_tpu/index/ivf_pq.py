@@ -24,7 +24,6 @@ TPU-first design:
 
 from __future__ import annotations
 
-import functools
 import json
 import os
 from typing import List, Optional
@@ -55,6 +54,7 @@ from dingo_tpu.ops.kmeans import (
 )
 from dingo_tpu.ops.pq import pq_train, split_subvectors
 from dingo_tpu.ops.topk import merge_topk
+from dingo_tpu.obs.sentinel import sentinel_jit
 
 
 HOST_SCAN_CHUNK = 65536
@@ -140,7 +140,7 @@ def _exact_rerank_host(store, queries, cand_slots, k, metric):
     return scores_to_distances(vals, metric), slots_out
 
 
-@functools.partial(jax.jit, static_argnames=())
+@sentinel_jit("index.ivfpq.encode_residual")
 def _encode_residual(vectors, assign, centroids, codebooks):
     """codes[n, m] uint8 for residuals (vectors - their centroid)."""
     resid = vectors - jnp.take(centroids, assign, axis=0)
@@ -153,7 +153,7 @@ def _encode_residual(vectors, assign, centroids, codebooks):
     return jax.vmap(enc_one)(subs, codebooks).T.astype(jnp.uint8)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "precompute_lut"))
+@sentinel_jit("index.ivfpq.scan", static_argnames=("k", "precompute_lut"))
 def _ivfpq_scan_kernel(
     code_buckets,      # [B, cap_list, m] uint8 (spill buckets, ivf_layout.py)
     bucket_valid,      # [B, cap_list] bool

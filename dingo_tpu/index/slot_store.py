@@ -28,22 +28,21 @@ from __future__ import annotations
 
 import threading
 
-import functools
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from dingo_tpu.obs.sentinel import sentinel_jit
 
 MIN_CAPACITY = 4096
 #: Max rows per dynamic_update_slice program (pads to pow2 buckets up to this).
 MAX_WRITE_BUCKET = 4096
 
 
-@functools.partial(
-    jax.jit, static_argnames=("nrows",), donate_argnums=(0, 1)
-)
+@sentinel_jit("index.slot_store.write_run",
+              static_argnames=("nrows",), donate_argnums=(0, 1))
 def _write_run(vecs, sqnorm, rows, start, lo, hi, nrows):
     """Blend rows[lo:hi] of the padded [nrows] window into vecs/sqnorm at
     window position `start` (i.e. slots start+lo .. start+hi-1).
@@ -70,9 +69,8 @@ def _write_run(vecs, sqnorm, rows, start, lo, hi, nrows):
     return vecs, sqnorm
 
 
-@functools.partial(
-    jax.jit, static_argnames=("nrows",), donate_argnums=(0, 1)
-)
+@sentinel_jit("index.slot_store.write_run_presq",
+              static_argnames=("nrows",), donate_argnums=(0, 1))
 def _write_run_presq(vecs, sqnorm, rows, row_sq, start, lo, hi, nrows):
     """`_write_run` variant taking PRECOMPUTED row norms: quantized stores
     write uint8 codes but must cache the norms of the DECODED rows (the

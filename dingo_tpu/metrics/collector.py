@@ -22,11 +22,12 @@ from typing import Optional
 from dingo_tpu.common.log import get_logger
 from dingo_tpu.common.metrics import METRICS
 from dingo_tpu.engine.raw_engine import CF_DEFAULT
-from dingo_tpu.metrics.device import device_memory_stats
 from dingo_tpu.metrics.snapshot import (
     RegionMetricsSnapshot,
     StoreMetricsSnapshot,
 )
+from dingo_tpu.obs.flight import FLIGHT
+from dingo_tpu.obs.hbm import HBM
 
 _log = get_logger("metrics.collector")
 
@@ -79,7 +80,10 @@ class StoreMetricsCollector:
         )
         ok = True
         try:
-            dev = device_memory_stats()
+            # one allocator query serves both the snapshot and the hbm
+            # watermark gauges (the hbm.watermark_interval_s crontab
+            # polls between passes)
+            dev = HBM.poll_process()
             snap.device_bytes_in_use = dev["bytes_in_use"]
             snap.device_bytes_limit = dev["bytes_limit"]
             snap.device_peak_bytes = dev["peak_bytes_in_use"]
@@ -102,7 +106,11 @@ class StoreMetricsCollector:
             # not burn a full sweep attempt on every single heartbeat
             self._latest_mono = time.monotonic()
             self.collect_total += 1
-            return self._latest
+            latest = self._latest
+        # feed the flight recorder's metric-delta ring OUTSIDE the lock
+        # (tick dumps the whole registry; bundles diff against it)
+        FLIGHT.tick()
+        return latest
 
     # ---------------- per-region ----------------
     def _collect_region(self, region) -> RegionMetricsSnapshot:
@@ -141,8 +149,17 @@ class StoreMetricsCollector:
             except Exception:  # noqa: BLE001 — index mid-build
                 pass
             # own index only — a post-split share serves from the PARENT's
-            # arrays; counting them on both regions would double-book HBM
-            rm.device_memory_bytes = wrapper.get_device_memory_size()
+            # arrays; counting them on both regions would double-book HBM.
+            # One object-graph walk serves both figures: the ledger's
+            # owner attribution sums to the index's live device bytes
+            # (shared dedup set + 'other' remainder root), so the total
+            # comes from the same pass instead of a second walk
+            owners = HBM.account_index(region.id, wrapper)
+            rm.device_memory_bytes = (
+                sum(owners.values()) if owners
+                else wrapper.get_device_memory_size()  # share/mid-build
+            )
+            rm.device_peak_bytes = HBM.region_peak(region.id)
         if region.document_index is not None:
             rm.document_count = region.document_index.count()
         rm.search_qps = self.registry.latency(
@@ -175,6 +192,7 @@ class StoreMetricsCollector:
         current = {rm.region_id for rm in snap.regions}
         for rid in self._published_regions - current:
             self.registry.drop_region(rid)
+            HBM.forget_region(rid)
         self._published_regions = current
         g = self.registry.gauge
         g("store.device.bytes_in_use").set(snap.device_bytes_in_use)

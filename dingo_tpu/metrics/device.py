@@ -78,7 +78,28 @@ def live_device_bytes(*roots, max_depth: int = 4) -> int:
         import jax
     except Exception:  # noqa: BLE001
         return 0
-    seen = set()
+    return _sum_live_bytes(jax, roots, set(), max_depth)
+
+
+def live_device_bytes_by_owner(owned_roots, max_depth: int = 4):
+    """Per-owner device-byte attribution over a SHARED dedup set: walk the
+    (owner, root) pairs in order and charge each distinct jax.Array to the
+    FIRST owner that reaches it. This is the hbm ledger's region view —
+    owners overlap (an IVF view holds gathered copies, a rerank cache
+    shares the store's lock but not its buffers) and the shared `seen` set
+    is what keeps the owner columns summable without double-booking."""
+    try:
+        import jax
+    except Exception:  # noqa: BLE001
+        return {owner: 0 for owner, _ in owned_roots}
+    seen: set = set()
+    return {
+        owner: _sum_live_bytes(jax, (root,), seen, max_depth)
+        for owner, root in owned_roots
+    }
+
+
+def _sum_live_bytes(jax, roots, seen, max_depth: int) -> int:
     total = 0
     stack = [(r, 0) for r in roots if r is not None]
     while stack:

@@ -38,9 +38,21 @@ class MetricsHttpServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
-                    body = registry.render_prometheus().encode()
+                    # trace-id exemplars are a NONSTANDARD suffix the
+                    # classic text parser rejects — served only on
+                    # explicit opt-in (?exemplars=1) for tooling that
+                    # understands it (tools/, tests, dashboards that
+                    # pre-process). A plain Prometheus scrape always gets
+                    # clean v0.0.4 text. (Accept-header OpenMetrics
+                    # negotiation deliberately NOT attempted: modern
+                    # Prometheus offers openmetrics-text by default, and
+                    # this exposition isn't OM-conformant — counters
+                    # lack _total, exemplars ride summaries.)
+                    want_ex = "exemplars=1" in query.split("&")
+                    body = registry.render_prometheus(
+                        exemplars=want_ex).encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif path == "/vars":
                     body = json.dumps(

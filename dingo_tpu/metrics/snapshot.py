@@ -34,6 +34,9 @@ class RegionMetricsSnapshot:
     is_leader: bool = False
     search_qps: float = 0.0
     document_count: int = 0
+    #: HBM high-watermark of the region total (obs hbm ledger); peaks are
+    #: what size a region move or explain an OOM — instants don't
+    device_peak_bytes: int = 0
 
 
 @persist.register

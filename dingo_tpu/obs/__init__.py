@@ -1,0 +1,36 @@
+"""Device-runtime observability: the hardware layer made visible.
+
+Three cooperating pieces (see ARCHITECTURE.md "Device-runtime
+observability"):
+
+- ``sentinel`` — recompile sentinel: counts jit cache hits vs traces per
+  kernel/shape-bucket/dtype at every persistent jitted entry point and
+  turns "steady-state serving never recompiles" into a monitored
+  invariant (``xla.recompiles`` / ``xla.compile_ms`` + ``xla.compile``
+  spans).
+- ``hbm`` — per-region device-memory ledger with per-owner
+  high-watermarks (``hbm.*`` gauges) and the allocation-failure hook.
+- ``flight`` — flight recorder: on slow query / search error / device
+  OOM, snapshots spans + metric deltas + kernel cache + hbm ledger into
+  a compressed bundle (DebugService ``FlightDump``,
+  ``tools/flight_report.py``).
+"""
+
+from dingo_tpu.obs.flight import FLIGHT, FlightRecorder  # noqa: F401
+from dingo_tpu.obs.hbm import HBM, HbmLedger, looks_like_oom  # noqa: F401
+from dingo_tpu.obs.sentinel import (  # noqa: F401
+    SENTINEL,
+    RecompileSentinel,
+    sentinel_jit,
+)
+
+__all__ = [
+    "FLIGHT",
+    "FlightRecorder",
+    "HBM",
+    "HbmLedger",
+    "RecompileSentinel",
+    "SENTINEL",
+    "looks_like_oom",
+    "sentinel_jit",
+]

@@ -14,13 +14,13 @@ points (faiss re-assigns empty clusters similarly).
 
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 
 from dingo_tpu.ops.distance import pairwise_l2sqr, squared_norms
+from dingo_tpu.obs.sentinel import sentinel_jit
 
 #: max_points_per_centroid default in faiss ClusteringParameters is 256;
 #: the reference derives IVF train sizes from it (vector_index_ivf_pq.cc:337).
@@ -36,7 +36,7 @@ def _pad_to_multiple(x: jax.Array, m: int) -> Tuple[jax.Array, jax.Array]:
     return x, valid
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@sentinel_jit("ops.kmeans.init", static_argnames=("k",))
 def farthest_first_init(x: jax.Array, first_idx: jax.Array, k: int) -> jax.Array:
     """Deterministic k-means++-style seeding: greedy farthest-first traversal.
 
@@ -65,7 +65,7 @@ def farthest_first_init(x: jax.Array, first_idx: jax.Array, k: int) -> jax.Array
     return chosen
 
 
-@functools.partial(jax.jit, static_argnames=("k", "iters", "chunk"))
+@sentinel_jit("ops.kmeans.fit", static_argnames=("k", "iters", "chunk"))
 def kmeans_fit(
     x: jax.Array,
     seed_idx: jax.Array,
@@ -159,7 +159,7 @@ def train_kmeans(
     return kmeans_fit(x, seeds, k=k, iters=iters)
 
 
-@functools.partial(jax.jit, static_argnames=("chunk",))
+@sentinel_jit("ops.kmeans.assign", static_argnames=("chunk",))
 def kmeans_assign(
     x: jax.Array, centroids: jax.Array, chunk: int = 16384
 ) -> jax.Array:

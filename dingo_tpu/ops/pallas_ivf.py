@@ -28,6 +28,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from dingo_tpu.ops.pallas_topk import _select_topk
+from dingo_tpu.obs.sentinel import sentinel_jit
 
 NEG_INF = float("-inf")
 #: output lane padding (TPU lane width; k slots live in the first k lanes)
@@ -94,9 +95,8 @@ def _ivf_kernel(vp_ref, q_ref, qsq_ref, x_ref, xsq_ref, val_ref, slot_ref,
         outi_ref[row, :] = jnp.where(jnp.isneginf(fv), -1, outi_ref[row, :])
 
 
-@functools.partial(
-    jax.jit, static_argnames=("k", "ascending", "interpret")
-)
+@sentinel_jit("ops.pallas.ivf_list_topk",
+              static_argnames=("k", "ascending", "interpret"))
 def ivf_list_topk(
     vprobes: jax.Array,        # [b, budget] int32 virtual bucket ids (-1 pad)
     queries: jax.Array,        # [b, d] f32

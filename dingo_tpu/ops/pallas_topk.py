@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from dingo_tpu.obs.sentinel import sentinel_jit
 
 NEG_INF = float("-inf")
 
@@ -100,10 +101,8 @@ def _fused_kernel(q_ref, qsq_ref, x_ref, xsq_ref, valid_ref,
         out_i_ref[:] = jnp.where(jnp.isneginf(fv), -1, best_i[:])
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("k", "block", "ascending", "interpret"),
-)
+@sentinel_jit("ops.pallas.fused_topk",
+              static_argnames=("k", "block", "ascending", "interpret"))
 def fused_topk(
     q: jax.Array,
     x: jax.Array,

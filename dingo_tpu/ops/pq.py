@@ -18,7 +18,6 @@ TPU design:
 
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax
@@ -26,6 +25,7 @@ import jax.numpy as jnp
 
 from dingo_tpu.ops import kmeans as _kmeans
 from dingo_tpu.ops.distance import pairwise_l2sqr
+from dingo_tpu.obs.sentinel import sentinel_jit
 
 
 def split_subvectors(x: jax.Array, m: int) -> jax.Array:
@@ -58,7 +58,7 @@ def pq_train(
     return jax.vmap(fit_one)(subs, first)
 
 
-@functools.partial(jax.jit, static_argnames=("chunk",))
+@sentinel_jit("ops.pq.encode", static_argnames=("chunk",))
 def pq_encode(x: jax.Array, codebooks: jax.Array, chunk: int = 8192) -> jax.Array:
     """Encode x[n, d] -> codes[n, m] uint8 (nearest codeword per subspace)."""
     m, ksub, dsub = codebooks.shape
@@ -95,7 +95,7 @@ def adc_lut(q: jax.Array, codebooks: jax.Array) -> jax.Array:
     return jnp.transpose(jax.vmap(one)(qs, codebooks), (1, 0, 2))
 
 
-@functools.partial(jax.jit, static_argnames=("chunk",))
+@sentinel_jit("ops.pq.adc_scan", static_argnames=("chunk",))
 def adc_scan(
     lut: jax.Array, codes: jax.Array, chunk: int = 32768
 ) -> jax.Array:

@@ -24,10 +24,11 @@ descending) so they drop in right after any scan kernel:
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
+
+from dingo_tpu.obs.sentinel import sentinel_jit
 
 from dingo_tpu.ops.distance import (
     Metric,
@@ -75,7 +76,7 @@ def _topk_epilogue(scores, cand_slots, k, metric):
     return scores_to_distances(vals, metric), slots
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric"))
+@sentinel_jit("ops.rerank.exact", static_argnames=("k", "metric"))
 def exact_rerank_device(
     vecs, sqnorm, queries, cand_slots, k, metric
 ):
@@ -90,7 +91,7 @@ def exact_rerank_device(
     return _topk_epilogue(scores, cand_slots, k, metric)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric"))
+@sentinel_jit("ops.rerank.cached", static_argnames=("k", "metric"))
 def cached_rerank_device(
     cache_vecs, cache_sqnorm, cache_map,
     cand_dists, cand_slots, queries, k, metric,

@@ -18,11 +18,11 @@ Conventions shared by every helper here:
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from dingo_tpu.obs.sentinel import sentinel_jit
 
 
 def _next_pow2(n: int) -> int:
@@ -34,7 +34,7 @@ def _next_pow2(n: int) -> int:
 MAX_SCATTER_BATCH = 8192
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
+@sentinel_jit("ops.scatter.bucket_rows", donate_argnums=(0,))
 def _scatter_bucket_rows(dst, b_idx, r_idx, vals):
     """dst[b_idx[i], r_idx[i]] = vals[i]; out-of-range indices dropped.
 
@@ -43,7 +43,7 @@ def _scatter_bucket_rows(dst, b_idx, r_idx, vals):
     return dst.at[b_idx, r_idx].set(vals.astype(dst.dtype), mode="drop")
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
+@sentinel_jit("ops.scatter.axis0", donate_argnums=(0,))
 def _scatter_axis0(dst, idx, vals):
     return dst.at[idx].set(vals.astype(dst.dtype), mode="drop")
 

@@ -44,11 +44,12 @@ from dingo_tpu.index.base import (
 )
 from dingo_tpu.ops.distance import Metric
 from dingo_tpu.parallel.sharded_store import ShardedFlatStore, make_mesh
+from dingo_tpu.obs.sentinel import sentinel_jit
 
 MIN_CAP_PER_SHARD = 64
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+@sentinel_jit("parallel.flat.scatter_rows", donate_argnums=(0, 1, 2))
 def _scatter_rows(vecs, sqnorm, valid, slots, rows, row_sq, row_valid):
     """Donated batch update; XLA routes each row to its owning shard."""
     vecs = vecs.at[slots].set(rows)

@@ -42,6 +42,8 @@ from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
+
+from dingo_tpu.obs.sentinel import sentinel_jit
 import numpy as np
 from dingo_tpu.parallel.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -168,7 +170,8 @@ class TpuShardedIvfFlat(TpuShardedFlat):
             return f(buckets, bsq, bval, bslot, ptable, centroids, c_sq,
                      queries, cap)
 
-        self._ivf_search_jit = jax.jit(
+        self._ivf_search_jit = sentinel_jit(
+            "parallel.ivf.search",
             search_fn, static_argnames=("k", "nprobe", "max_spill")
         )
 
@@ -195,7 +198,8 @@ class TpuShardedIvfFlat(TpuShardedFlat):
                 sq.reshape(S, B, cap_list),
             )
 
-        self._gather_view_jit = jax.jit(
+        self._gather_view_jit = sentinel_jit(
+            "parallel.ivf.gather_view",
             gather_fn, static_argnames=("B", "cap_list")
         )
 
@@ -219,7 +223,7 @@ class TpuShardedIvfFlat(TpuShardedFlat):
             )
             return f(vecs, valid, centroids, c_sq)
 
-        self._assign_jit = jax.jit(assign_fn)
+        self._assign_jit = sentinel_jit("parallel.ivf.assign", assign_fn)
 
     # -- training ------------------------------------------------------------
     def need_train(self) -> bool:

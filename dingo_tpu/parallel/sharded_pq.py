@@ -58,6 +58,7 @@ from dingo_tpu.index.ivf_layout import expand_probes_ranked
 from dingo_tpu.ops.distance import Metric
 from dingo_tpu.ops.kmeans import kmeans_assign
 from dingo_tpu.ops.pq import pairwise_l2sqr, pq_train, split_subvectors
+from dingo_tpu.obs.sentinel import sentinel_jit
 from dingo_tpu.ops.topk import merge_sharded_topk
 from dingo_tpu.parallel.sharded_ivf import TpuShardedIvfFlat
 
@@ -143,7 +144,7 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
             # vecs [cap, d], assign [cap] int32 (-1 unassigned)
             return _encode_codes(vecs, assign, centroids, codebooks, m)
 
-        self._encode_all_jit = jax.jit(shard_map(
+        self._encode_all_jit = sentinel_jit("parallel.pq.encode_all", shard_map(
             encode_local, mesh=mesh,
             in_specs=(P("data", None), P("data"), P(None, None),
                       P(None, None, None)),
@@ -165,7 +166,8 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
             S = mesh.shape["data"]
             return out.reshape(S, B, cap_list, m)
 
-        self._gather_codes_jit = jax.jit(
+        self._gather_codes_jit = sentinel_jit(
+            "parallel.pq.gather_codes",
             gather_codes_fn, static_argnames=("B", "cap_list")
         )
 
@@ -240,7 +242,8 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
             return f(codebkts, bval, bslot, bcoarse, ptable, vecs, sqnorm,
                      centroids, c_sq, codebooks, queries, cap)
 
-        self._pq_search_jit = jax.jit(
+        self._pq_search_jit = sentinel_jit(
+            "parallel.pq.search",
             search_fn,
             static_argnames=(
                 "k", "kprime", "nprobe", "max_spill", "precompute_lut"

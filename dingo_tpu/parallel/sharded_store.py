@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dingo_tpu.ops.distance import Metric
 from dingo_tpu.parallel.compat import shard_map
 from dingo_tpu.ops.topk import merge_sharded_topk, topk_scores
+from dingo_tpu.obs.sentinel import sentinel_jit
 
 
 def make_mesh(n_devices: Optional[int] = None, data: Optional[int] = None,
@@ -181,7 +182,8 @@ class ShardedFlatStore:
             )
             return f(vecs, sqnorm, valid, queries)
 
-        self._search_jit = jax.jit(search_fn, static_argnames=("k",))
+        self._search_jit = sentinel_jit("parallel.flat.search", search_fn,
+                                        static_argnames=("k",))
 
         def train_fn(vecs, valid, centroids0, iters):
             step = shard_map(
@@ -201,7 +203,8 @@ class ShardedFlatStore:
             )
             return centroids, counts[-1]
 
-        self._train_jit = jax.jit(train_fn, static_argnames=("iters",))
+        self._train_jit = sentinel_jit("parallel.flat.train", train_fn,
+                                       static_argnames=("iters",))
 
     def search(self, queries: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """Returns (ids [b, k] int64 with -1 padding, distances [b, k])."""

@@ -250,7 +250,7 @@ _REGION_METRIC_FIELDS = (
     "vector_memory_bytes", "device_memory_bytes", "index_ready",
     "index_building", "index_build_error", "index_apply_log_id",
     "index_snapshot_log_id", "apply_lag", "is_leader", "search_qps",
-    "document_count",
+    "document_count", "device_peak_bytes",
 )
 
 _STORE_METRIC_FIELDS = (

@@ -260,6 +260,38 @@ def serve_store(args) -> None:
         node.metrics.collect,
         immediately=True,
     )
+    # device-runtime observability: process HBM watermark poll (per-region
+    # owner ledgers refresh with each store_metrics pass) + region/index
+    # config snapshots for flight-recorder bundles
+    from dingo_tpu.obs import FLIGHT, HBM
+
+    crontab.add(
+        "hbm_watermark",
+        float(FLAGS.get("hbm_watermark_interval_s")),
+        HBM.poll_process,
+        immediately=True,
+    )
+
+    def _flight_node_config():
+        return {
+            "store_id": node.store_id,
+            "regions": {
+                r.id: {
+                    "type": r.definition.region_type.name,
+                    "index": (
+                        r.definition.index_parameter.index_type.name
+                        if r.definition.index_parameter else None
+                    ),
+                    "leader": (
+                        node.engine.get_node(r.id).is_leader()
+                        if node.engine.get_node(r.id) else False
+                    ),
+                }
+                for r in node.meta.get_all_regions()
+            },
+        }
+
+    FLIGHT.config_provider = _flight_node_config
     metrics_http = _maybe_metrics_http()
     crontab.start()
     print(f"store {args.id} listening on 127.0.0.1:{port}", flush=True)

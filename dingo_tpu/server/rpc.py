@@ -156,6 +156,7 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "TraceDump": (pb.MetricsDumpRequest, pb.MetricsDumpResponse),
         "TraceChromeDump": (pb.MetricsDumpRequest, pb.MetricsDumpResponse),
         "FailPoint": (pb.FailPointRequest, pb.FailPointResponse),
+        "FlightDump": (pb.FlightDumpRequest, pb.FlightDumpResponse),
     },
     "CoordinatorService": {
         "Hello": (pb.HelloRequest, pb.HelloResponse),
@@ -278,6 +279,12 @@ def _register(server: grpc.Server, service_name: str, impl) -> None:
                     get_logger("rpc").exception(
                         "%s.%s failed", service_name, method)
                     span.set_error(e)
+                    # black-box the failure: spans + metric deltas + kernel
+                    # cache + hbm ledger at the moment it happened (device
+                    # OOMs get their own reason and bump hbm.alloc_failures)
+                    from dingo_tpu.obs.flight import black_box_error
+
+                    black_box_error(span_name, e, span)
                     resp = resp_t()
                     if hasattr(resp, "error"):
                         resp.error.errcode = 99999

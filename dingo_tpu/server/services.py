@@ -177,6 +177,10 @@ class IndexService:
         region = _region_or_err(self.node, req.context, resp)
         if region is None:
             return resp, None
+        # fault-injection point for the search path (flight-recorder tests
+        # panic here; a panic propagates to the generic rpc handler which
+        # black-boxes it and answers in-band)
+        FAILPOINTS.apply("before_vector_search")
         from dingo_tpu.trace import current_span
 
         ingress = current_span()
@@ -253,6 +257,12 @@ class IndexService:
                     region, queries, topn, stage_us=stage_us, **kw
                 )
         except (VectorIndexError, ValueError) as e:
+            # in-band search failures never reach the generic rpc handler,
+            # so they black-box here (device OOMs included)
+            from dingo_tpu.obs.flight import black_box_error
+
+            black_box_error("rpc.IndexService.VectorSearch", e, ingress,
+                            region_id=region.id)
             return _err(resp, 30001, str(e)), None
         for row in results:
             r = resp.batch_results.add()
@@ -1360,6 +1370,32 @@ class DebugService:
                 FAILPOINTS.configure(req.name, req.config)
         except ValueError as e:
             return _err(resp, 50001, str(e))
+        return resp
+
+    def FlightDump(self, req: pb.FlightDumpRequest) -> pb.FlightDumpResponse:
+        """Flight-recorder export: bundle catalog always; one compressed
+        payload (zlib JSON — tools/flight_report.py renders it) when
+        include_payload is set (bundle_id empty = newest)."""
+        from dingo_tpu.obs.flight import FLIGHT
+
+        resp = pb.FlightDumpResponse()
+        metas = FLIGHT.bundles_meta()
+        for m in metas:
+            out = resp.bundles.add()
+            for field in ("id", "reason", "name", "trace_id", "region_id",
+                          "created_ms", "payload_bytes"):
+                setattr(out, field, m[field])
+        if req.include_payload:
+            found = FLIGHT.get_with_id(req.bundle_id)
+            if found is None:
+                return _err(
+                    resp, 50003,
+                    f"no flight bundle {req.bundle_id!r}" if req.bundle_id
+                    else "no flight bundles captured",
+                )
+            # id + payload resolved atomically: a bundle captured between
+            # the catalog read above and here can't mislabel the blob
+            resp.payload_bundle_id, resp.payload = found
         return resp
 
 

@@ -230,18 +230,46 @@ class Tracer:
         rec = span.record()
         self.buffer.add(rec)
         # bridge: every span name is automatically a LatencyRecorder, so
-        # aggregate percentiles come for free wherever a span exists
+        # aggregate percentiles come for free wherever a span exists; the
+        # trace id rides along as an exemplar candidate (outlier samples
+        # surface it in the Prometheus exposition)
         METRICS.latency(f"span.{span.name}").observe_us(
-            rec["dur_us"] or (span.end_ns - span.start_ns) / 1000.0
+            rec["dur_us"] or (span.end_ns - span.start_ns) / 1000.0,
+            trace_id=rec["trace_id"],
         )
         if self._slow_eligible(span.name, span.parent_id):
             slow_ms = FLAGS.get("slow_query_ms")
             if slow_ms > 0 and rec["dur_us"] >= slow_ms * 1000.0:
                 self.buffer.add_slow(rec)
+                bundle_id = self._capture_flight(rec)
+                if bundle_id:
+                    # pin the scrape exemplar to THIS sample: the p99
+                    # series must link to the trace a bundle was CAPTURED
+                    # for — not to a larger unbundled sample (a warmup
+                    # compile), and not to a rate-limited slow query that
+                    # has no bundle to link to
+                    METRICS.latency(f"span.{span.name}").pin_exemplar(
+                        rec["dur_us"], rec["trace_id"]
+                    )
+                # logs -> traces -> flight bundles are one hop each: the
+                # line carries the trace id and (when captured) the bundle
                 _log.warning(
-                    "slow query: %s took %.1f ms (trace %s)",
+                    "slow query: %s took %.1f ms (trace %s%s)",
                     span.name, rec["dur_us"] / 1000.0, rec["trace_id"],
+                    f", bundle {bundle_id}" if bundle_id else "",
                 )
+
+    @staticmethod
+    def _capture_flight(rec: Dict[str, Any]) -> str:
+        """Hand the slow-log record to the flight recorder (lazy import —
+        this is the slow path only; the recorder itself rate-limits).
+        Observability must never fail the request that tripped it."""
+        try:
+            from dingo_tpu.obs.flight import FLIGHT
+
+            return FLIGHT.on_slow_query(rec)
+        except Exception:  # noqa: BLE001
+            return ""
 
     #: replication-plane spans: a slow/down PEER makes every one of these
     #: slow — they'd churn the user-query evidence out of the slow log
@@ -281,14 +309,18 @@ class Tracer:
             return
         # synthesized single-record evidence: the request was unsampled so
         # no span tree exists, but the outlier itself must not be lost
-        self.buffer.add_slow({
+        rec = {
             "name": name, "trace_id": "", "span_id": "", "parent_id": "",
             "start_us": t0 // 1000, "dur_us": dur_us,
             "thread": threading.get_ident(), "status": "ok",
             "attrs": {"unsampled": True},
-        })
+        }
+        self.buffer.add_slow(rec)
+        bundle_id = self._capture_flight(rec)
         _log.warning(
-            "slow query (unsampled): %s took %.1f ms", name, dur_us / 1000.0
+            "slow query (unsampled): %s took %.1f ms%s",
+            name, dur_us / 1000.0,
+            f" (bundle {bundle_id})" if bundle_id else "",
         )
 
 

@@ -38,6 +38,36 @@ def test_checker_validates_fstring_prefix(tmp_path):
     assert len(problems) == 1 and problems[0][0] == 4
 
 
+def test_checker_validates_span_names(tmp_path):
+    f = tmp_path / "spans.py"
+    f.write_text(
+        "from dingo_tpu.trace import TRACER\n"
+        "TRACER.start_span('rpc.DebugService.MetricsDump')\n"   # ok
+        "TRACER.start_span('coalesce.wait')\n"                  # ok
+        "TRACER.start_span('Bad Span')\n"                       # bad
+        "name = 'x'\n"
+        "TRACER.start_span(f'rpc.{name}')\n"                    # ok prefix
+        "TRACER.start_span(f'RPC.{name}')\n"                    # bad prefix
+    )
+    problems = checker.check_file(str(f))
+    assert [p[0] for p in problems] == [4, 7], problems
+
+
+def test_checker_enforces_curated_families(tmp_path):
+    f = tmp_path / "fam.py"
+    f.write_text(
+        "from dingo_tpu.common.metrics import METRICS\n"
+        "METRICS.counter('xla.recompiles').add(1)\n"           # declared
+        "METRICS.gauge('hbm.region.peak_bytes').set(1)\n"      # declared
+        "METRICS.counter('xla.surprise_series').add(1)\n"      # undeclared
+        "METRICS.gauge('hbm.rogue').set(1)\n"                  # undeclared
+        "METRICS.counter('store.anything_goes').add(1)\n"      # uncurated
+    )
+    problems = checker.check_file(str(f))
+    assert [p[0] for p in problems] == [4, 5], problems
+    assert "FAMILY_NAMES" in problems[0][1]
+
+
 def test_registry_name_rule_matches_lint():
     from dingo_tpu.common.metrics import valid_metric_name
 

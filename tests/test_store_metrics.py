@@ -96,7 +96,11 @@ def test_prometheus_rendering_parses_back():
 
 
 _LINE_RE = re.compile(
-    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})? (-?[0-9.eE+\-]+|NaN)$"
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})? (-?[0-9.eE+\-]+|NaN)"
+    # optional OpenMetrics exemplar suffix (trace-id attachments on
+    # latency outliers — dingo_tpu/obs; an OpenMetrics-aware scraper
+    # links the p99 series to its flight-recorder bundle)
+    r"(?: # \{[^{}]*\} -?[0-9.eE+\-]+(?: -?[0-9.eE+\-]+)?)?$"
 )
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 

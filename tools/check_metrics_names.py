@@ -8,6 +8,17 @@ rename. Dynamic names (f-strings like `span.{name}`) can't be checked
 statically — their static prefix is validated and the runtime mangler
 keeps the rest legal — but every literal registration must pass here.
 
+Also linted:
+- span names (`TRACER.start_span("...")` literals): every span name feeds
+  a `span.<name>` latency series through the tracer bridge, so it must
+  survive the same mangling. Span segments may be CamelCase (service/
+  method names: `rpc.DebugService.MetricsDump`), but the name must start
+  lowercase and stay inside the identifier-plus-dots alphabet.
+- curated metric families: literal registrations under the `xla.` /
+  `hbm.` / `flight.` prefixes (the device-runtime observability plane)
+  must name a series declared in FAMILY_NAMES below — dashboards key on
+  these exact names, so additions are explicit, not incidental.
+
 Wired as a tier-1 test (tests/test_metrics_names.py) so a bad name fails
 CI, not the scrape.
 """
@@ -25,6 +36,8 @@ SRC_DIRS = ("dingo_tpu",)
 
 #: the registration methods on MetricsRegistry
 _METHODS = {"counter", "gauge", "latency"}
+#: span-minting methods on Tracer (names bridge to `span.<name>` series)
+_SPAN_METHODS = {"start_span"}
 
 #: full-name rule (common/metrics.py METRIC_NAME_RE)
 NAME_RE = re.compile(r"^[a-z][a-z0-9_.]*$")
@@ -32,6 +45,36 @@ NAME_RE = re.compile(r"^[a-z][a-z0-9_.]*$")
 #: must not end an identifier segment mid-word ambiguity — a trailing
 #: '.'/'_' separator or a clean segment both pass
 PREFIX_RE = re.compile(r"^[a-z][a-z0-9_.]*$")
+#: span names may carry CamelCase segments (gRPC service/method names)
+#: but start lowercase and stay mangle-safe
+SPAN_NAME_RE = re.compile(r"^[a-z][a-zA-Z0-9_.]*$")
+
+#: curated families: every literal registration under these prefixes must
+#: be one of the declared series (labels ride separately). Extend the set
+#: when adding a series — that's the point.
+FAMILY_NAMES = {
+    "xla": {
+        "xla.recompiles",           # jit-cache misses, process total
+        "xla.recompiles_by_kernel",  # breakdown (kernel label)
+        "xla.cache_hits",           # per-kernel jit-cache hits
+        "xla.compile_ms",           # last compile wall-time per kernel
+        "xla.compile_ms_total",     # cumulative compile stall
+    },
+    "hbm": {
+        "hbm.bytes_in_use",         # process allocator gauges
+        "hbm.bytes_limit",
+        "hbm.peak_bytes",
+        "hbm.region.bytes",         # per-(region, owner) ledger
+        "hbm.region.peak_bytes",
+        "hbm.region.total_bytes",   # region totals (distinct names so
+        "hbm.region.total_peak_bytes",  # sum() can't double-count)
+        "hbm.alloc_failures",
+    },
+    "flight": {
+        "flight.bundles",        # captured bundles by reason
+        "flight.suppressed",     # rate-limited triggers by reason
+    },
+}
 
 
 def _name_arg(call: ast.Call):
@@ -55,32 +98,63 @@ def check_file(path: str) -> List[Tuple[int, str]]:
         if not isinstance(node, ast.Call):
             continue
         func = node.func
-        if not (isinstance(func, ast.Attribute) and func.attr in _METHODS):
+        if not isinstance(func, ast.Attribute):
             continue
-        # only registry-shaped receivers: METRICS.counter(...), m.gauge(...),
-        # registry.latency(...) — skip unrelated .counter() methods by
-        # requiring a string-ish name argument
-        arg = _name_arg(node)
-        if arg is None:
-            continue
-        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-            name = arg.value
-            if not NAME_RE.match(name):
-                problems.append((
-                    node.lineno,
-                    f"metric name {name!r} is not a lowercase dotted "
-                    "identifier",
-                ))
-        elif isinstance(arg, ast.JoinedStr):
-            # f-string: validate the leading literal fragment
-            if arg.values and isinstance(arg.values[0], ast.Constant):
-                prefix = str(arg.values[0].value)
-                if prefix and not PREFIX_RE.match(prefix.rstrip("._")):
+        if func.attr in _METHODS:
+            # only registry-shaped receivers: METRICS.counter(...),
+            # m.gauge(...), registry.latency(...) — skip unrelated
+            # .counter() methods by requiring a string-ish name argument
+            arg = _name_arg(node)
+            if arg is None:
+                continue
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                name = arg.value
+                if not NAME_RE.match(name):
                     problems.append((
                         node.lineno,
-                        f"dynamic metric name prefix {prefix!r} is not a "
-                        "lowercase dotted identifier",
+                        f"metric name {name!r} is not a lowercase dotted "
+                        "identifier",
                     ))
+                else:
+                    family = name.split(".", 1)[0]
+                    known = FAMILY_NAMES.get(family)
+                    if known is not None and name not in known:
+                        problems.append((
+                            node.lineno,
+                            f"metric {name!r} is not a declared member of "
+                            f"the {family}.* family (extend FAMILY_NAMES "
+                            "in tools/check_metrics_names.py)",
+                        ))
+            elif isinstance(arg, ast.JoinedStr):
+                # f-string: validate the leading literal fragment
+                if arg.values and isinstance(arg.values[0], ast.Constant):
+                    prefix = str(arg.values[0].value)
+                    if prefix and not PREFIX_RE.match(prefix.rstrip("._")):
+                        problems.append((
+                            node.lineno,
+                            f"dynamic metric name prefix {prefix!r} is not "
+                            "a lowercase dotted identifier",
+                        ))
+        elif func.attr in _SPAN_METHODS:
+            arg = _name_arg(node)
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if not SPAN_NAME_RE.match(arg.value):
+                    problems.append((
+                        node.lineno,
+                        f"span name {arg.value!r} must start lowercase and "
+                        "use only [a-zA-Z0-9_.] (it feeds the span.<name> "
+                        "metric series)",
+                    ))
+            elif isinstance(arg, ast.JoinedStr):
+                if arg.values and isinstance(arg.values[0], ast.Constant):
+                    prefix = str(arg.values[0].value)
+                    if prefix and not SPAN_NAME_RE.match(
+                            prefix.rstrip("._")):
+                        problems.append((
+                            node.lineno,
+                            f"dynamic span name prefix {prefix!r} must "
+                            "start lowercase and use only [a-zA-Z0-9_.]",
+                        ))
     return problems
 
 

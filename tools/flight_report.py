@@ -1,0 +1,201 @@
+"""Render a flight-recorder bundle as a human-readable incident report.
+
+Input: the DebugService ``FlightDump`` payload — a zlib-compressed JSON
+bundle (write ``resp.payload`` to a file) — or the same JSON uncompressed.
+
+    python tools/flight_report.py BUNDLE_FILE [--json]
+
+Sections: trigger header, the offending trace's spans (start-ordered,
+parent-indented), metric deltas over the recorder window, the recompile
+sentinel's kernel cache state, and the HBM ledger. ``--json`` dumps the
+decoded bundle instead (for jq).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import zlib
+from typing import Any, Dict, List
+
+
+def parse_bundle(path: str) -> Dict[str, Any]:
+    """Load a bundle from a file holding either the raw zlib payload or
+    its decompressed JSON text."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    try:
+        raw = zlib.decompress(raw)
+    except zlib.error:
+        pass            # already-decompressed JSON
+    return json.loads(raw.decode("utf-8"))
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> List[str]:
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    out = ["  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+           "  ".join("-" * w for w in widths)]
+    for r in rows:
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return out
+
+
+def _span_rows(spans: List[Dict[str, Any]]) -> List[List[str]]:
+    spans = sorted(spans, key=lambda s: s.get("start_us", 0))
+    depth: Dict[str, int] = {}
+    rows = []
+    t0 = spans[0].get("start_us", 0) if spans else 0
+    for s in spans:
+        d = depth.get(s.get("parent_id") or "", -1) + 1
+        if s.get("span_id"):
+            depth[s["span_id"]] = d
+        attrs = s.get("attrs") or {}
+        rows.append([
+            "  " * d + s.get("name", "?"),
+            f"+{(s.get('start_us', 0) - t0) / 1000.0:.1f}",
+            f"{s.get('dur_us', 0) / 1000.0:.2f}",
+            s.get("status", ""),
+            ",".join(f"{k}={v}" for k, v in sorted(attrs.items()))[:60],
+        ])
+    return rows
+
+
+def render(bundle: Dict[str, Any]) -> str:
+    out: List[str] = []
+    created = bundle.get("created_ms", 0) / 1000.0
+    out.append("=" * 72)
+    out.append(f"FLIGHT BUNDLE {bundle.get('id', '?')}")
+    out.append(
+        f"reason={bundle.get('reason', '?')}  name={bundle.get('name', '')}"
+        f"  region={bundle.get('region_id', 0)}"
+    )
+    out.append(
+        f"trace={bundle.get('trace_id') or '(unsampled)'}  "
+        f"at={time.strftime('%F %T', time.localtime(created))}"
+    )
+    for k, v in sorted((bundle.get("trigger") or {}).items()):
+        out.append(f"  {k}: {v}")
+    out.append("=" * 72)
+
+    spans = bundle.get("spans") or []
+    out.append("")
+    note = ""
+    if bundle.get("spans_fallback"):
+        note = ("[trace spans unavailable: recent ring tail]"
+                if bundle.get("trace_id")
+                else "[no trace id: recent ring tail]")
+    out.append(f"-- spans ({len(spans)}) {note}".rstrip())
+    if spans:
+        out.extend(_table(
+            ["SPAN", "START_MS", "DUR_MS", "STATUS", "ATTRS"],
+            _span_rows(spans),
+        ))
+    else:
+        out.append("  (none captured)")
+
+    metrics = bundle.get("metrics") or {}
+    deltas = metrics.get("deltas") or {}
+    out.append("")
+    out.append(
+        f"-- metric deltas over the last {metrics.get('window_s', 0)}s "
+        f"({len(deltas)} changed)"
+    )
+    if deltas:
+        rows = [[k, f"{v:+g}"] for k, v in sorted(deltas.items())]
+        out.extend(_table(["SERIES", "DELTA"], rows[:80]))
+        if len(rows) > 80:
+            out.append(f"  ... {len(rows) - 80} more")
+    elif metrics.get("note"):
+        out.append(f"  ({metrics['note']})")
+
+    kernels = bundle.get("kernel_cache") or {}
+    out.append("")
+    out.append(f"-- kernel cache state ({len(kernels)} kernels)")
+    if kernels:
+        rows = []
+        for name, st in sorted(kernels.items()):
+            rows.append([
+                name,
+                str(st.get("calls", 0)),
+                str(st.get("traces", 0)),
+                str(st.get("cache_hits", 0)),
+                f"{st.get('last_compile_ms', 0):.0f}",
+                str(st.get("last_trace_age_s", "-")),
+                str(len(st.get("signatures") or {})),
+            ])
+        out.extend(_table(
+            ["KERNEL", "CALLS", "TRACES", "HITS", "LAST_MS", "AGE_S",
+             "SIGS"],
+            rows,
+        ))
+
+    hbm = bundle.get("hbm") or {}
+    regions = hbm.get("regions") or {}
+    out.append("")
+    out.append(
+        f"-- hbm ledger (process peak "
+        f"{_fmt_bytes(hbm.get('process_peak_bytes', 0))}, "
+        f"alloc failures {hbm.get('alloc_failures', 0)})"
+    )
+    rows = []
+    for rid, st in sorted(regions.items(), key=lambda kv: str(kv[0])):
+        owners = st.get("bytes") or {}
+        peaks = st.get("peak_bytes") or {}
+        for owner in sorted(set(owners) | set(peaks)):
+            rows.append([
+                str(rid), owner,
+                _fmt_bytes(owners.get(owner, 0)),
+                _fmt_bytes(peaks.get(owner, 0)),
+            ])
+        rows.append([
+            str(rid), "TOTAL",
+            _fmt_bytes(sum(owners.values())),
+            _fmt_bytes(st.get("total_peak_bytes", 0)),
+        ])
+    if rows:
+        out.extend(_table(["REGION", "OWNER", "BYTES", "PEAK"], rows))
+
+    slow = bundle.get("slow_queries") or []
+    if slow:
+        out.append("")
+        out.append(f"-- recent slow queries ({len(slow)})")
+        out.extend(_table(
+            ["NAME", "DUR_MS", "TRACE"],
+            [[s.get("name", "?"),
+              f"{s.get('dur_us', 0) / 1000.0:.1f}",
+              s.get("trace_id") or "(unsampled)"] for s in slow],
+        ))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("bundle", help="FlightDump payload file (zlib or JSON)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the decoded bundle JSON instead of a report")
+    args = ap.parse_args(argv)
+    bundle = parse_bundle(args.bundle)
+    if args.json:
+        json.dump(bundle, sys.stdout, indent=1, sort_keys=True)
+        print()
+    else:
+        print(render(bundle))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

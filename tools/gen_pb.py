@@ -54,6 +54,8 @@ NEW_MESSAGES = {
         ("is_leader", 13, T.TYPE_BOOL, None, False),
         ("search_qps", 14, T.TYPE_DOUBLE, None, False),
         ("document_count", 15, T.TYPE_INT64, None, False),
+        # HBM high-watermark for the region total (obs hbm ledger, PR 5)
+        ("device_peak_bytes", 16, T.TYPE_INT64, None, False),
     ],
     # whole-store snapshot (process device gauges + per-region list)
     "StoreMetrics": [
@@ -93,6 +95,28 @@ NEW_MESSAGES = {
         ("info", 1, T.TYPE_MESSAGE, ".dingo_tpu.ResponseInfo", False),
         ("error", 2, T.TYPE_MESSAGE, ".dingo_tpu.Error", False),
         ("regions", 3, T.TYPE_MESSAGE, ".dingo_tpu.RegionMetricsEntry", True),
+    ],
+    # flight-recorder bundle export (device-runtime observability, PR 5)
+    "FlightBundleMeta": [
+        ("id", 1, T.TYPE_STRING, None, False),
+        ("reason", 2, T.TYPE_STRING, None, False),
+        ("name", 3, T.TYPE_STRING, None, False),
+        ("trace_id", 4, T.TYPE_STRING, None, False),
+        ("region_id", 5, T.TYPE_INT64, None, False),
+        ("created_ms", 6, T.TYPE_INT64, None, False),
+        ("payload_bytes", 7, T.TYPE_INT64, None, False),
+    ],
+    "FlightDumpRequest": [
+        ("info", 1, T.TYPE_MESSAGE, ".dingo_tpu.RequestInfo", False),
+        ("bundle_id", 2, T.TYPE_STRING, None, False),  # "" = newest
+        ("include_payload", 3, T.TYPE_BOOL, None, False),
+    ],
+    "FlightDumpResponse": [
+        ("info", 1, T.TYPE_MESSAGE, ".dingo_tpu.ResponseInfo", False),
+        ("error", 2, T.TYPE_MESSAGE, ".dingo_tpu.Error", False),
+        ("bundles", 3, T.TYPE_MESSAGE, ".dingo_tpu.FlightBundleMeta", True),
+        ("payload", 4, T.TYPE_BYTES, None, False),  # zlib(JSON) bundle
+        ("payload_bundle_id", 5, T.TYPE_STRING, None, False),
     ],
 }
 

@@ -24,17 +24,20 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 from tpu_watcher import ROUND_DEADLINE_S as DEADLINE_S  # noqa: E402 — one
 # constant governs both processes (deadline drift caused a respawn/state-
 # reset loop in review)
+from tpu_watcher import (  # noqa: E402 — shared runtime dir + state path
+    RUNTIME_DIR,
+    STATE_PATH,
+    append_log,
+)
 
-LOG_PATH = os.path.join(REPO, "tools", "tpu_supervisor.log")
-PID_PATH = os.path.join(REPO, "tools", "tpu_supervisor.pid")
-STATE_PATH = os.path.join(REPO, "TPU_WATCHER_STATE.json")
+LOG_PATH = os.path.join(RUNTIME_DIR, "tpu_supervisor.log")
+PID_PATH = os.path.join(RUNTIME_DIR, "tpu_supervisor.pid")
 RESPAWN_BACKOFF_S = 20
 QUEUE_STEPS = {"smoke", "bench_row2", "row1_flat", "row4_hnsw", "row3_ivfpq"}
 
 
 def log(msg: str) -> None:
-    with open(LOG_PATH, "a") as f:
-        f.write(f"[{time.strftime('%H:%M:%S')}] {msg}\n")
+    append_log(LOG_PATH, f"[{time.strftime('%H:%M:%S')}] {msg}")
 
 
 def queue_complete() -> bool:

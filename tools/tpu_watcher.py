@@ -32,13 +32,38 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-STATE_PATH = os.path.join(REPO, "TPU_WATCHER_STATE.json")
-LOG_PATH = os.path.join(REPO, "tools", "tpu_watcher.log")
-PID_PATH = os.path.join(REPO, "tools", "tpu_watcher.pid")
+
+#: watcher/supervisor scratch (logs, state, pids) lives OUTSIDE the repo
+#: tree — earlier rounds committed accumulating tools/*.log artifacts.
+#: DINGO_RUNTIME_DIR overrides (e.g. a persistent volume).
+RUNTIME_DIR = os.environ.get("DINGO_RUNTIME_DIR") or os.path.join(
+    tempfile.gettempdir(), "dingo-tpu"
+)
+os.makedirs(RUNTIME_DIR, exist_ok=True)
+
+#: rotate a log once it exceeds this (keep one .1 generation): a round-long
+#: probe loop must not grow a file without bound
+LOG_ROTATE_BYTES = 1 << 20
+
+STATE_PATH = os.path.join(RUNTIME_DIR, "TPU_WATCHER_STATE.json")
+LOG_PATH = os.path.join(RUNTIME_DIR, "tpu_watcher.log")
+PID_PATH = os.path.join(RUNTIME_DIR, "tpu_watcher.pid")
+
+
+def append_log(path: str, line: str) -> None:
+    """Size-capped append shared by watcher and supervisor."""
+    try:
+        if os.path.getsize(path) > LOG_ROTATE_BYTES:
+            os.replace(path, path + ".1")
+    except OSError:
+        pass
+    with open(path, "a") as f:
+        f.write(line + "\n")
 
 PROBE_TIMEOUT_S = 120
 PROBE_INTERVAL_S = 240
@@ -59,9 +84,7 @@ QUEUE = [
 
 
 def log(msg: str) -> None:
-    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
-    with open(LOG_PATH, "a") as f:
-        f.write(line + "\n")
+    append_log(LOG_PATH, f"[{time.strftime('%H:%M:%S')}] {msg}")
 
 
 def load_state() -> dict:
