@@ -229,6 +229,98 @@ def precision_sweep_and_hybrid(platform):
     return sweep, hybrid
 
 
+def pruning_sweep(platform):
+    """ISSUE 6: QPS / recall@10 / mean scanned-dim fraction for the
+    dimension-blocked early-pruning scan, ON vs OFF, per precision tier
+    on one IVF_FLAT config. The spec point is 200k x 768 (matrix row 2's
+    shape at bench budget) on TPU; the CPU smoke runs the same scenario
+    at a reduced, labeled scale (the pruned kernel runs under interpret
+    there, so QPS-on is a correctness/pruning-rate signal, not a speed
+    claim — scanned_dim_fraction and the recall gates are the payload)."""
+    import time as _time
+
+    from dingo_tpu.common.config import FLAGS
+    from dingo_tpu.common.metrics import METRICS
+    from dingo_tpu.index import IndexParameter, IndexType, new_index
+
+    big = platform == "tpu"
+    n = int(os.environ.get("DINGO_BENCH_PRUNE_N",
+                           200_000 if big else 12_000))
+    d = int(os.environ.get("DINGO_BENCH_PRUNE_D", 768 if big else 256))
+    nlist = int(os.environ.get("DINGO_BENCH_PRUNE_NLIST",
+                               256 if big else 64))
+    dblk = int(os.environ.get("DINGO_BENCH_PRUNE_DBLK",
+                              128 if big else 64))
+    nprobe, batch, k = 16, (64 if big else 16), 10
+    iters = 10 if big else 3
+    rng = np.random.default_rng(11)
+    ncl = max(64, n // 1000)
+    centers = rng.standard_normal((ncl, d), dtype=np.float32)
+    x = centers[rng.integers(0, ncl, n)] + 0.35 * rng.standard_normal(
+        (n, d)
+    ).astype(np.float32)
+    ids = np.arange(n, dtype=np.int64)
+    queries = x[rng.choice(n, batch, replace=False)] + 0.05 * (
+        rng.standard_normal((batch, d)).astype(np.float32)
+    )
+    qs = queries[:8]
+    dmat = (
+        (qs ** 2).sum(1)[:, None] - 2.0 * qs @ x.T + (x ** 2).sum(1)[None, :]
+    )
+    gt = ids[np.argsort(dmat, axis=1)[:, :k]]
+
+    def recall_of(res):
+        return float(np.mean(
+            [len(set(r.ids) & set(g)) / k for r, g in zip(res, gt)]
+        ))
+
+    old_dblk = FLAGS.get("ivf_dim_block")
+    FLAGS.set("ivf_dim_block", dblk)
+    out = {"config": f"pruning_sweep_ivf_flat_{n//1000}k_x{d}"
+                     f"_nlist{nlist}_dblk{dblk}"}
+    try:
+        for tier in ("fp32", "bf16", "sq8"):
+            idx = new_index(200 + ("fp32", "bf16", "sq8").index(tier),
+                            IndexParameter(
+                                index_type=IndexType.IVF_FLAT, dimension=d,
+                                ncentroids=nlist, default_nprobe=nprobe,
+                                precision=tier,
+                            ))
+            idx.store.reserve(n)
+            idx.upsert(ids, x)
+            idx.train()
+            row = {}
+            for mode in ("off", "on"):
+                FLAGS.set("use_pallas_ivf_search", mode == "on")
+                idx._invalidate_view()   # rebuild picks up prune metadata
+                idx.warmup(batches=(batch,), topk=k, nprobe=nprobe)
+                rec = recall_of(idx.search(qs, k, nprobe=nprobe))
+                t0 = _time.perf_counter()
+                thunks = [idx.search_async(queries, k, nprobe=nprobe)
+                          for _ in range(iters)]
+                for t in thunks:
+                    t()
+                dt = (_time.perf_counter() - t0) / iters
+                row[f"qps_prune_{mode}"] = round(batch / dt, 1)
+                row[f"recall_at_10_{mode}"] = round(rec, 4)
+            FLAGS.set("use_pallas_ivf_search", False)
+            frac = METRICS.gauge(
+                "ivf.pruned_dim_fraction",
+                region_id=200 + ("fp32", "bf16", "sq8").index(tier),
+            ).get()
+            # the acceptance signal: mean fraction of (candidate, dim)
+            # work the pruned scan actually performed (< 1.0 = engaged)
+            row["scanned_dim_fraction"] = round(1.0 - float(frac), 4)
+            out[tier] = row
+            log(f"pruning {tier}: scanned-dim {row['scanned_dim_fraction']}"
+                f" qps on/off {row['qps_prune_on']}/{row['qps_prune_off']}"
+                f" recall {row['recall_at_10_on']}/{row['recall_at_10_off']}")
+    finally:
+        FLAGS.set("use_pallas_ivf_search", "auto")
+        FLAGS.set("ivf_dim_block", old_dblk)
+    return out
+
+
 def main():
     # With a cached TPU result on hand a short probe suffices; without one,
     # keep the generous window — a live run is strictly better than a cache.
@@ -427,6 +519,9 @@ def main():
 
     sweep, hybrid = precision_sweep_and_hybrid(platform)
 
+    # --- pruning sweep: blocked-scan early pruning on vs off (ISSUE 6) ---
+    prune = pruning_sweep(platform)
+
     # --- CPU baseline: numpy/OpenBLAS IVF-flat with same layout ---
     centroids = np.asarray(idx.centroids)
     assign = idx._assign_h[np.asarray(idx.store.slots_of(ids))]
@@ -507,6 +602,10 @@ def main():
         # benchmark-matrix row 5 (hybrid scalar-filtered IVF), first fill
         # — reduced scale, labeled in the config string
         "hybrid_row5": hybrid,
+        # blocked-scan early pruning (ISSUE 6): QPS/recall with the
+        # pruned kernel on vs off + mean scanned-dim fraction per tier
+        # (< 1.0 = the partial-distance bound demonstrably drops work)
+        "pruning_sweep": prune,
     }
     if platform == "tpu":
         result["measured_at"] = time.time()

@@ -76,9 +76,14 @@ FLAGS.define("raft_snapshot_threshold", 10000, mutable=True)
 FLAGS.define("region_max_size_bytes", 256 * 1024 * 1024, mutable=True)
 FLAGS.define("split_check_approximate_keys", 1_000_000, mutable=True)
 FLAGS.define("gc_retention_ms", 3_600_000, mutable=True)
-FLAGS.define("use_pallas_fused_search", False, mutable=True,
+FLAGS.define("use_pallas_fused_search", "auto", mutable=True,
              help_="route flat L2/IP searches through the fused Pallas "
-                   "streaming kernel (no [b,n] HBM materialization)")
+                   "streaming kernel (no [b,n] HBM materialization). "
+                   "'auto' (default) enables it on TPU once the store is "
+                   "large enough to amortize the streaming grid "
+                   "(capacity >= 2048 — below that one XLA matmul wins). "
+                   "True/False force; same tri-state crossover discipline "
+                   "as use_pallas_ivf_search")
 FLAGS.define("ivfpq_rerank_factor", 8, mutable=True,
              help_="host-vectors IVF_PQ reranks topk*factor ADC candidates "
                    "exactly from host rows (1 disables); same prune+rerank "
@@ -207,6 +212,31 @@ FLAGS.define("use_pallas_ivf_search", "auto", mutable=True,
                    "kernel is 4.9x the XLA path (33 vs 163 ms/batch), but "
                    "at 100Kx128/nlist=64 it LOSES 1.3x (18 vs 14) — thin "
                    "rows starve the per-bucket DMA. True/False force.")
+FLAGS.define("ivf_dim_block", 128, mutable=True,
+             help_="dimension-block width of the PDX-style vertical scan "
+                   "layout (per-block partial distances let the pruning "
+                   "kernels stop scanning candidates that cannot beat the "
+                   "running k-th best). 128 = one TPU lane tile; an index "
+                   "only builds blocked metadata when its (padded) "
+                   "dimension is a multiple with >= 2 blocks")
+FLAGS.define("ivf_prune_check_interval", 1, mutable=True,
+             help_="pruned-scan kernels re-evaluate the partial-distance "
+                   "bound every N dimension blocks (1 = every block). "
+                   "Larger values trade pruning opportunity for less VPU "
+                   "compare/mask overhead per block")
+FLAGS.define("ivf_prune_scan", "auto", mutable=True,
+             help_="use the early-pruning dimension-blocked scan kernels "
+                   "wherever the Pallas path is active and the index has "
+                   "blocked metadata. 'auto' (default) = on (the kernels "
+                   "fall back to the plain fused scan when the dimension "
+                   "doesn't block); False forces the non-pruning kernels")
+FLAGS.define("vector_blocked_layout", "auto", mutable=True,
+             help_="maintain a dimension-blocked ([n_blocks, capacity, "
+                   "block_d]) scan mirror + per-block norms in float/sq8 "
+                   "SlotStores so FLAT searches can run the pruned "
+                   "streaming kernel. 'auto' = on-TPU only (the mirror "
+                   "costs one extra copy of the rows in HBM; on CPU "
+                   "nothing reads it unless forced). True/False force")
 
 
 def bf16_compute_native() -> bool:
@@ -222,21 +252,60 @@ def bf16_compute_native() -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
-def pallas_ivf_enabled(dimension: int) -> bool:
-    """Resolve the tri-state use_pallas_ivf_search flag for an index.
-    FLAGS.set coerces to the default's type (str), so boolean sets arrive
-    as 'True'/'False' strings — parse, don't truth-test."""
-    flag = FLAGS.get("use_pallas_ivf_search")
+def _parse_tri(flag) -> Optional[bool]:
+    """Parse a tri-state backend-crossover flag: None = 'auto' (caller
+    applies its measured crossover), True/False force. FLAGS.set coerces
+    to the default's type (str), so boolean sets arrive as 'True'/'False'
+    strings — parse, don't truth-test."""
     if isinstance(flag, str):
         low = flag.strip().lower()
         if low == "auto":
-            import jax
-
-            return (
-                jax.default_backend() in ("tpu", "axon") and dimension >= 256
-            )
+            return None
         return low in ("true", "1", "on", "yes")
     return bool(flag)
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def pallas_ivf_enabled(dimension: int) -> bool:
+    """Resolve the tri-state use_pallas_ivf_search flag for an index."""
+    v = _parse_tri(FLAGS.get("use_pallas_ivf_search"))
+    if v is None:
+        return _on_tpu() and dimension >= 256
+    return v
+
+
+def pallas_fused_enabled(capacity: int) -> bool:
+    """Tri-state use_pallas_fused_search crossover for FLAT searches:
+    'auto' routes to the streaming kernel on TPU once the store is big
+    enough (capacity >= 2048) that avoiding the [b, capacity] HBM score
+    matrix beats one fused XLA matmul+top_k; True/False force."""
+    v = _parse_tri(FLAGS.get("use_pallas_fused_search"))
+    if v is None:
+        return _on_tpu() and capacity >= 2048
+    return v
+
+
+def prune_scan_enabled() -> bool:
+    """Tri-state ivf_prune_scan: 'auto' = on (the pruned kernels are only
+    reachable where the Pallas crossover already fired AND the index has
+    blocked metadata, so there is no separate hardware condition)."""
+    v = _parse_tri(FLAGS.get("ivf_prune_scan"))
+    return True if v is None else v
+
+
+def blocked_layout_enabled() -> bool:
+    """Tri-state vector_blocked_layout: 'auto' keeps the blocked FLAT scan
+    mirror TPU-only (it duplicates the rows in device memory; the CPU arm
+    never routes to the kernel that reads it unless forced)."""
+    v = _parse_tri(FLAGS.get("vector_blocked_layout"))
+    if v is None:
+        return _on_tpu()
+    return v
 
 
 class Config:

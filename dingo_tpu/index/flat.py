@@ -167,6 +167,23 @@ class _SlotStoreIndex(VectorIndex):
             labels={"precision": self._precision},
         ).add(1)
 
+    def _note_prune_stats(self, stats_h) -> None:
+        """Fold a pruned-scan stats block ([b, 4] host array: scanned
+        pairs, total pairs, full scans, candidates — see
+        ops/pallas_ivf._ivf_pruned_kernel) into the metrics plane. Called
+        from resolve() so the hot path never synchronizes for it."""
+        from dingo_tpu.common.metrics import METRICS
+
+        sums = np.asarray(stats_h, np.float64).sum(axis=0)
+        scanned, total, full, cand = (float(x) for x in sums[:4])
+        if total > 0:
+            METRICS.gauge(
+                "ivf.pruned_dim_fraction", region_id=self.id
+            ).set(max(0.0, 1.0 - scanned / total))
+        METRICS.counter("ivf.pruned_candidates", region_id=self.id).add(
+            int(max(0.0, cand - full))
+        )
+
     # subclasses set these
     def _prep_vectors(self, vectors: np.ndarray) -> np.ndarray:
         raise NotImplementedError
@@ -243,7 +260,7 @@ class _SlotStoreIndex(VectorIndex):
                         filter_spec.slot_mask(store.ids_by_slot)
                     )
                 kprime = self._rerank_shortlist(int(topk))
-                dists, slots = self._run_search_kernel(
+                dists, slots, stats = self._run_search_kernel(
                     qpad, mask, kprime or int(topk)
                 )
                 if kprime is not None:
@@ -266,6 +283,8 @@ class _SlotStoreIndex(VectorIndex):
         # serializing at resolve time.
         dists.copy_to_host_async()
         slots.copy_to_host_async()
+        if stats is not None:
+            stats.copy_to_host_async()
         # trace hook OUTSIDE the device lock: a sampled request blocks for
         # a true kernel-time span without stalling concurrent searches
         from dingo_tpu.ops.distance import device_wait_span
@@ -274,6 +293,8 @@ class _SlotStoreIndex(VectorIndex):
         def resolve() -> List[SearchResult]:
             try:
                 dists_h, slots_h = jax.device_get((dists, slots))
+                if stats is not None:
+                    self._note_prune_stats(jax.device_get(stats)[:b])
                 ids = store.ids_of_slots(slots_h[:b])
                 dists_h = self._convert_distances(dists_h)
                 return [strip_invalid(i, d) for i, d in zip(ids, dists_h[:b])]
@@ -288,58 +309,98 @@ class _SlotStoreIndex(VectorIndex):
         return dists
 
     def _run_search_kernel(self, qpad, mask, k):
-        """XLA flat-scan kernel, or the fused Pallas streaming kernel when
-        FLAGS.use_pallas_fused_search is on (L2/IP only — the fused kernel
-        avoids materializing the [b, capacity] score matrix in HBM)."""
-        from dingo_tpu.common.config import FLAGS
+        """Kernel crossover for the whole-store scan; returns (dists,
+        slots, prune_stats_or_None). Three arms per tier:
+
+          * pruned Pallas streaming kernel — fused crossover fired AND the
+            store maintains the dimension-blocked mirror (vecs_blk):
+            partial distances per dim block, early candidate pruning, no
+            [b, capacity] HBM score matrix;
+          * plain fused Pallas kernel — crossover fired, no blocked mirror;
+          * XLA scan + masked top-k otherwise.
+        """
+        from dingo_tpu.common.config import pallas_fused_enabled
         from dingo_tpu.ops.distance import metric_ascending
 
+        store = self.store
+        fused_on = (
+            pallas_fused_enabled(store.capacity)
+            and self._kernel_metric in (Metric.L2, Metric.INNER_PRODUCT)
+        )
+        pruned_on = fused_on and store.vecs_blk is not None
+        if pruned_on:
+            from dingo_tpu.common.config import prune_scan_enabled
+
+            pruned_on = prune_scan_enabled()
         if self._precision == "sq8":
-            if self.store.sq_params is None:
+            if store.sq_params is None:
                 # empty untrained store: nothing valid to scan; identity
                 # codec keeps the kernel well-defined WITHOUT installing
                 # params (the first real write must still train them)
                 vmin = jnp.zeros((self.dimension,), jnp.float32)
                 scale = jnp.ones((self.dimension,), jnp.float32)
+            elif pruned_on:
+                from dingo_tpu.ops.pallas_topk import pruned_fused_search
+
+                vals, slots, stats = pruned_fused_search(
+                    qpad, store.vecs_blk, store.bsq_blk, store.sqnorm,
+                    mask, k,
+                    ascending=metric_ascending(self._kernel_metric),
+                    sq_vmin=store.sq_vmin_d, sq_scale=store.sq_scale_d,
+                )
+                return (
+                    scores_to_distances(vals, self._kernel_metric),
+                    slots, stats,
+                )
             else:
-                vmin = self.store.sq_vmin_d
-                scale = self.store.sq_scale_d
-            return _sq_flat_search_kernel(
-                self.store.vecs,
+                vmin = store.sq_vmin_d
+                scale = store.sq_scale_d
+            dists, slots = _sq_flat_search_kernel(
+                store.vecs,
                 vmin,
                 scale,
-                self.store.sqnorm,
+                store.sqnorm,
                 mask,
                 qpad,
                 k=k,
                 metric=self._kernel_metric,
             )
-        use_fused = (
-            FLAGS.get("use_pallas_fused_search")
-            and self._kernel_metric in (Metric.L2, Metric.INNER_PRODUCT)
-            and self.store.capacity >= 2048
-            # float stores only (f32/bf16 — the kernel promotes in VMEM):
-            # TpuBinaryFlat reaches here with an int8 ±1 store and mixed
-            # int dot under Mosaic is unvalidated; keep it on XLA.
-            and self.store.vecs.dtype in (jnp.float32, jnp.bfloat16)
-        )
-        if use_fused:
+            return dists, slots, None
+        # float stores only (f32/bf16 — the kernels promote in VMEM):
+        # TpuBinaryFlat reaches here with an int8 ±1 store and mixed
+        # int dot under Mosaic is unvalidated; keep it on XLA.
+        if fused_on and store.vecs.dtype in (jnp.float32, jnp.bfloat16):
+            if pruned_on:
+                from dingo_tpu.ops.pallas_topk import pruned_fused_search
+
+                vals, slots, stats = pruned_fused_search(
+                    qpad, store.vecs_blk, store.bsq_blk, store.sqnorm,
+                    mask, k,
+                    ascending=metric_ascending(self._kernel_metric),
+                )
+                return (
+                    scores_to_distances(vals, self._kernel_metric),
+                    slots, stats,
+                )
             from dingo_tpu.ops.pallas_topk import fused_search
 
             vals, slots = fused_search(
-                qpad, self.store.vecs, self.store.sqnorm,
+                qpad, store.vecs, store.sqnorm,
                 mask, k, ascending=metric_ascending(self._kernel_metric),
             )
-            return scores_to_distances(vals, self._kernel_metric), slots
-        return _flat_search_kernel(
-            self.store.vecs,
-            self.store.sqnorm,
+            return (
+                scores_to_distances(vals, self._kernel_metric), slots, None
+            )
+        dists, slots = _flat_search_kernel(
+            store.vecs,
+            store.sqnorm,
             mask,
             qpad,
             k=k,
             metric=self._kernel_metric,
             nbits=self._kernel_nbits,
         )
+        return dists, slots, None
 
     # -- lifecycle ---------------------------------------------------------
     def get_count(self) -> int:
@@ -356,6 +417,14 @@ class _SlotStoreIndex(VectorIndex):
             "apply_log_id": self.apply_log_id,
             "count": self.get_count(),
             "precision": self._precision,
+            # scan-layout metadata: informational (rows persist FLAT; the
+            # blocked mirror is a runtime arrangement rebuilt at load time
+            # from conf vector.blocked_layout), recorded so operators can
+            # tell which layout produced a snapshot's bench numbers
+            "blocked_layout": bool(
+                getattr(self.store, "vecs_blk", None) is not None
+            ),
+            "dim_block": int(getattr(self.store, "dim_block", 0) or 0),
         }
 
     def _check_meta(self, meta: dict) -> None:

@@ -494,6 +494,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         self._view: Optional[MutableIvfView] = None
         self._buckets = None          # [alloc, cap_list, d]
         self._bucket_sqnorm = None
+        self._bucket_bsq = None       # [alloc, nblk, cap_list] prune norms
         self._view_dirty = True
         self._filter_cache: dict = {}
 
@@ -599,6 +600,33 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         self._invalidate_view()
 
     # -- bucketed view (IvfViewMaintenance data hooks) -----------------------
+    def _prune_dim_block(self):
+        """Dimension-block width the pruned scan kernel would use for this
+        index, or None when pruning cannot apply (flag off, binary ±1
+        store, sq8+cosine — the XLA arm divides by the decoded norm, the
+        kernel doesn't — or a dimension that doesn't block)."""
+        from dingo_tpu.common.config import (
+            pallas_ivf_enabled,
+            prune_scan_enabled,
+        )
+        from dingo_tpu.ops.blocked import resolve_dim_block
+
+        # metadata is only worth building where the Pallas route will
+        # read it (a flag flip takes effect at the next view rebuild)
+        if not pallas_ivf_enabled(self.dimension):
+            return None
+        if not prune_scan_enabled():
+            return None
+        if self._scan_metric not in (
+            Metric.L2, Metric.INNER_PRODUCT, Metric.COSINE
+        ):
+            return None
+        if self.store.vecs.dtype == jnp.int8:
+            return None                       # binary ±1 family stays XLA
+        if self._precision == "sq8" and self.metric is Metric.COSINE:
+            return None
+        return resolve_dim_block(self.dimension)
+
     def _materialize_view_data(self, view: MutableIvfView) -> None:
         """Dense gather of the whole store into the bucket coordinates —
         the O(N) path, reached only via rebuild/compaction. Caller holds
@@ -610,6 +638,22 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
             # XLA CPU's scalar bf16 convert on every probe gather
             self._buckets = self._buckets.astype(jnp.float32)
         self._bucket_sqnorm = view.gather_rows(self.store.sqnorm)
+        # pruning metadata: per-dimension-block squared norms of what the
+        # scan kernel accumulates (decoded values for sq8 code buckets)
+        self._bucket_bsq = None
+        dblk = self._prune_dim_block()
+        if dblk:
+            from dingo_tpu.ops.blocked import bucket_block_sqnorms
+
+            data = self._buckets
+            if self._precision == "sq8":
+                from dingo_tpu.ops.sq import sq_decode_device
+
+                data = sq_decode_device(
+                    data, self.store.sq_vmin_d, self.store.sq_scale_d,
+                    jnp.float32,
+                )
+            self._bucket_bsq = bucket_block_sqnorms(data, dblk)
 
     def _bf16_widen_view(self) -> bool:
         from dingo_tpu.common.config import bf16_compute_native
@@ -626,6 +670,10 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
             self._bucket_sqnorm = pad_buckets(
                 self._bucket_sqnorm, upd.grew_alloc
             )
+            if self._bucket_bsq is not None:
+                self._bucket_bsq = pad_buckets(
+                    self._bucket_bsq, upd.grew_alloc
+                )
         if not upd.appended:
             return
         cap = self._view.cap_list
@@ -640,18 +688,35 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
             sel = self.store.encode(sel)
             deq = self.store.decode(sel)
             sq = (deq ** 2).sum(axis=1).astype(np.float32)
+            norm_rows = deq
         else:
-            sq = (sel.astype(np.float32) ** 2).sum(axis=1)
-            if self._bf16_widen_view():
-                # widened-view arm: quantize through bf16 first so the f32
-                # scan copy matches the store rows bit-for-bit
-                sel = sel.astype(jnp.bfloat16).astype(np.float32)
+            norm_rows = sel.astype(np.float32)
+            if self._precision == "bf16":
+                # norms describe the bf16-quantized rows the scan reads
+                # (same stored-row convention as slot_store._write_run)
+                norm_rows = sel.astype(jnp.bfloat16).astype(np.float32)
+                if self._bf16_widen_view():
+                    # widened-view arm: quantize through bf16 first so the
+                    # f32 scan copy matches the store rows bit-for-bit
+                    sel = norm_rows
+            sq = (norm_rows ** 2).sum(axis=1)
         self._buckets = scatter_bucket_update(
             self._buckets, b_idx, r_idx, sel
         )
         self._bucket_sqnorm = scatter_bucket_update(
             self._bucket_sqnorm, b_idx, r_idx, sq
         )
+        if self._bucket_bsq is not None:
+            from dingo_tpu.ops.blocked import block_sqnorms
+            from dingo_tpu.ops.scatter import scatter_bucket_dim_update
+
+            dblk = self.dimension // self._bucket_bsq.shape[1]
+            bsq_rows = np.asarray(
+                block_sqnorms(np.asarray(norm_rows, np.float32), dblk)
+            ).T                                            # [n, nblk]
+            self._bucket_bsq = scatter_bucket_dim_update(
+                self._bucket_bsq, b_idx, r_idx, bsq_rows
+            )
 
     # -- search -------------------------------------------------------------
     def search(
@@ -695,22 +760,46 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
             # captured reference between here and dispatch (same contract
             # as slot_store.put); reading self._view inside the same hold
             # keeps view metadata and self._buckets consistent
+            stats = None
             with self.store.device_lock:
                 view = self._view
                 vprobes = expand_probes(
                     probes, view.probe_table, nprobe, view.max_spill
                 )
                 valid = self._bucket_valid_for_filter(filter_spec, fprep)
-                if (
+                # kernel keeps top-k in a 128-lane output block; larger
+                # k (and its unrolled select rounds) stays on XLA
+                pallas_ok = (
                     pallas_ivf_enabled(self.dimension)
                     and self.metric in (
                         Metric.L2, Metric.INNER_PRODUCT, Metric.COSINE
                     )
-                    and self.store.vecs.dtype in (jnp.float32, jnp.bfloat16)
-                    # kernel keeps top-k in a 128-lane output block; larger
-                    # k (and its unrolled select rounds) stays on XLA
                     and k_eff <= 64
+                )
+                float_store = self.store.vecs.dtype in (
+                    jnp.float32, jnp.bfloat16
+                )
+                if pallas_ok and self._bucket_bsq is not None and (
+                    float_store or self._precision == "sq8"
                 ):
+                    # dimension-blocked early-pruning scan: partial
+                    # distances per block, candidates that cannot beat
+                    # the running k-th best stop scanning
+                    from dingo_tpu.ops.distance import metric_ascending
+                    from dingo_tpu.ops.pallas_ivf import ivf_pruned_search
+
+                    sq = self._precision == "sq8"
+                    dblk = self.dimension // self._bucket_bsq.shape[1]
+                    vals, slots, stats = ivf_pruned_search(
+                        vprobes, qpad, self._buckets, self._bucket_bsq,
+                        self._bucket_sqnorm, valid, view.bucket_slot,
+                        k=k_eff, dim_block=dblk,
+                        ascending=metric_ascending(self._scan_metric),
+                        sq_vmin=self.store.sq_vmin_d if sq else None,
+                        sq_scale=self.store.sq_scale_d if sq else None,
+                    )
+                    dists = scores_to_distances(vals, self._scan_metric)
+                elif pallas_ok and float_store:
                     from dingo_tpu.ops.distance import metric_ascending
                     from dingo_tpu.ops.pallas_ivf import ivf_list_search
 
@@ -763,9 +852,15 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         store = self.store
         dists.copy_to_host_async()
         slots.copy_to_host_async()
+        if stats is not None:
+            stats.copy_to_host_async()
         def resolve() -> List[SearchResult]:
             try:
                 dists_h, slots_h = jax.device_get((dists, slots))
+                if stats is not None:
+                    # pruned-fraction observability rides the result
+                    # fetch — no extra sync on the dispatch path
+                    self._note_prune_stats(jax.device_get(stats)[:b])
                 # shape bucketing may have run a larger k; slice back
                 ids = store.ids_of_slots(slots_h[:b, :topk])
                 dists_h = self._convert_distances(dists_h[:b, :topk])

@@ -153,6 +153,48 @@ def _encode_residual(vectors, assign, centroids, codebooks):
     return jax.vmap(enc_one)(subs, codebooks).T.astype(jnp.uint8)
 
 
+def _codebook_sqnorms(codebooks):
+    """||codeword||^2 per (subspace, codeword): [m, ksub] f32."""
+    return jnp.einsum(
+        "mkd,mkd->mk", codebooks, codebooks,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+def _residual_lut_tables(resid, codebooks, cb_sq):
+    """Residual targets [n, d] -> ADC tables [n, m, ksub]:
+    lut[i, j, c] = ||resid_i_subj - codeword_jc||^2. THE one copy of the
+    distance-table formula — both the XLA scan kernel and the fused
+    Quick-ADC path build tables here, so they cannot drift apart."""
+    m = codebooks.shape[0]
+    subs = split_subvectors(resid, m)                  # [m, n, dsub]
+    dots = jnp.einsum(
+        "mbd,mkd->mbk", subs, codebooks,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    q_sq = jnp.einsum(
+        "mbd,mbd->mb", subs, subs, precision=jax.lax.Precision.HIGHEST
+    )
+    lut = q_sq[:, :, None] - 2.0 * dots + cb_sq[:, None, :]  # [m, n, ksub]
+    return jnp.transpose(lut, (1, 0, 2))               # [n, m, ksub]
+
+
+@sentinel_jit("index.ivfpq.adc_lut")
+def _ivfpq_adc_lut(queries, centroids, probes_coarse, codebooks):
+    """Residual ADC tables [b, nprobe, m, ksub] over the coarse probe
+    ranking — the XLA-built input the fused Quick-ADC Pallas kernel
+    (ops/pallas_pq.py) keeps resident in VMEM per (query, rank)."""
+    b, d = queries.shape
+    m, ksub, _ = codebooks.shape
+    nprobe = probes_coarse.shape[1]
+    resid = (
+        queries[:, None, :] - jnp.take(centroids, probes_coarse, axis=0)
+    ).reshape(b * nprobe, d)
+    lut = _residual_lut_tables(resid, codebooks, _codebook_sqnorms(codebooks))
+    return lut.reshape(b, nprobe, m, ksub)
+
+
 @sentinel_jit("index.ivfpq.scan", static_argnames=("k", "precompute_lut"))
 def _ivfpq_scan_kernel(
     code_buckets,      # [B, cap_list, m] uint8 (spill buckets, ivf_layout.py)
@@ -177,25 +219,11 @@ def _ivfpq_scan_kernel(
     b, d = queries.shape
     m, ksub, dsub = codebooks.shape
     neg_inf = jnp.float32(-jnp.inf)
-    cb_sq = jnp.einsum(
-        "mkd,mkd->mk", codebooks, codebooks,
-        precision=jax.lax.Precision.HIGHEST,
-    )                                                   # [m, ksub]
+    cb_sq = _codebook_sqnorms(codebooks)                # [m, ksub]
 
     def lut_for(resid):
-        """residual targets [n, d] -> LUT [n, m, ksub]."""
-        qsubs = split_subvectors(resid, m)              # [m, n, dsub]
-        dots = jnp.einsum(
-            "mbd,mkd->mbk", qsubs, codebooks,
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        q_sq = jnp.einsum(
-            "mbd,mbd->mb", qsubs, qsubs,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        lut = q_sq[:, :, None] - 2.0 * dots + cb_sq[:, None, :]  # [m, n, ksub]
-        return jnp.transpose(lut, (1, 0, 2))            # [n, m, ksub]
+        """residual targets [n, d] -> LUT [n, m, ksub] (shared formula)."""
+        return _residual_lut_tables(resid, codebooks, cb_sq)
 
     if precompute_lut:
         nprobe = probes_coarse.shape[1]
@@ -526,26 +554,51 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
                 # view snapshot + dispatch under the device lock:
                 # incremental writes donate the bucket arrays to their
                 # scatter programs (see ivf_flat.search_async)
+                precompute = lut_bytes <= 256 * 1024 * 1024
+                from dingo_tpu.common.config import pallas_ivf_enabled
+
+                # Quick-ADC fused kernel: same tri-state crossover as the
+                # IVF_FLAT list kernel. Needs the precomputed-LUT regime
+                # (tables are the resident VMEM operand) and the 128-lane
+                # output block's k ceiling (shared with pallas_ivf).
+                use_fused_adc = (
+                    pallas_ivf_enabled(self.dimension)
+                    and precompute
+                    and max(k_eff, kprime) <= 64
+                )
                 with store.device_lock:
                     view = self._view
                     vprobes, coarse_pos = expand_probes_ranked(
                         probes, view.probe_table, nprobe, view.max_spill
                     )
                     valid = self._bucket_valid_for_filter(filter_spec, fprep)
-                    dists, slots = _ivfpq_scan_kernel(
-                        self._code_buckets,
-                        valid,
-                        view.bucket_slot,
-                        view.bucket_coarse,
-                        probes,
-                        vprobes,
-                        coarse_pos,
-                        qpad,
-                        self.centroids,
-                        self.codebooks,
-                        k=max(k_eff, kprime),
-                        precompute_lut=lut_bytes <= 256 * 1024 * 1024,
-                    )
+                    if use_fused_adc:
+                        from dingo_tpu.ops.pallas_pq import ivf_pq_adc_search
+
+                        lut_all = _ivfpq_adc_lut(
+                            qpad, self.centroids, probes, self.codebooks
+                        )
+                        vals, slots = ivf_pq_adc_search(
+                            vprobes, coarse_pos, lut_all,
+                            self._code_buckets, valid, view.bucket_slot,
+                            k=max(k_eff, kprime),
+                        )
+                        dists = -vals    # wire: ADC squared-L2 ascending
+                    else:
+                        dists, slots = _ivfpq_scan_kernel(
+                            self._code_buckets,
+                            valid,
+                            view.bucket_slot,
+                            view.bucket_coarse,
+                            probes,
+                            vprobes,
+                            coarse_pos,
+                            qpad,
+                            self.centroids,
+                            self.codebooks,
+                            k=max(k_eff, kprime),
+                            precompute_lut=precompute,
+                        )
                     if rerank_dev:
                         from dingo_tpu.ops.rerank import exact_rerank_device
 

@@ -51,13 +51,21 @@ def _write_run(vecs, sqnorm, rows, start, lo, hi, nrows):
     end, which lets the caller shift the window left at the capacity
     boundary instead of letting dynamic_update_slice clamp (a clamped start
     silently lands the write one slot off and corrupts neighbors).
-    Donated buffers -> in-place on device."""
+    Donated buffers -> in-place on device.
+
+    sqnorm caches the norms of the STORED rows (post dtype cast): a bf16
+    store's scan kernels read bf16-quantized values, so ||bf16(x)||^2 is
+    the self-consistent cache — the sq8 tier's decoded-norm convention
+    applied to the bf16 tier (norms of the original f32 rows drift ~1e-3
+    relative, which breaks the pruned scan's partial-sum bookkeeping and
+    mis-ranks near-ties either way)."""
     d = vecs.shape[1]
-    rows32 = rows.astype(jnp.float32)
+    stored = rows.astype(vecs.dtype)
+    rows32 = stored.astype(jnp.float32)
     old = lax.dynamic_slice(vecs, (start, 0), (nrows, d))
     idx = jnp.arange(nrows)
     keep = (idx >= lo) & (idx < hi)
-    blend = jnp.where(keep[:, None], rows.astype(vecs.dtype), old)
+    blend = jnp.where(keep[:, None], stored, old)
     vecs = lax.dynamic_update_slice(vecs, blend, (start, 0))
     sq = jnp.einsum(
         "ld,ld->l", rows32, rows32, precision=jax.lax.Precision.HIGHEST
@@ -90,11 +98,58 @@ def _write_run_presq(vecs, sqnorm, rows, row_sq, start, lo, hi, nrows):
     return vecs, sqnorm
 
 
+@sentinel_jit("index.slot_store.write_run_blk",
+              static_argnames=("nrows",), donate_argnums=(0, 1))
+def _write_run_blk(vecs_blk, bsq_blk, rows_blk, row_bsq, start, lo, hi, nrows):
+    """Blocked-mirror arm of _write_run: blend rows [lo, hi) of the padded
+    window into the dimension-blocked arrays ([nblk, capacity, dblk] data +
+    [nblk, capacity] per-block norms) at window position `start` along the
+    slot axis. Same window/blend/donate contract as _write_run."""
+    nblk, _, dblk = vecs_blk.shape
+    old = lax.dynamic_slice(vecs_blk, (0, start, 0), (nblk, nrows, dblk))
+    idx = jnp.arange(nrows)
+    keep = (idx >= lo) & (idx < hi)
+    blend = jnp.where(keep[None, :, None], rows_blk.astype(vecs_blk.dtype),
+                      old)
+    vecs_blk = lax.dynamic_update_slice(vecs_blk, blend, (0, start, 0))
+    old_b = lax.dynamic_slice(bsq_blk, (0, start), (nblk, nrows))
+    bsq_blk = lax.dynamic_update_slice(
+        bsq_blk, jnp.where(keep[None, :], row_bsq, old_b), (0, start)
+    )
+    return vecs_blk, bsq_blk
+
+
 class SlotStore:
-    def __init__(self, dim: int, dtype=jnp.float32, capacity: int = MIN_CAPACITY):
+    def __init__(self, dim: int, dtype=jnp.float32, capacity: int = MIN_CAPACITY,
+                 blocked: Optional[bool] = None):
         self.dim = dim
         self.dtype = dtype
         self.capacity = max(MIN_CAPACITY, _next_pow2(capacity))
+        # Dimension-blocked scan mirror (PDX vertical layout, ops/blocked.py):
+        # [nblk, capacity, dblk] data + [nblk, capacity] per-block norms,
+        # read by the pruned FLAT streaming kernel. Decided once at
+        # construction (conf vector.blocked_layout; `blocked` forces) —
+        # None when off / dtype unsupported / dimension doesn't block.
+        self.dim_block: Optional[int] = None
+        self.nblk = 0
+        self.vecs_blk: Optional[jax.Array] = None
+        self.bsq_blk: Optional[jax.Array] = None
+        if blocked is None:
+            from dingo_tpu.common.config import blocked_layout_enabled
+
+            blocked = blocked_layout_enabled()
+        if blocked and self._blocked_dtype_ok():
+            from dingo_tpu.ops.blocked import resolve_dim_block
+
+            self.dim_block = resolve_dim_block(dim)
+            if self.dim_block:
+                self.nblk = dim // self.dim_block
+                self.vecs_blk = jnp.zeros(
+                    (self.nblk, self.capacity, self.dim_block), self.dtype
+                )
+                self.bsq_blk = jnp.zeros(
+                    (self.nblk, self.capacity), jnp.float32
+                )
         self.vecs, self.sqnorm = self._alloc_storage(self.capacity)
         self.ids_by_slot = np.full((self.capacity,), -1, np.int64)
         self.valid_h = np.zeros((self.capacity,), np.bool_)
@@ -114,6 +169,13 @@ class SlotStore:
         self.device_lock = threading.RLock()
 
     # -- storage hooks (HostSlotStore overrides with numpy) ----------------
+    def _blocked_dtype_ok(self) -> bool:
+        """Tiers whose scan kernels can read a blocked mirror: f32/bf16
+        rows (binary ±1 int8 stays on the XLA path; HostSlotStore has no
+        device arrays at all). SqSlotStore overrides — its uint8 codes
+        decode inside the kernel."""
+        return jnp.dtype(self.dtype) in (jnp.float32, jnp.bfloat16)
+
     def _alloc_storage(self, capacity: int):
         return (
             jnp.zeros((capacity, self.dim), self.dtype),
@@ -154,7 +216,11 @@ class SlotStore:
 
     def memory_size(self) -> int:
         itemsize = jnp.zeros((), self.dtype).dtype.itemsize
-        return self.capacity * (self.dim * itemsize + 8 + 4 + 1)
+        size = self.capacity * (self.dim * itemsize + 8 + 4 + 1)
+        if self.vecs_blk is not None:
+            # blocked scan mirror: one more copy of the rows + block norms
+            size += self.capacity * (self.dim * itemsize + self.nblk * 4)
+        return size
 
     def reserve(self, capacity: int) -> None:
         """Pre-size device arrays (bulk ingest avoids per-growth recompiles
@@ -234,6 +300,39 @@ class SlotStore:
             jnp.int32(lo + chunk),
             nrows=bucket,
         )
+        self._write_blocked(padded, None, win_start, lo, chunk, bucket)
+
+    def _write_blocked(self, rows, rows_f32, win_start, lo, chunk,
+                       bucket) -> None:
+        """Mirror the same padded window into the blocked arrays (no-op
+        when the mirror is off). `rows` carries what the device stores
+        (codes for sq8); `rows_f32` the decoded values the norms must
+        describe (None = derive by casting rows through the store dtype,
+        matching _write_run's stored-row norm convention). Caller holds
+        device_lock (the program donates)."""
+        if self.vecs_blk is None:
+            return
+        from dingo_tpu.ops.blocked import block_sqnorms, to_blocked
+
+        if rows_f32 is None:
+            rows_f32 = np.asarray(rows)
+            store_dt = jnp.zeros((), self.dtype).dtype
+            if store_dt != np.float32:
+                rows_f32 = rows_f32.astype(store_dt)
+        rows_blk = to_blocked(np.asarray(rows), self.dim_block)
+        bsq = block_sqnorms(
+            np.asarray(rows_f32, np.float32), self.dim_block
+        ).astype(np.float32)
+        self.vecs_blk, self.bsq_blk = _write_run_blk(
+            self.vecs_blk,
+            self.bsq_blk,
+            jnp.asarray(rows_blk),
+            jnp.asarray(bsq),
+            jnp.int32(win_start),
+            jnp.int32(lo),
+            jnp.int32(lo + chunk),
+            nrows=bucket,
+        )
 
     def remove(self, ids: np.ndarray) -> int:
         """Tombstone rows; returns number actually removed."""
@@ -275,6 +374,16 @@ class SlotStore:
         pad = new_capacity - self.capacity
         with self.device_lock:
             self.vecs, self.sqnorm = self._grow_storage(pad)
+            if self.vecs_blk is not None:
+                self.vecs_blk = jnp.concatenate(
+                    [self.vecs_blk,
+                     jnp.zeros((self.nblk, pad, self.dim_block), self.dtype)],
+                    axis=1,
+                )
+                self.bsq_blk = jnp.concatenate(
+                    [self.bsq_blk, jnp.zeros((self.nblk, pad), jnp.float32)],
+                    axis=1,
+                )
         self.ids_by_slot = np.concatenate(
             [self.ids_by_slot, np.full((pad,), -1, np.int64)]
         )
@@ -351,6 +460,9 @@ class HostSlotStore(SlotStore):
     host chunks with a running top-k merge.
     """
 
+    def _blocked_dtype_ok(self) -> bool:
+        return False   # rows live in host RAM; no device scan mirror
+
     def _np_dtype(self):
         return np.dtype(jnp.zeros((), self.dtype).dtype.name)
 
@@ -370,8 +482,9 @@ class HostSlotStore(SlotStore):
 
     def _write_segment(self, start: int, rows: np.ndarray) -> None:
         n = rows.shape[0]
-        rows32 = rows.astype(np.float32)
-        self.vecs[start:start + n] = rows.astype(self.vecs.dtype)
+        stored = rows.astype(self.vecs.dtype)
+        rows32 = stored.astype(np.float32)   # stored-row norms (bf16 tier)
+        self.vecs[start:start + n] = stored
         self.sqnorm[start:start + n] = (rows32 * rows32).sum(1)
 
     def gather(self, ids: np.ndarray):
@@ -402,10 +515,11 @@ class SqSlotStore(SlotStore):
     set_params() installed them earlier (index.train with an explicit
     train set, or a snapshot load)."""
 
-    def __init__(self, dim: int, dtype=jnp.uint8, capacity: int = MIN_CAPACITY):
+    def __init__(self, dim: int, dtype=jnp.uint8, capacity: int = MIN_CAPACITY,
+                 blocked: Optional[bool] = None):
         if jnp.dtype(dtype) != jnp.uint8:
             raise ValueError("SqSlotStore stores uint8 codes")
-        super().__init__(dim, jnp.uint8, capacity)
+        super().__init__(dim, jnp.uint8, capacity, blocked=blocked)
         self.sq_params = None            # ops.sq.SqParams (host)
         self._sq_vmin_d = None           # lazy device copies
         self._sq_scale_d = None
@@ -460,6 +574,10 @@ class SqSlotStore(SlotStore):
         assert self.sq_params is not None, "set_params before put_codes"
         return super().put(ids, np.asarray(codes, np.uint8))
 
+    def _blocked_dtype_ok(self) -> bool:
+        # codes mirror blocks fine: the pruned kernel decodes per tile
+        return True
+
     def _dispatch_write(self, padded, win_start, lo, chunk, bucket) -> None:
         # padded rows are CODES here; norms come from the decoded surrogate
         deq = self.decode(padded)
@@ -474,6 +592,9 @@ class SqSlotStore(SlotStore):
             jnp.int32(lo + chunk),
             nrows=bucket,
         )
+        # blocked mirror scatters the CODES; the per-block norms describe
+        # the decoded surrogate the pruned kernel actually accumulates
+        self._write_blocked(padded, deq, win_start, lo, chunk, bucket)
 
     def gather(self, ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         found, codes = super().gather(ids)

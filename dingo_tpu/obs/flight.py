@@ -121,12 +121,35 @@ class FlightRecorder:
     def on_slow_query(self, rec: Dict[str, Any]) -> str:
         """Tracer hook: `rec` is the slow-log record (sampled span or the
         synthesized unsampled one)."""
+        extra = {"dur_ms": round(rec.get("dur_us", 0) / 1000.0, 1)}
+        prune = self._pruned_fractions()
+        if prune:
+            # a slow scan with pruning barely engaging (fraction ~0) is
+            # a different diagnosis than one pruning hard — carry the
+            # per-region gauge right in the trigger meta so the bundle
+            # answers it even when no metrics collector tick ring runs
+            # (bench, tests)
+            extra["pruned_dim_fraction"] = prune
         return self.trigger(
             "slow_query",
             trace_id=rec.get("trace_id", ""),
             name=rec.get("name", ""),
-            extra={"dur_ms": round(rec.get("dur_us", 0) / 1000.0, 1)},
+            extra=extra,
         )
+
+    @staticmethod
+    def _pruned_fractions() -> Dict[str, float]:
+        """Current ivf.pruned_dim_fraction gauge per series (empty when
+        the pruned scan never ran)."""
+        from dingo_tpu.common.metrics import METRICS
+
+        out = {}
+        with METRICS._lock:
+            items = list(METRICS._gauges.items())
+        for key, g in items:
+            if key.startswith("ivf.pruned_dim_fraction"):
+                out[key] = round(g.get(), 4)
+        return out
 
     def on_rpc_error(self, span_name: str, exc: BaseException,
                      span=None) -> str:

@@ -96,7 +96,7 @@ def _ivf_kernel(vp_ref, q_ref, qsq_ref, x_ref, xsq_ref, val_ref, slot_ref,
 
 
 @sentinel_jit("ops.pallas.ivf_list_topk",
-              static_argnames=("k", "ascending", "interpret"))
+              static_argnames=("k", "ascending", "interpret", "nq"))
 def ivf_list_topk(
     vprobes: jax.Array,        # [b, budget] int32 virtual bucket ids (-1 pad)
     queries: jax.Array,        # [b, d] f32
@@ -107,15 +107,21 @@ def ivf_list_topk(
     k: int,
     ascending: bool = True,
     interpret: bool = False,
+    nq: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fused probed-bucket scan -> (scores[b, k], slots[b, k]).
 
     Scores follow the 'larger is better' convention (negated L2 when
     ascending); slots are -1 where fewer than k valid rows were probed.
+    `nq` clamps the query grid to the REAL batch: arrays stay padded to
+    ROW_BLOCK rows (Mosaic tiling), but padded rows get no grid steps —
+    without the clamp a b=1 batch paid 8x the grid (and each dead step
+    still DMA'd bucket 0's [cap, d] tile through VMEM).
     """
     b, d = queries.shape
     nb, cap, _ = buckets.shape
     budget = vprobes.shape[1]
+    nq = nq or b
     q32 = queries.astype(jnp.float32)
     qsq = jnp.einsum(
         "bd,bd->b", q32, q32, precision=jax.lax.Precision.HIGHEST
@@ -131,7 +137,7 @@ def ivf_list_topk(
     # as ROW_BLOCK-row blocks so VMEM stays O(1) in the batch.
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, budget),
+        grid=(nq, budget),
         in_specs=[
             pl.BlockSpec(
                 (ROW_BLOCK, d), lambda q, r, vp: (q // ROW_BLOCK, 0)
@@ -178,9 +184,25 @@ def ivf_list_search(
     k: int, ascending: bool = True,
 ):
     """Backend-aware wrapper: interpret mode off-TPU (Mosaic is TPU-only);
-    pads the batch to ROW_BLOCK (padded queries probe nothing: vprobes -1)."""
+    pads the ARRAYS to ROW_BLOCK rows but clamps the grid to the real
+    batch, so a b<8 request doesn't run (or DMA for) dead grid steps."""
     b = queries.shape[0]
-    pad = (-b) % ROW_BLOCK
+    queries, vprobes = _pad_rows(queries, vprobes)
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    vals, slots = ivf_list_topk(
+        vprobes, queries, buckets, bucket_sqnorm, bucket_valid, bucket_slot,
+        k=k, ascending=ascending, interpret=interpret, nq=b,
+    )
+    from dingo_tpu.ops.distance import device_wait_span
+
+    vals, slots = device_wait_span("pallas_ivf_search", (vals, slots))
+    return vals[:b], slots[:b]
+
+
+def _pad_rows(queries, vprobes):
+    """Pad the per-query arrays to the ROW_BLOCK sublane multiple (padded
+    queries probe nothing: vprobes -1)."""
+    pad = (-queries.shape[0]) % ROW_BLOCK
     if pad:
         queries = jnp.concatenate(
             [queries, jnp.zeros((pad, queries.shape[1]), queries.dtype)]
@@ -188,12 +210,279 @@ def ivf_list_search(
         vprobes = jnp.concatenate(
             [vprobes, jnp.full((pad, vprobes.shape[1]), -1, vprobes.dtype)]
         )
+    return queries, vprobes
+
+
+def _ivf_pruned_kernel(vp_ref, q_ref, qsq_ref, qpsq_ref, x_ref, bsq_ref,
+                       xsq_ref, val_ref, slot_ref, *rest,
+                       k, ascending, nblk, check_every, sq):
+    """Dimension-blocked early-pruning list scan (PDX on TPU).
+
+    Grid (q, r, jb) with the dimension block jb INNERMOST: for each probed
+    bucket the kernel streams one [cap, dblk] tile per step, accumulates
+    the partial dot in VMEM scratch, and after each block masks out
+    candidates whose partial-distance bound already cannot beat the
+    running k-th best (read from the resident output block). A bucket
+    whose candidates are ALL dead skips the remaining blocks' compute
+    entirely. Bounds:
+
+      L2: partial dist through block j = qpsq[j] - 2*cum + xpsq[j] is a
+          LOWER bound of the final distance (remaining blocks add >= 0),
+          so -partial is an upper bound of the final score.
+      IP: cum + sqrt(qtail[j] * xtail[j]) (Cauchy-Schwarz on the unseen
+          dimension suffix) is an upper bound of the final dot.
+
+    A candidate is pruned only when its upper bound is STRICTLY below the
+    running k-th best, so results match the non-pruning kernels exactly
+    (up to f32 partial-sum rounding on the reported distances).
+
+    Stats output lanes (accumulated per query): 0 = candidate-block pairs
+    actually scanned, 1 = candidate-block pairs total, 2 = candidates
+    scanned to the last block, 3 = candidates considered.
+    """
+    if sq:
+        (vmin_ref, scale_ref, outv_ref, outi_ref, outs_ref,
+         cum, alive, xpsq) = rest
+    else:
+        outv_ref, outi_ref, outs_ref, cum, alive, xpsq = rest
+    qi = pl.program_id(0)
+    r = pl.program_id(1)
+    jb = pl.program_id(2)
+    row = pl.ds(jax.lax.rem(qi, ROW_BLOCK), 1)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (1, outs_ref.shape[1]), 1)
+
+    @pl.when((r == 0) & (jb == 0))
+    def _init_out():
+        outv_ref[row, :] = jnp.full(
+            (1, outv_ref.shape[1]), NEG_INF, jnp.float32
+        )
+        outi_ref[row, :] = jnp.full((1, outi_ref.shape[1]), -1, jnp.int32)
+        outs_ref[row, :] = jnp.zeros((1, outs_ref.shape[1]), jnp.float32)
+
+    @pl.when(vp_ref[qi, r] >= 0)
+    def _scan_bucket():
+        @pl.when(jb == 0)
+        def _init_bucket():
+            cum[:] = jnp.zeros_like(cum)
+            xpsq[:] = jnp.zeros_like(xpsq)
+            alive[:] = val_ref[0]
+            nvalid = jnp.sum(val_ref[0])
+            outs_ref[row, :] += jnp.where(
+                lanes == 1, nvalid * nblk,
+                jnp.where(lanes == 3, nvalid, 0.0),
+            )
+
+        nalive = jnp.sum(alive[:])
+        outs_ref[row, :] += jnp.where(lanes == 0, nalive, 0.0)
+
+        @pl.when((jb == nblk - 1))
+        def _count_full():
+            outs_ref[row, :] += jnp.where(lanes == 2, nalive, 0.0)
+
+        @pl.when(nalive > 0.5)
+        def _compute():
+            q = q_ref[row, :]                          # [1, dblk]
+            x = x_ref[0]                               # [cap, dblk]
+            if sq:
+                # decode in f32, multiply in bf16 with f32 accumulation —
+                # the sq8 tier's compute contract (ops/sq.py): native
+                # bf16 MXU matmul fed by 1-byte HBM reads
+                x = (
+                    x.astype(jnp.float32) * scale_ref[:] + vmin_ref[:]
+                ).astype(jnp.bfloat16)
+                q = q.astype(jnp.bfloat16)
+            else:
+                x = x.astype(jnp.float32)
+            dots = jax.lax.dot_general(
+                q, x, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=(None if sq else jax.lax.Precision.HIGHEST),
+            )                                          # [1, cap]
+            cum[:] += dots
+            xpsq[:] += bsq_ref[0]
+            bound = outv_ref[row, :][:, k - 1:k]       # running k-th best
+            qpsq_j = qpsq_ref[row, :]                  # [1, 1] prefix
+            if ascending:
+                partial = qpsq_j - 2.0 * cum[:] + xpsq[:]
+                ub = -partial
+                final = ub
+            else:
+                qtail = qsq_ref[row, :] - qpsq_j
+                xtail = xsq_ref[0] - xpsq[:]
+                ub = cum[:] + jnp.sqrt(
+                    jnp.maximum(qtail, 0.0) * jnp.maximum(xtail, 0.0)
+                )
+                final = cum[:]
+
+            @pl.when(jb < nblk - 1)
+            def _prune():
+                do_check = jax.lax.rem(jb + 1, check_every) == 0
+                dead = do_check & (ub < bound)
+                alive[:] = jnp.where(dead, 0.0, alive[:])
+
+            @pl.when(jb == nblk - 1)
+            def _merge():
+                scores = jnp.where(alive[:] > 0.5, final, NEG_INF)
+                slot = slot_ref[0].astype(jnp.int32)
+                blk_v, blk_i = _select_topk(scores, slot, k)
+                cur_v = outv_ref[row, :]
+                cur_i = outi_ref[row, :]
+                cat_v = jnp.concatenate([cur_v[:, :k], blk_v], axis=1)
+                cat_i = jnp.concatenate([cur_i[:, :k], blk_i], axis=1)
+                new_v, new_i = _select_topk(cat_v, cat_i, k)
+                pad = outv_ref.shape[1] - k
+                outv_ref[row, :] = jnp.concatenate(
+                    [new_v, jnp.full((1, pad), NEG_INF, jnp.float32)],
+                    axis=1,
+                )
+                outi_ref[row, :] = jnp.concatenate(
+                    [new_i, jnp.full((1, pad), -1, jnp.int32)], axis=1
+                )
+
+    @pl.when((r == pl.num_programs(1) - 1) & (jb == nblk - 1))
+    def _finish():
+        fv = outv_ref[row, :]
+        outi_ref[row, :] = jnp.where(jnp.isneginf(fv), -1, outi_ref[row, :])
+
+
+@sentinel_jit("ops.pallas.ivf_pruned_topk",
+              static_argnames=("k", "ascending", "dim_block", "check_every",
+                               "interpret", "nq", "sq"))
+def ivf_pruned_topk(
+    vprobes: jax.Array,        # [b, budget] int32 virtual bucket ids (-1 pad)
+    queries: jax.Array,        # [b, d] f32
+    qpsq: jax.Array,           # [b, nblk] f32 inclusive per-block prefixes
+    buckets: jax.Array,        # [B, cap, d] rows (f32/bf16) or codes (uint8)
+    bucket_bsq: jax.Array,     # [B, nblk, cap] f32 per-block (decoded) norms
+    bucket_sqnorm: jax.Array,  # [B, cap] f32 total (decoded) norms
+    bucket_valid: jax.Array,   # [B, cap] bool/float
+    bucket_slot: jax.Array,    # [B, cap] int32
+    sq_vmin,                   # [d] f32 codec params (None for float rows)
+    sq_scale,
+    k: int,
+    dim_block: int,
+    ascending: bool = True,
+    check_every: int = 1,
+    interpret: bool = False,
+    nq: int = 0,
+    sq: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Early-pruning probed-bucket scan -> (scores, slots, stats).
+
+    Same contract as ivf_list_topk plus a [b, OUT_PAD] stats output (see
+    _ivf_pruned_kernel lanes) the caller turns into pruned-fraction
+    metrics. The [B, cap, d] bucket array is NOT physically re-laid-out:
+    the (1, cap, dim_block) BlockSpec tile IS the PDX vertical access
+    pattern (one dimension block of every candidate per DMA)."""
+    b, d = queries.shape
+    nb, cap, _ = buckets.shape
+    budget = vprobes.shape[1]
+    nblk = d // dim_block
+    nq = nq or b
+    q32 = queries.astype(jnp.float32)
+    qsq = jnp.einsum(
+        "bd,bd->b", q32, q32, precision=jax.lax.Precision.HIGHEST
+    )[:, None]
+
+    def bucket_map(q, r, jb, vp):
+        return (jnp.maximum(vp[q, r], 0), 0, 0)
+
+    in_specs = [
+        pl.BlockSpec(
+            (ROW_BLOCK, dim_block),
+            lambda q, r, jb, vp: (q // ROW_BLOCK, jb),
+        ),                                                    # queries
+        pl.BlockSpec(
+            (ROW_BLOCK, 1), lambda q, r, jb, vp: (q // ROW_BLOCK, 0)
+        ),                                                    # qsq
+        pl.BlockSpec(
+            (ROW_BLOCK, 1), lambda q, r, jb, vp: (q // ROW_BLOCK, jb)
+        ),                                                    # qpsq
+        pl.BlockSpec(
+            (1, cap, dim_block),
+            lambda q, r, jb, vp: (jnp.maximum(vp[q, r], 0), 0, jb),
+        ),                                                    # bucket tile
+        pl.BlockSpec(
+            (1, 1, cap),
+            lambda q, r, jb, vp: (jnp.maximum(vp[q, r], 0), jb, 0),
+        ),                                                    # per-block norms
+        pl.BlockSpec((1, 1, cap), bucket_map),                # total norms
+        pl.BlockSpec((1, 1, cap), bucket_map),                # valid
+        pl.BlockSpec((1, 1, cap), bucket_map),                # slots
+    ]
+    args = [
+        q32,
+        qsq,
+        qpsq,
+        buckets,
+        bucket_bsq,
+        bucket_sqnorm[:, None, :],
+        bucket_valid.astype(jnp.float32)[:, None, :],
+        bucket_slot[:, None, :],
+    ]
+    if sq:
+        in_specs += [
+            pl.BlockSpec((1, dim_block), lambda q, r, jb, vp: (0, jb)),
+            pl.BlockSpec((1, dim_block), lambda q, r, jb, vp: (0, jb)),
+        ]
+        args += [sq_vmin[None, :], sq_scale[None, :]]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nq, budget, nblk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec(
+                (ROW_BLOCK, OUT_PAD),
+                lambda q, r, jb, vp: (q // ROW_BLOCK, 0),
+            ),
+        ] * 3,
+        scratch_shapes=[
+            pltpu.VMEM((1, cap), jnp.float32),    # cum dot
+            pltpu.VMEM((1, cap), jnp.float32),    # alive mask
+            pltpu.VMEM((1, cap), jnp.float32),    # x per-block prefix norms
+        ],
+    )
+    out_v, out_i, out_s = pl.pallas_call(
+        functools.partial(
+            _ivf_pruned_kernel, k=k, ascending=ascending, nblk=nblk,
+            check_every=check_every, sq=sq,
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, OUT_PAD), jnp.float32),
+            jax.ShapeDtypeStruct((b, OUT_PAD), jnp.int32),
+            jax.ShapeDtypeStruct((b, OUT_PAD), jnp.float32),
+        ],
+        interpret=interpret,
+    )(vprobes, *args)
+    return out_v[:, :k], out_i[:, :k], out_s[:, :4]
+
+
+def ivf_pruned_search(
+    vprobes, queries, buckets, bucket_bsq, bucket_sqnorm, bucket_valid,
+    bucket_slot, k: int, dim_block: int, ascending: bool = True,
+    sq_vmin=None, sq_scale=None,
+):
+    """Backend-aware wrapper for the pruning scan: pads per-query arrays
+    to ROW_BLOCK, clamps the grid to the real batch, computes the query
+    prefix norms, and returns (scores[b,k], slots[b,k], stats[b,4])."""
+    from dingo_tpu.common.config import FLAGS
+    from dingo_tpu.ops.blocked import query_prefix_sqnorms
+
+    b = queries.shape[0]
+    queries, vprobes = _pad_rows(queries, vprobes)
+    qpsq = query_prefix_sqnorms(queries, dim_block)
     interpret = jax.default_backend() not in ("tpu", "axon")
-    vals, slots = ivf_list_topk(
-        vprobes, queries, buckets, bucket_sqnorm, bucket_valid, bucket_slot,
-        k=k, ascending=ascending, interpret=interpret,
+    check = max(1, int(FLAGS.get("ivf_prune_check_interval")))
+    vals, slots, stats = ivf_pruned_topk(
+        vprobes, queries, qpsq, buckets, bucket_bsq, bucket_sqnorm,
+        bucket_valid, bucket_slot, sq_vmin, sq_scale,
+        k=k, dim_block=dim_block, ascending=ascending, check_every=check,
+        interpret=interpret, nq=b, sq=sq_vmin is not None,
     )
     from dingo_tpu.ops.distance import device_wait_span
 
-    vals, slots = device_wait_span("pallas_ivf_search", (vals, slots))
-    return vals[:b], slots[:b]
+    vals, slots, stats = device_wait_span(
+        "pruned_scan", (vals, slots, stats)
+    )
+    return vals[:b], slots[:b], stats[:b]

@@ -174,3 +174,232 @@ def fused_search(
     interpret = jax.default_backend() not in ("tpu", "axon")
     return fused_topk(jnp.asarray(q), x, x_sqnorm, valid, k=k, block=block,
                       ascending=ascending, interpret=interpret)
+
+
+#: stats output lane width (TPU lane tile; only the first 4 lanes carry)
+STATS_PAD = 128
+
+
+def _pruned_fused_kernel(q_ref, qsq_ref, qpsq_ref, x_ref, bsq_ref, xsq_ref,
+                         valid_ref, *rest, k, block, nblk, check_every,
+                         ascending, sq):
+    """Dimension-blocked early-pruning whole-index scan (the FLAT arm of
+    the PDX scheme — see ops/pallas_ivf._ivf_pruned_kernel for the bound
+    math). Grid (row_block j, dim_block jb) with jb INNERMOST: partial
+    dots accumulate in VMEM scratch per row block; candidates whose bound
+    cannot beat the running k-th best stop contributing, and a row block
+    whose candidates are ALL dead (for every query) skips the remaining
+    dimension blocks' matmuls.
+
+    Stats output lanes (per query, accumulated): 0 = candidate-block
+    pairs scanned, 1 = pairs total, 2 = candidates scanned to the last
+    block, 3 = candidates considered."""
+    if sq:
+        (vmin_ref, scale_ref, out_v_ref, out_i_ref, outs_ref,
+         best_v, best_i, cum, alive, xpsq) = rest
+    else:
+        (out_v_ref, out_i_ref, outs_ref,
+         best_v, best_i, cum, alive, xpsq) = rest
+    j = pl.program_id(0)
+    jb = pl.program_id(1)
+    b = cum.shape[0]
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (b, STATS_PAD), 1)
+
+    @pl.when((j == 0) & (jb == 0))
+    def _init():
+        best_v[:] = jnp.full_like(best_v, NEG_INF)
+        best_i[:] = jnp.full_like(best_i, -1)
+        outs_ref[:] = jnp.zeros_like(outs_ref)
+
+    @pl.when(jb == 0)
+    def _init_block():
+        cum[:] = jnp.zeros_like(cum)
+        xpsq[:] = jnp.zeros_like(xpsq)
+        alive[:] = jnp.broadcast_to(valid_ref[:], (b, block))
+        nvalid = jnp.sum(valid_ref[:])
+        outs_ref[:] += jnp.where(
+            lanes == 1, nvalid * nblk, jnp.where(lanes == 3, nvalid, 0.0)
+        )
+
+    per_q = jnp.sum(alive[:], axis=1, keepdims=True)       # [b, 1]
+    outs_ref[:] += jnp.where(lanes == 0, per_q, 0.0)
+
+    @pl.when(jb == nblk - 1)
+    def _count_full():
+        outs_ref[:] += jnp.where(lanes == 2, per_q, 0.0)
+
+    @pl.when(jnp.sum(alive[:]) > 0.5)
+    def _compute():
+        q = q_ref[:]                                       # [b, dblk]
+        x = x_ref[0]                                       # [block, dblk]
+        if sq:
+            # decode f32 -> bf16 multiplies, f32 accumulate (the sq8
+            # tier's compute contract, ops/sq.py)
+            x = (
+                x.astype(jnp.float32) * scale_ref[:] + vmin_ref[:]
+            ).astype(jnp.bfloat16)
+            q = q.astype(jnp.bfloat16)
+            bf16_mul = True
+        else:
+            # bf16 stores keep bf16 multiplies with f32 accumulation —
+            # the same pairing distance._dot applies on the XLA arm, so
+            # the pruned scan ranks identically to the flat kernel
+            bf16_mul = x.dtype == jnp.bfloat16
+            if bf16_mul:
+                q = q.astype(jnp.bfloat16)
+            else:
+                x = x.astype(jnp.float32)
+        dots = jax.lax.dot_general(
+            q, x, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=(None if bf16_mul else jax.lax.Precision.HIGHEST),
+        )                                                  # [b, block]
+        cum[:] += dots
+        xpsq[:] += bsq_ref[0]                              # [1, block]
+        bound = best_v[:, k - 1:k]                         # [b, 1]
+        if ascending:
+            partial = qpsq_ref[:] - 2.0 * cum[:] + xpsq[:]
+            ub = -partial
+            final = ub
+        else:
+            qtail = qsq_ref[:] - qpsq_ref[:]               # [b, 1]
+            xtail = xsq_ref[:] - xpsq[:]                   # [1, block]
+            ub = cum[:] + jnp.sqrt(
+                jnp.maximum(qtail, 0.0) * jnp.maximum(xtail, 0.0)
+            )
+            final = cum[:]
+
+        @pl.when(jb < nblk - 1)
+        def _prune():
+            do_check = jax.lax.rem(jb + 1, check_every) == 0
+            alive[:] = jnp.where(do_check & (ub < bound), 0.0, alive[:])
+
+        @pl.when(jb == nblk - 1)
+        def _merge():
+            scores = jnp.where(alive[:] > 0.5, final, NEG_INF)
+            gidx = (
+                jax.lax.broadcasted_iota(jnp.int32, (b, block), 1)
+                + j * block
+            )
+            blk_v, blk_i = _select_topk(scores, gidx, k)
+            cat_v = jnp.concatenate([best_v[:], blk_v], axis=1)
+            cat_i = jnp.concatenate([best_i[:], blk_i], axis=1)
+            new_v, new_i = _select_topk(cat_v, cat_i, k)
+            best_v[:] = new_v
+            best_i[:] = new_i
+
+    @pl.when((j == pl.num_programs(0) - 1) & (jb == nblk - 1))
+    def _finish():
+        fv = best_v[:]
+        out_v_ref[:] = fv
+        out_i_ref[:] = jnp.where(jnp.isneginf(fv), -1, best_i[:])
+
+
+@sentinel_jit("ops.pallas.pruned_fused_topk",
+              static_argnames=("k", "block", "dim_block", "check_every",
+                               "ascending", "interpret", "sq"))
+def pruned_fused_topk(
+    q: jax.Array,              # [b, d] f32
+    x_blk: jax.Array,          # [nblk, n, dblk] rows (f32/bf16) or codes
+    bsq_blk: jax.Array,        # [nblk, n] f32 per-block (decoded) norms
+    x_sqnorm: jax.Array,       # [n] f32 total (decoded) norms
+    valid: jax.Array,          # [n] bool/float
+    sq_vmin,                   # [d] f32 codec params (None for float rows)
+    sq_scale,
+    k: int,
+    block: int = 2048,
+    dim_block: int = 128,
+    check_every: int = 1,
+    ascending: bool = True,
+    interpret: bool = False,
+    sq: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Early-pruning streaming search over the dimension-blocked store
+    mirror (slot_store.vecs_blk/bsq_blk) -> (scores[b,k], slots[b,k],
+    stats[b,4]). Same contract as fused_topk plus the pruning stats."""
+    b, d = q.shape
+    nblk, n, dblk = x_blk.shape
+    assert n % block == 0, f"n={n} not a multiple of block={block}"
+    assert dblk * nblk == d, f"blocked dim {nblk}x{dblk} != {d}"
+    q32 = q.astype(jnp.float32)
+    qsq = jnp.einsum("bd,bd->b", q32, q32,
+                     precision=jax.lax.Precision.HIGHEST)[:, None]
+    from dingo_tpu.ops.blocked import query_prefix_sqnorms
+
+    qpsq = query_prefix_sqnorms(q32, dblk)                 # [b, nblk]
+    grid = (n // block, nblk)
+    in_specs = [
+        pl.BlockSpec((b, dblk), lambda j, jb: (0, jb)),     # q (dim block)
+        pl.BlockSpec((b, 1), lambda j, jb: (0, 0)),         # qsq
+        pl.BlockSpec((b, 1), lambda j, jb: (0, jb)),        # qpsq prefix
+        pl.BlockSpec((1, block, dblk), lambda j, jb: (jb, j, 0)),   # x tile
+        pl.BlockSpec((1, 1, block), lambda j, jb: (jb, 0, j)),      # bsq
+        pl.BlockSpec((1, block), lambda j, jb: (0, j)),     # xsq total
+        pl.BlockSpec((1, block), lambda j, jb: (0, j)),     # valid
+    ]
+    args = [
+        q32, qsq, qpsq, x_blk, bsq_blk[:, None, :],
+        x_sqnorm[None, :], valid.astype(jnp.float32)[None, :],
+    ]
+    if sq:
+        in_specs += [
+            pl.BlockSpec((1, dblk), lambda j, jb: (0, jb)),
+            pl.BlockSpec((1, dblk), lambda j, jb: (0, jb)),
+        ]
+        args += [sq_vmin[None, :], sq_scale[None, :]]
+    out_v, out_i, out_s = pl.pallas_call(
+        functools.partial(
+            _pruned_fused_kernel, k=k, block=block, nblk=nblk,
+            check_every=check_every, ascending=ascending, sq=sq,
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((b, k), lambda j, jb: (0, 0)),
+            pl.BlockSpec((b, k), lambda j, jb: (0, 0)),
+            pl.BlockSpec((b, STATS_PAD), lambda j, jb: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, k), jnp.float32),
+            jax.ShapeDtypeStruct((b, k), jnp.int32),
+            jax.ShapeDtypeStruct((b, STATS_PAD), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, k), jnp.float32),       # best_v
+            pltpu.VMEM((b, k), jnp.int32),         # best_i
+            pltpu.VMEM((b, block), jnp.float32),   # cum dot
+            pltpu.VMEM((b, block), jnp.float32),   # alive mask
+            pltpu.VMEM((1, block), jnp.float32),   # x per-block prefixes
+        ],
+        interpret=interpret,
+    )(*args)
+    return out_v, out_i, out_s[:, :4]
+
+
+def pruned_fused_search(
+    q,
+    x_blk: jax.Array,
+    bsq_blk: jax.Array,
+    x_sqnorm: jax.Array,
+    valid: jax.Array,
+    k: int,
+    block: int = 2048,
+    ascending: bool = True,
+    sq_vmin=None,
+    sq_scale=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Host-friendly wrapper over the blocked store mirror. The mirror's
+    capacity is pow2 >= 4096, so `block` is clamped down to divide it
+    exactly (no padding copy of a [nblk, n, dblk] array on the hot path)."""
+    from dingo_tpu.common.config import FLAGS
+
+    n = x_blk.shape[1]
+    block = min(block, n)
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    check = max(1, int(FLAGS.get("ivf_prune_check_interval")))
+    return pruned_fused_topk(
+        jnp.asarray(q), x_blk, bsq_blk, x_sqnorm, valid,
+        sq_vmin, sq_scale,
+        k=k, block=block, dim_block=int(x_blk.shape[2]), check_every=check,
+        ascending=ascending, interpret=interpret, sq=sq_vmin is not None,
+    )

@@ -76,6 +76,37 @@ def scatter_bucket_update(dst, b_idx, r_idx, vals):
     )
 
 
+@sentinel_jit("ops.scatter.bucket_dim_rows", donate_argnums=(0,))
+def _scatter_bucket_dim_rows(dst, b_idx, r_idx, vals):
+    """dst[b_idx[i], :, r_idx[i]] = vals[i] for a dimension-blocked view
+    array [A, n_blocks, cap, ...] (vals [n, n_blocks, ...]); out-of-range
+    bucket indices dropped (the pow2 pad)."""
+    blk = jnp.arange(dst.shape[1], dtype=jnp.int32)
+    return dst.at[b_idx[:, None], blk[None, :], r_idx[:, None]].set(
+        vals.astype(dst.dtype), mode="drop"
+    )
+
+
+def scatter_bucket_dim_update(dst, b_idx, r_idx, vals):
+    """Point-update a donated dimension-blocked [A, n_blocks, cap, ...]
+    view array at (bucket, row) coordinates — one row touches every
+    dimension block. Same pow2-pad/donation contract as
+    scatter_bucket_update."""
+    n = len(b_idx)
+    if n == 0:
+        return dst
+    m = _next_pow2(n)
+    if m != n:
+        drop = dst.shape[0]
+        b_idx = _pad_pow2(np.asarray(b_idx, np.int32), m - n, drop)
+        r_idx = _pad_pow2(np.asarray(r_idx, np.int32), m - n, 0)
+        vals = _pad_pow2(vals, m - n, 0)
+    return _scatter_bucket_dim_rows(
+        dst, jnp.asarray(b_idx, jnp.int32), jnp.asarray(r_idx, jnp.int32),
+        jnp.asarray(vals),
+    )
+
+
 def scatter_axis0_update(dst, idx, vals):
     """Point-update a donated [B, ...] array along axis 0 (bucket_coarse)."""
     n = len(idx)

@@ -1,0 +1,158 @@
+"""Dimension-blocked (PDX vertical) layout: flat <-> blocked round-trips,
+the SlotStore scan mirror under in-place writes/tombstones/growth, and
+snapshot round-trips carrying layout metadata."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dingo_tpu.common.config import FLAGS
+from dingo_tpu.index.base import IndexParameter, IndexType
+from dingo_tpu.index.slot_store import SlotStore, SqSlotStore
+from dingo_tpu.ops.blocked import (
+    block_sqnorms,
+    bucket_block_sqnorms,
+    from_blocked,
+    query_prefix_sqnorms,
+    resolve_dim_block,
+    to_blocked,
+)
+
+
+@pytest.fixture
+def small_dim_block():
+    FLAGS.set("ivf_dim_block", 8)
+    yield
+    FLAGS.set("ivf_dim_block", 128)
+
+
+def test_round_trip_bit_exact():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((37, 48)).astype(np.float32)
+    for dblk in (8, 16, 48):
+        blk = to_blocked(x, dblk)
+        assert blk.shape == (48 // dblk if 48 % dblk == 0 else -(-48 // dblk),
+                             37, dblk)
+        np.testing.assert_array_equal(from_blocked(blk, 48), x)
+    # non-divisible dimension: zero-padded trailing block, still bit-exact
+    blk = to_blocked(x[:, :42], 16)
+    assert blk.shape == (3, 37, 16)
+    np.testing.assert_array_equal(from_blocked(blk, 42), x[:, :42])
+    # device arrays round-trip too
+    xd = jnp.asarray(x)
+    np.testing.assert_array_equal(
+        np.asarray(from_blocked(to_blocked(xd, 16), 48)), x
+    )
+
+
+def test_block_norm_helpers_consistent():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((20, 32)).astype(np.float32)
+    bsq = block_sqnorms(x, 8)                       # [4, 20]
+    np.testing.assert_allclose(bsq.sum(axis=0), (x ** 2).sum(axis=1),
+                               rtol=1e-5)
+    pref = np.asarray(query_prefix_sqnorms(jnp.asarray(x), 8))  # [20, 4]
+    np.testing.assert_allclose(pref[:, -1], (x ** 2).sum(axis=1), rtol=1e-5)
+    np.testing.assert_allclose(pref.T, np.cumsum(bsq, axis=0), rtol=1e-5)
+    buckets = x.reshape(2, 10, 32)
+    bb = np.asarray(bucket_block_sqnorms(jnp.asarray(buckets), 8))
+    np.testing.assert_allclose(bb.sum(axis=1), (buckets ** 2).sum(axis=2),
+                               rtol=1e-5)
+
+
+def test_resolve_dim_block_gates():
+    assert resolve_dim_block(768, 128) == 128
+    assert resolve_dim_block(128, 128) is None      # single block: no prune
+    assert resolve_dim_block(100, 32) is None       # doesn't tile
+    assert resolve_dim_block(64, 0) is None         # disabled
+
+
+def test_blocked_store_mirror_append_and_tombstone(small_dim_block):
+    rng = np.random.default_rng(2)
+    store = SlotStore(32, capacity=4096, blocked=True)
+    assert store.dim_block == 8 and store.nblk == 4
+    v = rng.standard_normal((300, 32)).astype(np.float32)
+    store.put(np.arange(300, dtype=np.int64), v)
+    # mirror matches the flat ground truth bit-for-bit
+    np.testing.assert_array_equal(
+        np.asarray(from_blocked(store.vecs_blk, 32))[:300],
+        np.asarray(store.vecs[:300]),
+    )
+    # overwrite + scattered update keeps the mirror in sync
+    sel = np.array([5, 17, 250], np.int64)
+    v2 = rng.standard_normal((3, 32)).astype(np.float32)
+    store.put(sel, v2)
+    slots = store.slots_of(sel)
+    got = np.asarray(from_blocked(store.vecs_blk, 32))[slots]
+    np.testing.assert_array_equal(got, v2)
+    # per-block norms track the stored rows
+    np.testing.assert_allclose(
+        np.asarray(store.bsq_blk)[:, slots], block_sqnorms(v2, 8), rtol=1e-5
+    )
+    # tombstone: host bitmap only — mirror rows go stale but masked
+    store.remove(np.array([5], np.int64))
+    assert not store.valid_h[slots[0]]
+    # growth preserves mirror content
+    store.put(np.arange(300, 5000, dtype=np.int64),
+              rng.standard_normal((4700, 32)).astype(np.float32))
+    assert store.vecs_blk.shape[1] == store.capacity
+    np.testing.assert_array_equal(
+        np.asarray(from_blocked(store.vecs_blk, 32))[slots[1]], v2[1]
+    )
+
+
+def test_blocked_sq_store_codes_and_decoded_norms(small_dim_block):
+    rng = np.random.default_rng(3)
+    store = SqSlotStore(32, capacity=4096, blocked=True)
+    v = rng.standard_normal((200, 32)).astype(np.float32)
+    store.put(np.arange(200, dtype=np.int64), v)
+    codes = np.asarray(store.vecs[:200])
+    np.testing.assert_array_equal(
+        np.asarray(from_blocked(store.vecs_blk, 32))[:200], codes
+    )
+    deq = store.decode(codes)
+    np.testing.assert_allclose(
+        np.asarray(store.bsq_blk)[:, :200], block_sqnorms(deq, 8), rtol=1e-5
+    )
+
+
+def test_binary_and_host_stores_skip_mirror(small_dim_block):
+    from dingo_tpu.index.slot_store import HostSlotStore
+
+    assert SlotStore(32, jnp.int8, blocked=True).vecs_blk is None
+    assert HostSlotStore(32, blocked=True).vecs_blk is None
+
+
+def test_snapshot_round_trip_with_layout_metadata(tmp_path,
+                                                  small_dim_block):
+    import json
+    import os
+
+    from dingo_tpu.index.flat import TpuFlat
+
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((500, 32)).astype(np.float32)
+    ids = np.arange(500, dtype=np.int64)
+    FLAGS.set("vector_blocked_layout", True)
+    try:
+        idx = TpuFlat(1, IndexParameter(index_type=IndexType.FLAT,
+                                        dimension=32))
+        idx.upsert(ids, x)
+        assert idx.store.vecs_blk is not None
+        want = [list(r.ids) for r in idx.search(x[:4], 5)]
+        idx.save(str(tmp_path))
+        with open(os.path.join(str(tmp_path), "meta.json")) as f:
+            meta = json.load(f)
+        assert meta["blocked_layout"] is True and meta["dim_block"] == 8
+        idx2 = TpuFlat(1, IndexParameter(index_type=IndexType.FLAT,
+                                         dimension=32))
+        idx2.load(str(tmp_path))
+        # the mirror rebuilds at load time and rows restore bit-exactly
+        assert idx2.store.vecs_blk is not None
+        np.testing.assert_array_equal(
+            np.asarray(from_blocked(idx2.store.vecs_blk, 32))[:500],
+            np.asarray(idx2.store.vecs[:500]),
+        )
+        assert [list(r.ids) for r in idx2.search(x[:4], 5)] == want
+    finally:
+        FLAGS.set("vector_blocked_layout", "auto")

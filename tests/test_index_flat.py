@@ -252,7 +252,7 @@ def test_fused_pallas_path_matches_xla(corpus):
     try:
         got = idx.search(x[:4], 7)
     finally:
-        FLAGS.set("use_pallas_fused_search", False)
+        FLAGS.set("use_pallas_fused_search", "auto")
     for a, b in zip(want, got):
         np.testing.assert_array_equal(a.ids, b.ids)
         np.testing.assert_allclose(a.distances, b.distances, rtol=5e-3,

@@ -85,7 +85,7 @@ def test_pallas_paths_accept_bf16_stores():
     try:
         got = [list(r.ids) for r in flat.search(x[:4], 5)]
     finally:
-        FLAGS.set("use_pallas_fused_search", False)
+        FLAGS.set("use_pallas_fused_search", "auto")
     assert want == got
 
     ivf = TpuIvfFlat(6, IndexParameter(

@@ -74,6 +74,19 @@ FAMILY_NAMES = {
         "flight.bundles",        # captured bundles by reason
         "flight.suppressed",     # rate-limited triggers by reason
     },
+    "ivf": {
+        "ivf.inplace_appends",      # view maintenance (PR 3)
+        "ivf.tombstones",
+        "ivf.compactions",
+        "ivf.full_rebuild",
+        "ivf.tombstone_ratio",
+        "ivf.filter_mask_hits",     # filter-mask cache
+        "ivf.filter_mask_misses",
+        "ivf.pruned_dim_fraction",  # early-pruning scan: fraction of
+                                    # (candidate, dim-block) work skipped
+        "ivf.pruned_candidates",    # candidates dropped before their
+                                    # last dimension block
+    },
 }
 
 
