@@ -321,6 +321,196 @@ def pruning_sweep(platform):
     return out
 
 
+def _mesh_corpus(n, d, seed=5):
+    """Deterministic clustered corpus shared by every mesh_scaling child —
+    identical bytes at every device count, so shortlists must match."""
+    rng = np.random.default_rng(seed)
+    ncl = max(32, n // 1000)
+    centers = rng.standard_normal((ncl, d), dtype=np.float32)
+    x = centers[rng.integers(0, ncl, n)] + 0.3 * rng.standard_normal(
+        (n, d)
+    ).astype(np.float32)
+    ids = np.arange(n, dtype=np.int64)
+    queries = x[rng.choice(n, 64, replace=False)] + 0.05 * (
+        rng.standard_normal((64, d)).astype(np.float32)
+    )
+    return ids, x, queries
+
+
+def mesh_scaling_child(n_devices: int) -> int:
+    """Subprocess body for one mesh_scaling point: pin a virtual CPU
+    platform with n_devices, serve FLAT + IVF_FLAT mesh-sharded over a
+    data-axis mesh of that width, and print ONE JSON line with QPS,
+    steady-state recompiles, and a shortlist checksum (the n_devices=1
+    point IS the single-device path, so equal checksums across points ==
+    exact-parity collective merges)."""
+    import hashlib
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    want = f"--xla_force_host_platform_device_count={n_devices}"
+    if want not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + want
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < n_devices:
+        print(json.dumps({
+            "n_devices": n_devices,
+            "error": f"only {len(jax.devices())} devices (backend was "
+                     "already initialized?)",
+        }))
+        return 1
+    from dingo_tpu.common.metrics import METRICS
+    from dingo_tpu.index.base import IndexParameter, IndexType
+    from dingo_tpu.parallel.sharded_flat import TpuShardedFlat
+    from dingo_tpu.parallel.sharded_ivf import TpuShardedIvfFlat
+    from dingo_tpu.parallel.sharded_store import make_mesh
+
+    n = int(os.environ.get("DINGO_BENCH_MESH_N", 16384))
+    d = int(os.environ.get("DINGO_BENCH_MESH_D", 64))
+    nlist = int(os.environ.get("DINGO_BENCH_MESH_NLIST", 64))
+    iters = int(os.environ.get("DINGO_BENCH_MESH_ITERS", 8))
+    k = 10
+    ids, x, queries = _mesh_corpus(n, d)
+    mesh = make_mesh(n_devices, data=n_devices, dim=1)
+    out = {"n_devices": n_devices, "n": n, "d": d}
+    dmat = (
+        (queries ** 2).sum(1)[:, None] - 2.0 * queries @ x.T
+        + (x ** 2).sum(1)[None, :]
+    )
+    exact = ids[np.argsort(dmat, axis=1)[:, :k]]
+    for kind in ("flat", "ivf_flat"):
+        if kind == "flat":
+            idx = TpuShardedFlat(1, IndexParameter(
+                index_type=IndexType.FLAT, dimension=d,
+            ), mesh=mesh)
+        else:
+            idx = TpuShardedIvfFlat(2, IndexParameter(
+                index_type=IndexType.IVF_FLAT, dimension=d,
+                ncentroids=nlist, default_nprobe=16,
+            ), mesh=mesh)
+        idx.reserve(n + 1)
+        idx.upsert(ids, x)
+        if kind == "ivf_flat":
+            # EXPLICIT train set -> deterministic single-device k-means ->
+            # identical centroids/probes at every device count, so the
+            # checksum-parity contract extends to the approximate index
+            idx.train(x[:: max(1, n // 8192)])
+        for _ in range(2):
+            idx.search(queries, k)       # warm the shape buckets
+        rc_c = METRICS.counter("xla.recompiles")
+        rc0 = rc_c.get()
+        mb_c = METRICS.counter("mesh.merge_bytes", region_id=idx.id)
+        mb0 = mb_c.get()
+        t0 = time.perf_counter()
+        thunks = [idx.search_async(queries, k) for _ in range(iters)]
+        outs = [t() for t in thunks]
+        dt = (time.perf_counter() - t0) / iters
+        res_ids = np.asarray([r.ids for r in outs[-1]])
+        row = {
+            "qps": round(len(queries) / dt, 1),
+            "ms_per_batch": round(dt * 1e3, 2),
+            "steady_state_recompiles": int(rc_c.get() - rc0),
+            "merge_bytes_per_search": int(
+                (mb_c.get() - mb0) // max(1, iters)
+            ),
+            "ids_sha1": hashlib.sha1(
+                np.ascontiguousarray(res_ids)
+            ).hexdigest()[:16],
+        }
+        if kind == "flat":
+            row["exact_parity"] = bool((res_ids == exact).all())
+        else:
+            row["recall_at_10"] = round(float(np.mean([
+                len(set(r) & set(g)) / k for r, g in zip(res_ids, exact)
+            ])), 4)
+        out[kind] = row
+    print(json.dumps(out))
+    return 0
+
+
+def mesh_scaling(platform):
+    """ISSUE 7 tentpole bench arm: QPS vs virtual device count for the
+    mesh-sharded indexes, one SUBPROCESS per point (the forced host
+    device count must be set before jax initializes). Parity contract:
+    every point must produce byte-identical shortlists (the 1-device
+    point is the single-device path). On this host the numbers measure
+    collective-merge overhead, not speedup — one physical core executes
+    all virtual devices serially; scaling_efficiency is still reported
+    so the same rows read correctly on a real multi-chip lease."""
+    import subprocess
+
+    counts = [
+        int(c) for c in os.environ.get(
+            "DINGO_BENCH_MESH_DEVICES", "1,2,4,8"
+        ).split(",")
+    ]
+    points = []
+    me = os.path.abspath(__file__)
+    for nd in counts:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={nd}"
+        ).strip()
+        try:
+            p = subprocess.run(
+                [sys.executable, me, "--mesh-child", str(nd)],
+                capture_output=True, text=True, timeout=600, env=env,
+            )
+            line = p.stdout.strip().splitlines()[-1] if p.stdout.strip() \
+                else ""
+            point = json.loads(line) if line.startswith("{") else {
+                "n_devices": nd, "error": p.stderr[-300:],
+            }
+        except subprocess.TimeoutExpired:
+            point = {"n_devices": nd, "error": "timeout"}
+        points.append(point)
+        log(f"mesh_scaling {nd}dev: "
+            + (f"flat {point['flat']['qps']:,.0f} QPS, ivf "
+               f"{point['ivf_flat']['qps']:,.0f} QPS"
+               if "flat" in point else f"error {point.get('error')!r}"))
+    ok = [p for p in points if "flat" in p]
+    base = next((p for p in ok if p["n_devices"] == 1), None)
+    out = {
+        "host_physical_cores": os.cpu_count(),
+        "points": points,
+        # byte-identical shortlists across device counts (vs the 1-device
+        # = single-device path) — the collective merge's parity gate
+        "shortlist_parity": {
+            kind: len({p[kind]["ids_sha1"] for p in ok}) <= 1
+            for kind in ("flat", "ivf_flat")
+        } if ok else {},
+        "steady_state_recompiles": int(sum(
+            p[kind]["steady_state_recompiles"]
+            for p in ok for kind in ("flat", "ivf_flat")
+        )) if ok else None,
+    }
+    if base and len(ok) > 1:
+        out["scaling_efficiency"] = {
+            kind: {
+                str(p["n_devices"]): round(
+                    p[kind]["qps"]
+                    / (p["n_devices"] * base[kind]["qps"]), 3
+                )
+                for p in ok
+            }
+            for kind in ("flat", "ivf_flat")
+        }
+        if os.cpu_count() == 1:
+            out["note"] = (
+                "single-core host: all virtual devices execute serially, "
+                "so fixed-corpus QPS cannot scale with device count here; "
+                "these rows validate collective-merge parity + the "
+                "zero-recompile steady state, and the efficiency figures "
+                "become meaningful on a real multi-chip lease"
+            )
+    return out
+
+
 def main():
     # With a cached TPU result on hand a short probe suffices; without one,
     # keep the generous window — a live run is strictly better than a cache.
@@ -522,6 +712,9 @@ def main():
     # --- pruning sweep: blocked-scan early pruning on vs off (ISSUE 6) ---
     prune = pruning_sweep(platform)
 
+    # --- mesh scaling: QPS vs device count, subprocess per point (ISSUE 7) ---
+    mesh = mesh_scaling(platform)
+
     # --- CPU baseline: numpy/OpenBLAS IVF-flat with same layout ---
     centroids = np.asarray(idx.centroids)
     assign = idx._assign_h[np.asarray(idx.store.slots_of(ids))]
@@ -606,6 +799,10 @@ def main():
         # pruned kernel on vs off + mean scanned-dim fraction per tier
         # (< 1.0 = the partial-distance bound demonstrably drops work)
         "pruning_sweep": prune,
+        # mesh serving tier (ISSUE 7): QPS vs forced-host-device count
+        # with shortlist-parity + zero-recompile gates; on-chip these
+        # rows become the 1 -> N device scaling story
+        "mesh_scaling": mesh,
     }
     if platform == "tpu":
         result["measured_at"] = time.time()
@@ -618,4 +815,10 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--mesh-child":
+        sys.exit(mesh_scaling_child(int(sys.argv[2])))
+    if len(sys.argv) >= 2 and sys.argv[1] == "--mesh-scaling":
+        # standalone: just the mesh_scaling block (MULTICHIP runs)
+        print(json.dumps({"mesh_scaling": mesh_scaling("cpu")}))
+        sys.exit(0)
     main()

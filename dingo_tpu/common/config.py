@@ -124,6 +124,42 @@ FLAGS.define("use_mesh_sharded_ivfpq", False, mutable=True,
 FLAGS.define("mesh_dim_axis", 1, mutable=True,
              help_="size of the mesh 'dim' (tensor-parallel) axis used by "
                    "mesh-sharded indexes; 'data' axis = n_devices // dim")
+FLAGS.define("mesh_batch_axis", 1, mutable=True,
+             help_="size of the mesh 'batch' (query data-parallel) axis: "
+                   "coalesced query batches split across batch replicas "
+                   "while every replica scans the full set of row shards; "
+                   "vector state replicates over this axis (read scaling). "
+                   "Must be a power of two so the shape-bucket ladder's "
+                   "pow2 batch padding stays divisible; 1 disables")
+FLAGS.define("mesh_replicas", 1, mutable=True,
+             help_="replica-group fan-out for mesh-sharded regions: the "
+                   "factory builds this many full index replicas on "
+                   "disjoint device slices and routes searches across "
+                   "them (parallel/replica_group.py); writes fan out to "
+                   "every member; 1 disables")
+FLAGS.define("mesh_replica_route", "rr", mutable=True,
+             help_="replica-group routing policy: 'rr' (round robin) or "
+                   "'load' (fewest in-flight searches)")
+FLAGS.define("mesh_collective_merge", True, mutable=True,
+             help_="merge per-shard shortlists ON DEVICE with an in-jit "
+                   "all_gather + top_k (the ICI path). Off = the capped "
+                   "fallback: each shard ships only its local [b, k] "
+                   "shortlist to the host, merged there (debug/A-B arm; "
+                   "never transfers full score matrices either way)")
+FLAGS.define("balance_replica_mode", "off", mutable=True,
+             help_="coordinator replica planning: 'off' or 'auto' (scale "
+                   "a region's read-replica count from its measured QPS "
+                   "via the store-metrics plane; placement picks the "
+                   "least-loaded stores)")
+FLAGS.define("balance_replica_qps_target", 50.0, mutable=True,
+             help_="replica planning aims for at most this many QPS per "
+                   "replica before adding another (auto mode)")
+FLAGS.define("ivf_prune_inbucket_bound", True, mutable=True,
+             help_="pruned-scan kernels refresh the k-th-best bound "
+                   "BETWEEN dimension blocks inside a bucket/row-block "
+                   "from the candidates' own suffix-norm lower bounds "
+                   "(PDX finer-grained threshold), not only from shortlist "
+                   "merges at bucket boundaries; off = PR 6 behavior")
 FLAGS.define("metrics_collect_interval_s", 5.0, mutable=True,
              help_="StoreMetricsCollector crontab period; heartbeats also "
                    "refresh snapshots older than this so beats never ship "

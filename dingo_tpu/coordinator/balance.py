@@ -29,6 +29,19 @@ class MoveRegionOp:
     to_store: str
 
 
+@dataclasses.dataclass
+class ScaleReplicaOp:
+    """Grow/shrink a region's replica set for read scaling (the mesh
+    serving tier's coordinator arm): peers to add ride first, drops later —
+    raft membership changes stay one server at a time."""
+
+    region_id: int
+    current: int
+    target: int
+    add_stores: List[str]
+    drop_stores: List[str]
+
+
 #: load-aware weight: one load unit per this many index bytes (memory is a
 #: capacity signal alongside QPS — a cold 4GB leader still costs HBM)
 LOAD_BYTES_PER_UNIT = 64 * 1024 * 1024
@@ -36,6 +49,18 @@ LOAD_BYTES_PER_UNIT = 64 * 1024 * 1024
 #: acting on them would churn leadership for nothing (count mode's
 #: `n_most <= n_least + 1` dead band, translated to load units)
 MIN_LOAD_GAP = 1.0
+
+
+def fresh_store_metrics(control: CoordinatorControl):
+    """store_id -> snapshot for every ALIVE store with non-stale metrics
+    (the one trust filter both the load balancer and the replica planner
+    apply — staleness semantics must not diverge between schedulers)."""
+    alive = {s.store_id for s in control.alive_stores()}
+    rows = control.get_store_metrics()
+    return {
+        sid: snap for sid, snap, _at, stale in rows
+        if not stale and sid in alive
+    }
 
 
 class BalanceLeaderScheduler:
@@ -60,11 +85,7 @@ class BalanceLeaderScheduler:
         """store_id -> {led region_id -> weight}; None when any alive
         store lacks fresh metrics (fall back to count mode)."""
         alive = {s.store_id for s in self.control.alive_stores()}
-        rows = self.control.get_store_metrics()
-        fresh = {
-            sid: snap for sid, snap, _at, stale in rows
-            if not stale and sid in alive
-        }
+        fresh = fresh_store_metrics(self.control)
         if alive - set(fresh):
             return None
         out: Dict[str, Dict[int, float]] = {}
@@ -154,6 +175,117 @@ class BalanceLeaderScheduler:
         ops = self.plan()
         for op in ops:
             self.control.transfer_leader(op.region_id, op.to_store)
+        return len(ops)
+
+
+class ReplicaPlanScheduler:
+    """Scale a region's read-replica count from its measured QPS
+    (`balance.replica_mode = auto`): regions hotter than
+    `balance.replica_qps_target` per replica gain replicas on the
+    least-loaded stores; regions that cooled back down drop follower
+    replicas from the most-loaded stores — never below the cluster's
+    configured raft replication (the base peers are quorum, not elastic
+    read capacity). The store-side mechanism is
+    parallel/replica_group.py (device slices) or extra raft followers
+    serving follower reads — this tier only decides COUNT and PLACEMENT
+    from the heartbeat metrics plane, like the reference's region
+    scheduler family."""
+
+    def __init__(self, control: CoordinatorControl,
+                 mode: Optional[str] = None,
+                 qps_target: Optional[float] = None,
+                 max_replicas: int = 5):
+        self.control = control
+        self._mode = mode
+        self._qps_target = qps_target
+        self.max_replicas = max_replicas
+
+    def _flag(self, name: str, override):
+        if override is not None:
+            return override
+        from dingo_tpu.common.config import FLAGS
+
+        return FLAGS.get(name)
+
+    def plan(self) -> List[ScaleReplicaOp]:
+        mode = self._flag("balance_replica_mode", self._mode)
+        if mode != "auto":
+            return []
+        target_qps = float(
+            self._flag("balance_replica_qps_target", self._qps_target)
+        )
+        fresh = fresh_store_metrics(self.control)
+        if not fresh:
+            return []    # planning replicas on dead figures is worse than none
+        # store load (for placement) + per-region leader QPS (for sizing)
+        store_load = {
+            sid: sum(
+                rm.search_qps
+                + (rm.vector_memory_bytes + rm.device_memory_bytes)
+                / LOAD_BYTES_PER_UNIT
+                for rm in snap.regions
+            )
+            for sid, snap in fresh.items()
+        }
+        region_qps = {}
+        for sid, snap in fresh.items():
+            for rm in snap.regions:
+                if rm.is_leader:
+                    region_qps[rm.region_id] = rm.search_qps
+        # NEVER shrink below the cluster's configured raft replication:
+        # the base peers are write durability / quorum, only replicas the
+        # planner ADDED beyond that are elastic read capacity. (Without
+        # this floor every quiet region would erode to a single peer.)
+        floor = max(1, int(getattr(self.control, "replication", 1) or 1))
+        ops: List[ScaleReplicaOp] = []
+        for rid, qps in sorted(region_qps.items()):
+            definition = self.control.regions.get(rid)
+            if definition is None:
+                continue
+            peers = list(definition.peers)
+            current = len(peers)
+            want = max(1, -(-int(qps) // max(1, int(target_qps))))
+            target = min(max(want, floor), max(self.max_replicas, floor))
+            # hysteresis: one-step moves only, and never below the raft
+            # quorum floor the region was created with is the control
+            # plane's concern — this planner only adds/removes ONE peer
+            # per tick so a QPS spike can't thrash membership
+            if target > current:
+                cand = sorted(
+                    (s for s in store_load if s not in peers),
+                    key=lambda s: store_load[s],
+                )
+                if not cand:
+                    continue
+                ops.append(ScaleReplicaOp(
+                    rid, current, current + 1, [cand[0]], []
+                ))
+            elif target < current and current > floor:
+                leader = next(
+                    (s.store_id for s in self.control.alive_stores()
+                     if rid in s.leader_region_ids), None
+                )
+                followers = [s for s in peers if s != leader]
+                if not followers:
+                    continue
+                drop = max(
+                    followers, key=lambda s: store_load.get(s, 0.0)
+                )
+                ops.append(ScaleReplicaOp(
+                    rid, current, current - 1, [], [drop]
+                ))
+        return ops
+
+    def dispatch(self) -> int:
+        ops = self.plan()
+        for op in ops:
+            peers = list(self.control.regions[op.region_id].peers)
+            for s in op.add_stores:
+                peers = peers + [s]
+                self.control.change_peer(op.region_id, peers)
+            for s in op.drop_stores:
+                peers = [p for p in peers if p != s]
+                self.control.change_peer(op.region_id, peers)
         return len(ops)
 
 

@@ -17,8 +17,19 @@ def _sharded_if_enabled(flag: str, index_id: int, parameter: IndexParameter):
         return None
     import jax
 
-    if len(jax.devices()) <= 1:
+    devs = jax.devices()
+    if len(devs) <= 1:
         return None
+    replicas = int(FLAGS.get("mesh_replicas") or 1)
+    if replicas > 1:
+        if len(devs) % replicas:
+            raise InvalidParameter(
+                f"mesh_replicas={replicas} does not divide the "
+                f"{len(devs)}-device set"
+            )
+        from dingo_tpu.parallel.replica_group import ReplicaGroup
+
+        return ReplicaGroup(index_id, parameter, replicas=replicas)
     if flag == "use_mesh_sharded_flat":
         from dingo_tpu.parallel.sharded_flat import TpuShardedFlat as cls
     elif flag == "use_mesh_sharded_ivfpq":

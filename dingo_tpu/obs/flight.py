@@ -100,8 +100,10 @@ class FlightRecorder:
                    and now - self._ticks[1][0] > window):
                 self._ticks.popleft()
 
-    def _metrics_delta(self) -> Dict[str, Any]:
-        now_flat = _flatten_numeric(self.registry.dump())
+    def _metrics_delta(self, now_flat: Optional[Dict[str, float]] = None
+                       ) -> Dict[str, Any]:
+        if now_flat is None:
+            now_flat = _flatten_numeric(self.registry.dump())
         with self._lock:
             base = self._ticks[0] if self._ticks else None
         if base is None:
@@ -116,6 +118,14 @@ class FlightRecorder:
             "window_s": round(time.monotonic() - base_t, 1),
             "deltas": deltas,
         }
+
+    def _mesh_state(self, now_flat: Dict[str, float]) -> Dict[str, Any]:
+        """Current mesh.* series values (per-shard live rows, skew ratio,
+        replica routing counters) — ABSOLUTE values, unlike the delta
+        window: a slow-query bundle must show the shard balance at
+        capture time, not only how it moved during the window. Shares
+        the capture's single registry dump."""
+        return {k: v for k, v in now_flat.items() if k.startswith("mesh.")}
 
     # ---- triggers ----------------------------------------------------------
     def on_slow_query(self, rec: Dict[str, Any]) -> str:
@@ -240,6 +250,10 @@ class FlightRecorder:
                 config["node"] = {"error": "config provider failed"}
 
         bid = _bundle_id()
+        # ONE registry dump per capture, shared by the delta window and
+        # the absolute mesh state (capture fires exactly when the store
+        # is struggling — don't walk the registry twice)
+        now_flat = _flatten_numeric(self.registry.dump())
         payload = {
             "id": bid,
             "reason": reason,
@@ -251,9 +265,10 @@ class FlightRecorder:
             "spans": spans,
             "spans_fallback": spans_fallback,
             "slow_queries": TRACE_BUFFER.slow_queries()[-8:],
-            "metrics": self._metrics_delta(),
+            "metrics": self._metrics_delta(now_flat),
             "kernel_cache": SENTINEL.state(),
             "hbm": HBM.state(),
+            "mesh": self._mesh_state(now_flat),
             "config": config,
         }
         blob = zlib.compress(

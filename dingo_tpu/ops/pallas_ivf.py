@@ -215,7 +215,7 @@ def _pad_rows(queries, vprobes):
 
 def _ivf_pruned_kernel(vp_ref, q_ref, qsq_ref, qpsq_ref, x_ref, bsq_ref,
                        xsq_ref, val_ref, slot_ref, *rest,
-                       k, ascending, nblk, check_every, sq):
+                       k, ascending, nblk, check_every, sq, inbucket):
     """Dimension-blocked early-pruning list scan (PDX on TPU).
 
     Grid (q, r, jb) with the dimension block jb INNERMOST: for each probed
@@ -235,6 +235,16 @@ def _ivf_pruned_kernel(vp_ref, q_ref, qsq_ref, qpsq_ref, x_ref, bsq_ref,
     A candidate is pruned only when its upper bound is STRICTLY below the
     running k-th best, so results match the non-pruning kernels exactly
     (up to f32 partial-sum rounding on the reported distances).
+
+    With `inbucket` (FLAGS.ivf_prune_inbucket_bound) the threshold also
+    REFRESHES between dimension blocks inside a bucket: every alive
+    candidate carries a suffix-norm LOWER bound of its final score
+    (L2: dist <= partial + (|q_tail| + |x_tail|)^2 by the triangle
+    inequality; IP: dot >= cum - |q_tail||x_tail| by Cauchy-Schwarz), and
+    the k-th largest lower bound among them is a valid prune threshold
+    even though none of these candidates has reached the shortlist merge
+    yet. Early buckets — where the output block still reads -inf — start
+    pruning from block 1 instead of scanning fully.
 
     Stats output lanes (accumulated per query): 0 = candidate-block pairs
     actually scanned, 1 = candidate-block pairs total, 2 = candidates
@@ -302,23 +312,43 @@ def _ivf_pruned_kernel(vp_ref, q_ref, qsq_ref, qpsq_ref, x_ref, bsq_ref,
             xpsq[:] += bsq_ref[0]
             bound = outv_ref[row, :][:, k - 1:k]       # running k-th best
             qpsq_j = qpsq_ref[row, :]                  # [1, 1] prefix
+            qtail = jnp.maximum(qsq_ref[row, :] - qpsq_j, 0.0)
+            xtail = jnp.maximum(xsq_ref[0] - xpsq[:], 0.0)
             if ascending:
                 partial = qpsq_j - 2.0 * cum[:] + xpsq[:]
                 ub = -partial
                 final = ub
             else:
-                qtail = qsq_ref[row, :] - qpsq_j
-                xtail = xsq_ref[0] - xpsq[:]
-                ub = cum[:] + jnp.sqrt(
-                    jnp.maximum(qtail, 0.0) * jnp.maximum(xtail, 0.0)
-                )
+                ub = cum[:] + jnp.sqrt(qtail * xtail)
                 final = cum[:]
 
-            @pl.when(jb < nblk - 1)
+            @pl.when((jb < nblk - 1)
+                     & (jax.lax.rem(jb + 1, check_every) == 0))
             def _prune():
-                do_check = jax.lax.rem(jb + 1, check_every) == 0
-                dead = do_check & (ub < bound)
-                alive[:] = jnp.where(dead, 0.0, alive[:])
+                bnd = bound
+                if inbucket:
+                    # within-bucket refresh (PDX finer threshold): each
+                    # alive candidate's final score is >= its suffix-norm
+                    # LOWER bound, so the k-th largest lower bound among
+                    # this bucket's alive candidates is itself a valid
+                    # prune threshold — usable blocks before any of them
+                    # reaches the shortlist merge. A candidate can never
+                    # prune itself: ub >= lb always, so ub < kth-lb
+                    # implies its own lb is below the top-k lb set.
+                    if ascending:
+                        tail = jnp.sqrt(qtail) + jnp.sqrt(xtail)
+                        lb = -(partial + tail * tail)
+                    else:
+                        lb = cum[:] - jnp.sqrt(qtail * xtail)
+                    # f32 safety shave: the bound math is exact in real
+                    # arithmetic; keep rounding on the conservative side
+                    lb = lb - 1e-5 * jnp.abs(lb) - 1e-6
+                    lb = jnp.where(alive[:] > 0.5, lb, NEG_INF)
+                    lb_k, _ = _select_topk(
+                        lb, slot_ref[0].astype(jnp.int32), k
+                    )
+                    bnd = jnp.maximum(bnd, lb_k[:, k - 1:k])
+                alive[:] = jnp.where(ub < bnd, 0.0, alive[:])
 
             @pl.when(jb == nblk - 1)
             def _merge():
@@ -347,7 +377,7 @@ def _ivf_pruned_kernel(vp_ref, q_ref, qsq_ref, qpsq_ref, x_ref, bsq_ref,
 
 @sentinel_jit("ops.pallas.ivf_pruned_topk",
               static_argnames=("k", "ascending", "dim_block", "check_every",
-                               "interpret", "nq", "sq"))
+                               "interpret", "nq", "sq", "inbucket"))
 def ivf_pruned_topk(
     vprobes: jax.Array,        # [b, budget] int32 virtual bucket ids (-1 pad)
     queries: jax.Array,        # [b, d] f32
@@ -366,6 +396,7 @@ def ivf_pruned_topk(
     interpret: bool = False,
     nq: int = 0,
     sq: bool = False,
+    inbucket: bool = True,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Early-pruning probed-bucket scan -> (scores, slots, stats).
 
@@ -445,7 +476,7 @@ def ivf_pruned_topk(
     out_v, out_i, out_s = pl.pallas_call(
         functools.partial(
             _ivf_pruned_kernel, k=k, ascending=ascending, nblk=nblk,
-            check_every=check_every, sq=sq,
+            check_every=check_every, sq=sq, inbucket=inbucket,
         ),
         grid_spec=grid_spec,
         out_shape=[
@@ -479,6 +510,7 @@ def ivf_pruned_search(
         bucket_valid, bucket_slot, sq_vmin, sq_scale,
         k=k, dim_block=dim_block, ascending=ascending, check_every=check,
         interpret=interpret, nq=b, sq=sq_vmin is not None,
+        inbucket=bool(FLAGS.get("ivf_prune_inbucket_bound")),
     )
     from dingo_tpu.ops.distance import device_wait_span
 

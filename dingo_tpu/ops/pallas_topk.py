@@ -182,7 +182,7 @@ STATS_PAD = 128
 
 def _pruned_fused_kernel(q_ref, qsq_ref, qpsq_ref, x_ref, bsq_ref, xsq_ref,
                          valid_ref, *rest, k, block, nblk, check_every,
-                         ascending, sq):
+                         ascending, sq, inbucket):
     """Dimension-blocked early-pruning whole-index scan (the FLAT arm of
     the PDX scheme — see ops/pallas_ivf._ivf_pruned_kernel for the bound
     math). Grid (row_block j, dim_block jb) with jb INNERMOST: partial
@@ -257,22 +257,39 @@ def _pruned_fused_kernel(q_ref, qsq_ref, qpsq_ref, x_ref, bsq_ref, xsq_ref,
         cum[:] += dots
         xpsq[:] += bsq_ref[0]                              # [1, block]
         bound = best_v[:, k - 1:k]                         # [b, 1]
+        qtail = jnp.maximum(qsq_ref[:] - qpsq_ref[:], 0.0)  # [b, 1]
+        xtail = jnp.maximum(xsq_ref[:] - xpsq[:], 0.0)      # [1, block]
         if ascending:
             partial = qpsq_ref[:] - 2.0 * cum[:] + xpsq[:]
             ub = -partial
             final = ub
         else:
-            qtail = qsq_ref[:] - qpsq_ref[:]               # [b, 1]
-            xtail = xsq_ref[:] - xpsq[:]                   # [1, block]
-            ub = cum[:] + jnp.sqrt(
-                jnp.maximum(qtail, 0.0) * jnp.maximum(xtail, 0.0)
-            )
+            ub = cum[:] + jnp.sqrt(qtail * xtail)
             final = cum[:]
 
-        @pl.when(jb < nblk - 1)
+        @pl.when((jb < nblk - 1)
+                 & (jax.lax.rem(jb + 1, check_every) == 0))
         def _prune():
-            do_check = jax.lax.rem(jb + 1, check_every) == 0
-            alive[:] = jnp.where(do_check & (ub < bound), 0.0, alive[:])
+            bnd = bound
+            if inbucket:
+                # within-row-block threshold refresh: the k-th largest
+                # suffix-norm LOWER bound among alive candidates prunes
+                # blocks before any of them reaches a shortlist merge
+                # (see ops/pallas_ivf._ivf_pruned_kernel for the math
+                # and the self-prune impossibility argument)
+                if ascending:
+                    tail = jnp.sqrt(qtail) + jnp.sqrt(xtail)
+                    lb = -(partial + tail * tail)
+                else:
+                    lb = cum[:] - jnp.sqrt(qtail * xtail)
+                lb = lb - 1e-5 * jnp.abs(lb) - 1e-6   # f32 safety shave
+                lb = jnp.where(alive[:] > 0.5, lb, NEG_INF)
+                gidx = jax.lax.broadcasted_iota(
+                    jnp.int32, lb.shape, 1
+                )
+                lb_k, _ = _select_topk(lb, gidx, k)
+                bnd = jnp.maximum(bnd, lb_k[:, k - 1:k])
+            alive[:] = jnp.where(ub < bnd, 0.0, alive[:])
 
         @pl.when(jb == nblk - 1)
         def _merge():
@@ -297,7 +314,7 @@ def _pruned_fused_kernel(q_ref, qsq_ref, qpsq_ref, x_ref, bsq_ref, xsq_ref,
 
 @sentinel_jit("ops.pallas.pruned_fused_topk",
               static_argnames=("k", "block", "dim_block", "check_every",
-                               "ascending", "interpret", "sq"))
+                               "ascending", "interpret", "sq", "inbucket"))
 def pruned_fused_topk(
     q: jax.Array,              # [b, d] f32
     x_blk: jax.Array,          # [nblk, n, dblk] rows (f32/bf16) or codes
@@ -313,6 +330,7 @@ def pruned_fused_topk(
     ascending: bool = True,
     interpret: bool = False,
     sq: bool = False,
+    inbucket: bool = True,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Early-pruning streaming search over the dimension-blocked store
     mirror (slot_store.vecs_blk/bsq_blk) -> (scores[b,k], slots[b,k],
@@ -351,6 +369,7 @@ def pruned_fused_topk(
         functools.partial(
             _pruned_fused_kernel, k=k, block=block, nblk=nblk,
             check_every=check_every, ascending=ascending, sq=sq,
+            inbucket=inbucket,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -402,4 +421,5 @@ def pruned_fused_search(
         sq_vmin, sq_scale,
         k=k, block=block, dim_block=int(x_blk.shape[2]), check_every=check,
         ascending=ascending, interpret=interpret, sq=sq_vmin is not None,
+        inbucket=bool(FLAGS.get("ivf_prune_inbucket_bound")),
     )

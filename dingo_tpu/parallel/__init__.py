@@ -7,9 +7,15 @@ parallelizes within a node via ThreadPools (vector_index.h:157-196
 
   sharded_store.py — one region's vectors sharded across a jax Mesh
                      (row-sharded data parallel), per-device top-k +
-                     all-gather + merge in one shard_map program.
+                     all-gather + merge in one shard_map program; optional
+                     "batch" mesh axis splits the query batch across
+                     replicas of the row shards (SPMD read scaling).
   sharded_train.py — k-means training over the mesh (psum-reduced
                      assignment statistics).
+  replica_group.py — R full index replicas on disjoint device slices with
+                     per-request routing (MPMD read scaling), the
+                     store-side mechanism behind the coordinator's
+                     replica planner.
 """
 
 from dingo_tpu.parallel.sharded_store import ShardedFlatStore  # noqa: F401

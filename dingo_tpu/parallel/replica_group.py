@@ -1,0 +1,257 @@
+"""Region replica groups: R full index replicas on disjoint device slices.
+
+The reference scales reads by placing multi-Raft region replicas across
+Store/Index nodes and routing follower reads at them (PAPER.md layer map).
+On one mesh host the analog is a ReplicaGroup: the factory carves the
+device set into R disjoint slices, builds one complete mesh-sharded index
+per slice, and routes each search at exactly one replica — writes fan out
+to every member so replicas stay bit-identical. Two knobs compose:
+
+  FLAGS.mesh_batch_axis — SPMD read scaling: ONE program whose query
+      batch splits over a "batch" mesh axis (collectives stitch the
+      result). Best when requests arrive pre-coalesced into big batches.
+  FLAGS.mesh_replicas  — MPMD read scaling (this module): independent
+      programs on disjoint devices, routed per request. Best when many
+      small batches arrive concurrently — no cross-replica collective,
+      no shared program, a wedged replica only hurts its slice.
+
+The coordinator's replica planner (coordinator/balance.py,
+`balance.replica_mode = auto`) chooses R per region from measured QPS via
+the heartbeat metrics plane; this module is the store-side mechanism.
+
+Observability: per-replica search counters / in-flight gauges / latency
+series under `mesh.replica.*` (the latency series carries the windowed
+per-replica QPS the planner and `cluster top` read).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from dingo_tpu.index.base import (
+    FilterSpec,
+    IndexParameter,
+    IndexType,
+    InvalidParameter,
+    SearchResult,
+    VectorIndex,
+)
+
+
+def _default_member_builder(index_id: int, parameter: IndexParameter,
+                            devices: Sequence) -> VectorIndex:
+    """One mesh-sharded replica on an explicit device slice. The batch
+    (and, for FLAT, dim) mesh axes COMPOSE with replication: each member
+    carves its slice into batch x data (x dim) per the serving flags —
+    indivisible combinations fail loudly instead of silently dropping an
+    axis the operator configured."""
+    from dingo_tpu.common.config import FLAGS
+    from dingo_tpu.parallel.sharded_store import make_mesh
+
+    n = len(devices)
+    batch = int(FLAGS.get("mesh_batch_axis") or 1)
+    dim = (int(FLAGS.get("mesh_dim_axis") or 1)
+           if parameter.index_type is IndexType.FLAT else 1)
+    if n % (batch * dim):
+        raise InvalidParameter(
+            f"replica slice of {n} devices does not divide by "
+            f"mesh_batch_axis={batch} x mesh_dim_axis={dim}"
+        )
+    mesh = make_mesh(
+        devices=devices, batch=batch, dim=dim, data=n // (batch * dim)
+    )
+    if parameter.index_type is IndexType.FLAT:
+        from dingo_tpu.parallel.sharded_flat import TpuShardedFlat
+
+        return TpuShardedFlat(index_id, parameter, mesh=mesh)
+    if parameter.index_type is IndexType.IVF_FLAT:
+        from dingo_tpu.parallel.sharded_ivf import TpuShardedIvfFlat
+
+        return TpuShardedIvfFlat(index_id, parameter, mesh=mesh)
+    if parameter.index_type is IndexType.IVF_PQ:
+        from dingo_tpu.parallel.sharded_pq import TpuShardedIvfPq
+
+        return TpuShardedIvfPq(index_id, parameter, mesh=mesh)
+    raise InvalidParameter(
+        f"replica groups support mesh-sharded FLAT/IVF_FLAT/IVF_PQ, "
+        f"not {parameter.index_type}"
+    )
+
+
+class ReplicaGroup(VectorIndex):
+    """R replicas of one region's index; reads route, writes fan out."""
+
+    def __init__(self, index_id: int, parameter: IndexParameter,
+                 replicas: int = 2,
+                 devices: Optional[Sequence] = None,
+                 member_builder: Optional[Callable] = None):
+        super().__init__(index_id, parameter)
+        if replicas < 1:
+            raise InvalidParameter(f"replicas {replicas} < 1")
+        if devices is None:
+            import jax
+
+            devices = jax.devices()
+        if len(devices) % replicas:
+            raise InvalidParameter(
+                f"{len(devices)} devices not divisible by "
+                f"{replicas} replicas"
+            )
+        per = len(devices) // replicas
+        build = member_builder or _default_member_builder
+        self.members: List[VectorIndex] = [
+            build(index_id, parameter, devices[r * per:(r + 1) * per])
+            for r in range(replicas)
+        ]
+        self._rr = 0
+        self._inflight = [0] * replicas
+        self._lock = threading.Lock()
+        from dingo_tpu.common.metrics import METRICS
+
+        METRICS.gauge("mesh.replicas", region_id=index_id).set(
+            float(replicas)
+        )
+
+    @property
+    def replicas(self) -> int:
+        return len(self.members)
+
+    # -- routing -------------------------------------------------------------
+    def _route(self) -> int:
+        """Pick a replica: 'rr' round-robin, or 'load' = fewest searches
+        currently in flight (a replica stuck on a slow scan stops
+        receiving until it drains)."""
+        from dingo_tpu.common.config import FLAGS
+
+        with self._lock:
+            if FLAGS.get("mesh_replica_route") == "load":
+                r = int(np.argmin(self._inflight))
+            else:
+                r = self._rr % len(self.members)
+                self._rr += 1
+            self._inflight[r] += 1
+            return r
+
+    def _begin(self, r: int):
+        from dingo_tpu.common.metrics import METRICS
+
+        METRICS.counter("mesh.replica.searches", region_id=self.id,
+                        labels={"replica": str(r)}).add(1)
+        METRICS.gauge("mesh.replica.inflight", region_id=self.id,
+                      labels={"replica": str(r)}).set(
+            float(self._inflight[r])
+        )
+        return time.perf_counter()
+
+    def _finish(self, r: int, t0: float) -> None:
+        from dingo_tpu.common.metrics import METRICS
+
+        with self._lock:
+            self._inflight[r] -= 1
+            inflight = self._inflight[r]
+        METRICS.latency("mesh.replica.search_ms", region_id=self.id,
+                        labels={"replica": str(r)}).observe_us(
+            (time.perf_counter() - t0) * 1e6
+        )
+        METRICS.gauge("mesh.replica.inflight", region_id=self.id,
+                      labels={"replica": str(r)}).set(float(inflight))
+
+    # -- queries -------------------------------------------------------------
+    def search_async(self, queries, topk,
+                     filter_spec: Optional[FilterSpec] = None, **kw):
+        r = self._route()
+        t0 = self._begin(r)
+        member = self.members[r]
+        try:
+            if hasattr(member, "search_async"):
+                inner = member.search_async(
+                    queries, topk, filter_spec, **kw
+                )
+            else:
+                res = member.search(queries, topk, filter_spec, **kw)
+                inner = lambda: res  # noqa: E731
+        except BaseException:
+            self._finish(r, t0)
+            raise
+
+        def resolve() -> List[SearchResult]:
+            try:
+                return inner()
+            finally:
+                self._finish(r, t0)
+
+        return resolve
+
+    def search(self, queries, topk,
+               filter_spec: Optional[FilterSpec] = None, **kw):
+        return self.search_async(queries, topk, filter_spec, **kw)()
+
+    # -- mutation: fan out so replicas stay identical ------------------------
+    def add(self, ids, vectors) -> None:
+        for m in self.members:
+            m.add(ids, vectors)
+
+    def upsert(self, ids, vectors) -> None:
+        for m in self.members:
+            m.upsert(ids, vectors)
+
+    def delete(self, ids):
+        return [m.delete(ids) for m in self.members][0]
+
+    def need_train(self) -> bool:
+        return self.members[0].need_train()
+
+    def is_trained(self) -> bool:
+        return all(m.is_trained() for m in self.members)
+
+    def train(self, vectors: Optional[np.ndarray] = None) -> None:
+        """Fan out; members train deterministically (seed = index id over
+        identical rows), so replicas end with the same model state and
+        answer identically."""
+        for m in self.members:
+            m.train(vectors) if vectors is not None else m.train()
+
+    def reserve(self, n: int) -> None:
+        for m in self.members:
+            if hasattr(m, "reserve"):
+                m.reserve(n)
+
+    # -- lifecycle -----------------------------------------------------------
+    def save(self, path: str) -> None:
+        # replicas are write-identical; one copy on disk is the snapshot
+        self.members[0].save(path)
+
+    def load(self, path: str) -> None:
+        for m in self.members:
+            m.load(path)
+
+    def get_count(self) -> int:
+        return self.members[0].get_count()
+
+    def get_memory_size(self) -> int:
+        # the real footprint: every replica holds a full copy
+        return sum(m.get_memory_size() for m in self.members)
+
+    def replica_stats(self) -> List[dict]:
+        from dingo_tpu.common.metrics import METRICS
+
+        out = []
+        for r in range(len(self.members)):
+            lat = METRICS.latency(
+                "mesh.replica.search_ms", region_id=self.id,
+                labels={"replica": str(r)},
+            ).stats()
+            out.append({
+                "replica": r,
+                "searches": METRICS.counter(
+                    "mesh.replica.searches", region_id=self.id,
+                    labels={"replica": str(r)},
+                ).get(),
+                "inflight": self._inflight[r],
+                "qps": lat.get("qps", 0.0),
+            })
+        return out

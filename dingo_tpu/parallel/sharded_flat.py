@@ -43,8 +43,24 @@ from dingo_tpu.index.base import (
     strip_invalid,
 )
 from dingo_tpu.ops.distance import Metric
-from dingo_tpu.parallel.sharded_store import ShardedFlatStore, make_mesh
+from dingo_tpu.parallel.sharded_store import (
+    ShardedFlatStore,
+    account_merge,
+    batch_spec,
+    make_mesh,
+    pad_query_batch,
+)
 from dingo_tpu.obs.sentinel import sentinel_jit
+
+
+def mesh_from_flags() -> "Mesh":
+    """Mesh shaped by the serving flags: 'dim' (TP) x optional 'batch'
+    (query DP) axes, 'data' takes the rest of the devices."""
+    from dingo_tpu.common.config import FLAGS
+
+    dim_axis = int(FLAGS.get("mesh_dim_axis") or 1)
+    batch_axis = int(FLAGS.get("mesh_batch_axis") or 1)
+    return make_mesh(dim=dim_axis, batch=batch_axis)
 
 MIN_CAP_PER_SHARD = 64
 
@@ -73,10 +89,7 @@ class TpuShardedFlat(VectorIndex):
                 f"sharded flat does not support {parameter.metric}"
             )
         if mesh is None:
-            from dingo_tpu.common.config import FLAGS
-
-            dim_axis = int(FLAGS.get("mesh_dim_axis") or 1)
-            mesh = make_mesh(dim=dim_axis)
+            mesh = mesh_from_flags()
         self.mesh = mesh
         self.n_shards = mesh.shape["data"]
         if parameter.dimension % mesh.shape["dim"]:
@@ -183,6 +196,23 @@ class TpuShardedFlat(VectorIndex):
         self._store.cap_per_shard = cap
         self._store.ids_by_gslot = self.ids_by_gslot
 
+    def _update_mesh_gauges(self) -> None:
+        """Per-shard liveness for the mesh metrics plane: row counts per
+        shard plus the max/mean skew ratio. Flight bundles inherit these
+        through the metric tick ring, so a slow-query bundle shows whether
+        one shard was carrying the region."""
+        from dingo_tpu.common.metrics import METRICS
+
+        cap = self.cap_per_shard
+        live = [cap - len(f) for f in self._free_per_shard]
+        mean = sum(live) / max(1, len(live))
+        for s, rows in enumerate(live):
+            METRICS.gauge("mesh.shard_rows", region_id=self.id,
+                          labels={"shard": str(s)}).set(float(rows))
+        METRICS.gauge("mesh.shard_skew", region_id=self.id).set(
+            (max(live) / mean) if mean > 0 else 0.0
+        )
+
     def _take_slots(self, n: int) -> np.ndarray:
         """Balanced BULK allocation of n slots: waterfill so the shards'
         remaining free counts stay as equal as possible, popping each
@@ -286,6 +316,7 @@ class TpuShardedFlat(VectorIndex):
                 )
             )
         self.write_count_since_save += len(ids)
+        self._update_mesh_gauges()
 
     def add(self, ids: np.ndarray, vectors: np.ndarray) -> None:
         ids = np.asarray(ids, np.int64)
@@ -320,6 +351,7 @@ class TpuShardedFlat(VectorIndex):
                     )
                 )
             self.write_count_since_save += len(doomed)
+            self._update_mesh_gauges()
         return len(doomed)
 
     # -- search --------------------------------------------------------------
@@ -328,12 +360,17 @@ class TpuShardedFlat(VectorIndex):
 
     def search_async(self, queries, topk, filter_spec: Optional[FilterSpec] = None,
                      **kw):
+        from dingo_tpu.common.config import FLAGS
         from dingo_tpu.parallel.tracing import shard_search_span
 
         with shard_search_span("parallel.flat.search", self.mesh) as span:
             queries = self._prep(np.atleast_2d(np.asarray(queries, np.float32)))
+            b = queries.shape[0]
+            qpad = pad_query_batch(queries, self.mesh)
+            collective = bool(FLAGS.get("mesh_collective_merge"))
             q = jax.device_put(
-                jnp.asarray(queries), NamedSharding(self.mesh, P(None, "dim"))
+                jnp.asarray(qpad),
+                NamedSharding(self.mesh, batch_spec(self.mesh, "dim")),
             )
             with self._device_lock:
                 # capture valid/vecs AND the gslot translation table inside
@@ -347,20 +384,43 @@ class TpuShardedFlat(VectorIndex):
                         jnp.asarray(mask) & self._store.valid,
                         NamedSharding(self.mesh, P("data")),
                     )
-                vals, gslots = self._store._search_jit(
-                    self._store.vecs, self._store.sqnorm, valid, q, int(topk)
-                )
+                if collective:
+                    vals, gslots = self._store._search_jit(
+                        self._store.vecs, self._store.sqnorm, valid, q,
+                        int(topk),
+                    )
+                else:
+                    # capped fallback arm: per-shard [b, k] shortlists only
+                    # cross to the host, merged in resolve()
+                    vals, gslots = self._store._local_topk_jit(
+                        self._store.vecs, self._store.sqnorm, valid, q,
+                        int(topk),
+                    )
                 ids_by_gslot = self.ids_by_gslot.copy()
+            if collective:
+                account_merge(self.mesh, qpad.shape[0], int(topk),
+                              region_id=self.id)
+            else:
+                from dingo_tpu.common.metrics import METRICS
+
+                METRICS.counter("mesh.fallback_searches").add(1)
             vals.copy_to_host_async()
             gslots.copy_to_host_async()
             if span.sampled:
                 # sampled requests trade pipelining for a true kernel span
-                span.set_attr("batch", int(len(queries)))
+                span.set_attr("batch", b)
                 jax.block_until_ready((vals, gslots))
         ascending = self.metric is Metric.L2
 
         def resolve() -> List[SearchResult]:
             vals_h, gslots_h = jax.device_get((vals, gslots))
+            if not collective:
+                from dingo_tpu.parallel.sharded_store import merge_host_topk
+
+                vals_h, gslots_h = merge_host_topk(
+                    vals_h, gslots_h, int(topk)
+                )
+            vals_h, gslots_h = vals_h[:b], gslots_h[:b]
             safe = np.where(gslots_h >= 0, gslots_h, 0)
             ids = np.where(gslots_h >= 0, ids_by_gslot[safe], -1)
             dists = -vals_h if ascending else vals_h

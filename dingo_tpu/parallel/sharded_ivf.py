@@ -57,7 +57,6 @@ from dingo_tpu.index.base import (
     VectorIndex,
     strip_invalid,
 )
-from dingo_tpu.index.flat import _pad_batch
 from dingo_tpu.index.ivf_flat import coarse_probes, ivf_scan_scores
 from dingo_tpu.index.ivf_layout import (
     MAX_CAP,
@@ -70,7 +69,12 @@ from dingo_tpu.ops.distance import Metric, scores_to_distances, squared_norms
 from dingo_tpu.ops.kmeans import kmeans_assign
 from dingo_tpu.ops.topk import merge_sharded_topk
 from dingo_tpu.parallel.sharded_flat import TpuShardedFlat
-from dingo_tpu.parallel.sharded_store import make_mesh
+from dingo_tpu.parallel.sharded_store import (
+    account_merge,
+    batch_spec,
+    make_mesh,
+    pad_query_batch,
+)
 
 
 @dataclasses.dataclass
@@ -96,7 +100,11 @@ class TpuShardedIvfFlat(TpuShardedFlat):
         if parameter.ncentroids <= 0:
             raise InvalidParameter(f"ncentroids {parameter.ncentroids}")
         if mesh is None:
-            mesh = make_mesh(dim=1)
+            from dingo_tpu.common.config import FLAGS
+
+            mesh = make_mesh(
+                dim=1, batch=int(FLAGS.get("mesh_batch_axis") or 1)
+            )
         if mesh.shape["dim"] != 1:
             raise InvalidParameter(
                 "sharded IVF needs mesh dim axis == 1 (rows shard, the "
@@ -148,6 +156,7 @@ class TpuShardedIvfFlat(TpuShardedFlat):
 
         def search_fn(buckets, bsq, bval, bslot, ptable, centroids, c_sq,
                       queries, cap, k, nprobe, max_spill):
+            out2 = batch_spec(mesh, None)
             f = shard_map(
                 functools.partial(
                     local_search, k=k, nprobe=nprobe, max_spill=max_spill
@@ -161,10 +170,10 @@ class TpuShardedIvfFlat(TpuShardedFlat):
                     P("data", None, None),         # probe_table
                     P(None, None),                 # centroids (replicated)
                     P(None),                       # c_sqnorm
-                    P(None, None),                 # queries (replicated)
+                    batch_spec(mesh, None),        # queries (batch-split)
                     P(),                           # cap scalar
                 ),
-                out_specs=(P(), P()),
+                out_specs=(out2, out2),
                 check_vma=False,
             )
             return f(buckets, bsq, bval, bslot, ptable, centroids, c_sq,
@@ -413,14 +422,15 @@ class TpuShardedIvfFlat(TpuShardedFlat):
             queries = self._prep(np.atleast_2d(np.asarray(queries, np.float32)))
             b = queries.shape[0]
             nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
-            qpad = jnp.asarray(_pad_batch(queries))
+            qpad = jnp.asarray(pad_query_batch(queries, self.mesh))
             with self._device_lock:
                 if self._view_dirty:
                     self._rebuild_view()
                 view = self._view
                 bval = self._bucket_valid_for_filter(filter_spec)
                 q = jax.device_put(
-                    qpad, NamedSharding(self.mesh, P(None, None))
+                    qpad,
+                    NamedSharding(self.mesh, batch_spec(self.mesh, None)),
                 )
                 vals, gslots = self._ivf_search_jit(
                     view.buckets, view.bucket_sqnorm, bval, view.bucket_slot,
@@ -430,6 +440,8 @@ class TpuShardedIvfFlat(TpuShardedFlat):
                     max_spill=int(view.max_spill),
                 )
                 ids_by_gslot = self.ids_by_gslot.copy()
+            account_merge(self.mesh, int(qpad.shape[0]), int(topk),
+                          region_id=self.id)
             if span.sampled:
                 span.set_attr("batch", b)
                 span.set_attr("nprobe", int(nprobe))

@@ -51,7 +51,6 @@ from dingo_tpu.index.base import (
     InvalidParameter,
     NotTrained,
 )
-from dingo_tpu.index.flat import _pad_batch
 from dingo_tpu.index.ivf_flat import coarse_probes
 from dingo_tpu.index.ivf_pq import MAX_POINTS_PER_CENTROID, _ivfpq_scan_kernel
 from dingo_tpu.index.ivf_layout import expand_probes_ranked
@@ -61,6 +60,11 @@ from dingo_tpu.ops.pq import pairwise_l2sqr, pq_train, split_subvectors
 from dingo_tpu.obs.sentinel import sentinel_jit
 from dingo_tpu.ops.topk import merge_sharded_topk
 from dingo_tpu.parallel.sharded_ivf import TpuShardedIvfFlat
+from dingo_tpu.parallel.sharded_store import (
+    account_merge,
+    batch_spec,
+    pad_query_batch,
+)
 
 
 def _encode_codes(vecs, assign, centroids, codebooks, m):
@@ -216,6 +220,7 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
         def search_fn(codebkts, bval, bslot, bcoarse, ptable, vecs, sqnorm,
                       centroids, c_sq, codebooks, queries, cap,
                       k, kprime, nprobe, max_spill, precompute_lut):
+            out2 = batch_spec(mesh, None)
             f = shard_map(
                 functools.partial(
                     local_search, k=k, kprime=kprime, nprobe=nprobe,
@@ -233,10 +238,10 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
                     P(None, None),                 # centroids
                     P(None),                       # c_sqnorm
                     P(None, None, None),           # codebooks
-                    P(None, None),                 # queries
+                    batch_spec(mesh, None),        # queries (batch-split)
                     P(),                           # cap scalar
                 ),
-                out_specs=(P(), P()),
+                out_specs=(out2, out2),
                 check_vma=False,
             )
             return f(codebkts, bval, bslot, bcoarse, ptable, vecs, sqnorm,
@@ -388,7 +393,7 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
             queries = self._prep(np.atleast_2d(np.asarray(queries, np.float32)))
             b = queries.shape[0]
             nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
-            qpad = jnp.asarray(_pad_batch(queries))
+            qpad = jnp.asarray(pad_query_batch(queries, self.mesh))
             k = int(topk)
             kprime = max(
                 k, min(self.get_count() or k,
@@ -400,7 +405,8 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
                 view = self._pq_view
                 bval = self._pq_bucket_valid_for_filter(filter_spec)
                 q = jax.device_put(
-                    qpad, NamedSharding(self.mesh, P(None, None))
+                    qpad,
+                    NamedSharding(self.mesh, batch_spec(self.mesh, None)),
                 )
                 # per-(query, coarse-list) LUT sharing is worthwhile only
                 # while the [b, nprobe, m, ksub] table stays comfortably
@@ -419,6 +425,8 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
                     precompute_lut=lut_bytes <= 256 * 1024 * 1024,
                 )
                 ids_by_gslot = self.ids_by_gslot.copy()
+            account_merge(self.mesh, int(qpad.shape[0]), k,
+                          region_id=self.id)
             if span.sampled:
                 span.set_attr("batch", b)
                 span.set_attr("nprobe", int(nprobe))

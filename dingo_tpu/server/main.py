@@ -29,6 +29,7 @@ from dingo_tpu.common.stream import StreamManager
 from dingo_tpu.coordinator.balance import (
     BalanceLeaderScheduler,
     BalanceRegionScheduler,
+    ReplicaPlanScheduler,
 )
 from dingo_tpu.coordinator.control import CoordinatorControl
 from dingo_tpu.coordinator.kv_control import KvControl
@@ -132,6 +133,13 @@ def serve_coordinator(args) -> None:
     crontab.add(
         "balance_region", 60.0,
         when_leader(BalanceRegionScheduler(control).dispatch),
+    )
+    # replica planner reads balance_replica_mode/qps_target from FLAGS on
+    # every tick (hot-changeable, no-ops while mode != auto or metrics
+    # are stale), so it can always ride the crontab
+    crontab.add(
+        "replica_plan", 30.0,
+        when_leader(ReplicaPlanScheduler(control).dispatch),
     )
     metrics_http = _maybe_metrics_http()
     crontab.start()

@@ -1,0 +1,457 @@
+"""Mesh serving tier (ISSUE 7) on the 8-virtual-device CPU mesh.
+
+Covers the batch x data x dim mesh (query-batch data parallelism),
+device-side collective shortlist merge parity against single-device
+top-k for FLAT / IVF_FLAT / IVF_PQ x L2 / IP, the capped non-collective
+fallback, replica-group routing + write fan-out, the coordinator replica
+planner, the steady-state-recompiles == 0 invariant across the mesh
+path, and the mesh.* observability plane.
+"""
+
+import dataclasses
+from typing import Dict, List
+
+import numpy as np
+import pytest
+
+import jax
+
+from dingo_tpu.common.config import FLAGS
+from dingo_tpu.common.metrics import METRICS
+from dingo_tpu.index.base import IndexParameter, IndexType, Metric
+from dingo_tpu.metrics.snapshot import (
+    RegionMetricsSnapshot,
+    StoreMetricsSnapshot,
+)
+from dingo_tpu.parallel.replica_group import ReplicaGroup
+from dingo_tpu.parallel.sharded_flat import TpuShardedFlat
+from dingo_tpu.parallel.sharded_ivf import TpuShardedIvfFlat
+from dingo_tpu.parallel.sharded_pq import TpuShardedIvfPq
+from dingo_tpu.parallel.sharded_store import (
+    ShardedFlatStore,
+    make_mesh,
+    pad_query_batch,
+)
+
+DIM = 32
+N = 1024
+
+
+def test_virtual_mesh_present():
+    assert len(jax.devices()) == 8
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(77)
+    x = rng.standard_normal((N, DIM)).astype(np.float32)
+    ids = np.arange(N, dtype=np.int64) * 7 + 3
+    q = x[:6] + 0.01 * rng.standard_normal((6, DIM)).astype(np.float32)
+    return ids, x, q
+
+
+def _exact(ids, x, q, k, metric):
+    if metric is Metric.L2:
+        d = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        order = np.argsort(d, axis=1)
+    else:
+        order = np.argsort(-(q @ x.T), axis=1)
+    return ids[order[:, :k]]
+
+
+# ---------------------------------------------------------------------------
+# collective merge parity: batch x data (x dim) mesh vs single-device top-k
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("metric", [Metric.L2, Metric.INNER_PRODUCT])
+@pytest.mark.parametrize("shape", [(2, 2, 2), (2, 4, 1), (4, 2, 1)])
+def test_flat_batch_mesh_parity(corpus, metric, shape):
+    ids, x, q = corpus
+    batch, data, dim = shape
+    mesh = make_mesh(8, batch=batch, data=data, dim=dim)
+    idx = TpuShardedFlat(11, IndexParameter(
+        index_type=IndexType.FLAT, dimension=DIM, metric=metric,
+    ), mesh=mesh)
+    idx.upsert(ids, x)
+    want = _exact(ids, x, q, 10, metric)
+    got = np.asarray([r.ids for r in idx.search(q, 10)])
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("metric", [Metric.L2, Metric.INNER_PRODUCT])
+def test_ivf_batch_mesh_parity(corpus, metric):
+    ids, x, q = corpus
+    mesh = make_mesh(8, batch=2, data=4, dim=1)
+    idx = TpuShardedIvfFlat(12, IndexParameter(
+        index_type=IndexType.IVF_FLAT, dimension=DIM, metric=metric,
+        ncentroids=8, default_nprobe=8,
+    ), mesh=mesh)
+    idx.upsert(ids, x)
+    idx.train(x[::2])
+    # nprobe == nlist scans every list -> the collective-merge result must
+    # equal single-device exact top-k bit for bit
+    want = _exact(ids, x, q, 10, metric)
+    got = np.asarray([r.ids for r in idx.search(q, 10, nprobe=8)])
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("metric", [Metric.L2, Metric.INNER_PRODUCT])
+def test_pq_batch_mesh_parity(corpus, metric):
+    ids, x, q = corpus
+    mesh = make_mesh(8, batch=2, data=4, dim=1)
+    old = FLAGS.get("ivfpq_rerank_factor")
+    FLAGS.set("ivfpq_rerank_factor", 200)   # kprime = count: exact rerank
+    try:
+        idx = TpuShardedIvfPq(13, IndexParameter(
+            index_type=IndexType.IVF_PQ, dimension=DIM, metric=metric,
+            ncentroids=8, nsubvector=4, default_nprobe=8,
+        ), mesh=mesh)
+        idx.upsert(ids, x)
+        idx.train(x[::2])
+        want = _exact(ids, x, q, 10, metric)
+        got = np.asarray([r.ids for r in idx.search(q, 10, nprobe=8)])
+        # full-probe ADC shortlists + exact shard-local rerank over every
+        # candidate == exact top-k
+        np.testing.assert_array_equal(got, want)
+    finally:
+        FLAGS.set("ivfpq_rerank_factor", old)
+
+
+def test_batch_axis_odd_batch_trims(corpus):
+    """b=5 pads to 8 for the 2-way batch split; results trim back to 5."""
+    ids, x, q = corpus
+    mesh = make_mesh(8, batch=2, data=4, dim=1)
+    idx = TpuShardedFlat(14, IndexParameter(
+        index_type=IndexType.FLAT, dimension=DIM,
+    ), mesh=mesh)
+    idx.upsert(ids, x)
+    res = idx.search(q[:5], 7)
+    assert len(res) == 5
+    want = _exact(ids, x, q[:5], 7, Metric.L2)
+    np.testing.assert_array_equal(np.asarray([r.ids for r in res]), want)
+
+
+def test_pad_query_batch_ladder():
+    mesh = make_mesh(8, batch=4, data=2, dim=1)
+    assert pad_query_batch(np.zeros((5, 4), np.float32), mesh).shape[0] == 8
+    assert pad_query_batch(np.zeros((1, 4), np.float32), mesh).shape[0] == 4
+    mesh1 = make_mesh(8, data=4, dim=2)
+    assert pad_query_batch(np.zeros((5, 4), np.float32), mesh1).shape[0] == 8
+    with pytest.raises(ValueError):
+        make_mesh(6, batch=3, data=2, dim=1)   # non-pow2 batch axis
+
+
+# ---------------------------------------------------------------------------
+# steady state: the warmed mesh path never recompiles
+# ---------------------------------------------------------------------------
+def test_mesh_steady_state_zero_recompiles(corpus):
+    ids, x, q = corpus
+    mesh = make_mesh(8, batch=2, data=4, dim=1)
+    idx = TpuShardedIvfFlat(15, IndexParameter(
+        index_type=IndexType.IVF_FLAT, dimension=DIM,
+        ncentroids=8, default_nprobe=4,
+    ), mesh=mesh)
+    idx.upsert(ids, x)
+    idx.train(x[::2])
+    for _ in range(2):
+        idx.search(q, 10, nprobe=4)          # warm every shape bucket
+    c = METRICS.counter("xla.recompiles")
+    before = c.get()
+    for _ in range(5):
+        idx.search(q, 10, nprobe=4)
+    assert c.get() - before == 0
+
+
+# ---------------------------------------------------------------------------
+# non-collective fallback: capped k-per-shard transfers, same results
+# ---------------------------------------------------------------------------
+def test_fallback_merge_parity(corpus):
+    ids, x, q = corpus
+    mesh = make_mesh(8, data=4, dim=2)
+    store = ShardedFlatStore(mesh, dim=DIM)
+    store.load(ids, x)
+    want_ids, want_d = store.search(q, 10)
+    fb = METRICS.counter("mesh.fallback_searches")
+    before = fb.get()
+    FLAGS.set("mesh_collective_merge", False)
+    try:
+        got_ids, got_d = store.search(q, 10)
+    finally:
+        FLAGS.set("mesh_collective_merge", True)
+    np.testing.assert_array_equal(got_ids, want_ids)
+    np.testing.assert_allclose(got_d, want_d, rtol=1e-5, atol=1e-4)
+    assert fb.get() == before + 1
+
+
+def test_fallback_merge_serving_class(corpus):
+    """mesh.collective_merge=false must engage on the FACTORY-built FLAT
+    serving path (TpuShardedFlat.search_async), not only the raw store."""
+    ids, x, q = corpus
+    mesh = make_mesh(8, batch=2, data=2, dim=2)
+    idx = TpuShardedFlat(21, IndexParameter(
+        index_type=IndexType.FLAT, dimension=DIM,
+    ), mesh=mesh)
+    idx.upsert(ids, x)
+    want = _exact(ids, x, q, 10, Metric.L2)
+    fb = METRICS.counter("mesh.fallback_searches")
+    before = fb.get()
+    FLAGS.set("mesh_collective_merge", False)
+    try:
+        got = np.asarray([r.ids for r in idx.search(q, 10)])
+    finally:
+        FLAGS.set("mesh_collective_merge", True)
+    np.testing.assert_array_equal(got, want)
+    assert fb.get() == before + 1
+
+
+def test_merge_bytes_accounting(corpus):
+    ids, x, q = corpus
+    mesh = make_mesh(8, data=4, dim=2)
+    idx = TpuShardedFlat(16, IndexParameter(
+        index_type=IndexType.FLAT, dimension=DIM,
+    ), mesh=mesh)
+    idx.upsert(ids, x)
+    c = METRICS.counter("mesh.merge_bytes", region_id=16)
+    before = c.get()
+    idx.search(q, 10)       # b=6 pads to 8; 4 shards x 8 x 10 x 8B
+    assert c.get() - before == 4 * 8 * 10 * 8
+    skew = METRICS.gauge("mesh.shard_skew", region_id=16).get()
+    assert skew >= 1.0      # balanced allocation keeps this near 1
+
+
+# ---------------------------------------------------------------------------
+# replica groups: routing, write fan-out, factory wiring
+# ---------------------------------------------------------------------------
+def test_replica_group_routing_and_fanout(corpus):
+    ids, x, q = corpus
+    g = ReplicaGroup(17, IndexParameter(
+        index_type=IndexType.FLAT, dimension=DIM,
+    ), replicas=2)
+    assert g.replicas == 2
+    g.upsert(ids, x)
+    want = _exact(ids, x, q, 5, Metric.L2)
+    for _ in range(4):      # round robin: both members must answer alike
+        got = np.asarray([r.ids for r in g.search(q, 5)])
+        np.testing.assert_array_equal(got, want)
+    stats = g.replica_stats()
+    assert [s["searches"] for s in stats] == [2, 2]
+    assert all(s["inflight"] == 0 for s in stats)
+    # write fan-out: a delete lands on every member
+    g.delete(ids[:1])
+    res = g.search(x[:1], 1)
+    assert res[0].ids[0] != ids[0]
+    assert g.get_count() == N - 1
+    # full footprint: each replica holds a complete copy
+    assert g.get_memory_size() >= 2 * (N // 2) * DIM * 4
+
+
+def test_replica_group_composes_batch_axis(corpus):
+    """mesh_replicas x mesh_batch_axis compose: each member's slice
+    carves into batch x data instead of silently dropping the axis."""
+    ids, x, q = corpus
+    old = FLAGS.get("mesh_batch_axis")
+    FLAGS.set("mesh_batch_axis", 2)
+    try:
+        g = ReplicaGroup(20, IndexParameter(
+            index_type=IndexType.FLAT, dimension=DIM,
+        ), replicas=2)
+        for m in g.members:
+            assert dict(m.mesh.shape) == {"batch": 2, "data": 2, "dim": 1}
+        g.upsert(ids, x)
+        want = _exact(ids, x, q, 5, Metric.L2)
+        for _ in range(2):
+            got = np.asarray([r.ids for r in g.search(q, 5)])
+            np.testing.assert_array_equal(got, want)
+        # indivisible combination fails loudly
+        FLAGS.set("mesh_batch_axis", 8)
+        from dingo_tpu.index.base import InvalidParameter
+
+        with pytest.raises(InvalidParameter):
+            ReplicaGroup(22, IndexParameter(
+                index_type=IndexType.FLAT, dimension=DIM,
+            ), replicas=2)
+    finally:
+        FLAGS.set("mesh_batch_axis", old)
+
+
+def test_flight_report_mesh_section():
+    """Bundle mesh state renders: per-shard rows, skew, and replica rows
+    with the latency suffix parsed off the label brace."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "flight_report_under_test",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "flight_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    name, labels = mod._series_labels(
+        "mesh.replica.search_ms{region=5,replica=0}.count"
+    )
+    assert name == "mesh.replica.search_ms.count"
+    assert labels == {"region": "5", "replica": "0"}
+    text = "\n".join(mod._mesh_section({
+        "mesh.shard_rows{region=5,shard=0}": 100.0,
+        "mesh.shard_rows{region=5,shard=1}": 300.0,
+        "mesh.shard_skew{region=5}": 1.5,
+        "mesh.replica.searches{region=5,replica=0}": 4.0,
+        "mesh.replica.inflight{region=5,replica=0}": 1.0,
+        "mesh.replica.search_ms{region=5,replica=0}.count": 4.0,
+        "mesh.replica.search_ms{region=5,replica=0}.sum_us": 8000.0,
+    }))
+    assert "SKEW" in text and "1.50x" in text
+    assert "300" in text
+    # 8000us / 4 calls = 2.00 avg ms, proving the suffix parse works
+    assert "2.00" in text
+
+
+def test_replica_group_load_routing(corpus):
+    ids, x, q = corpus
+    g = ReplicaGroup(18, IndexParameter(
+        index_type=IndexType.FLAT, dimension=DIM,
+    ), replicas=2)
+    g.upsert(ids[:128], x[:128])
+    old = FLAGS.get("mesh_replica_route")
+    FLAGS.set("mesh_replica_route", "load")
+    try:
+        # hold replica 0 busy: its in-flight count stays 1 until resolved
+        pending = g.search_async(q, 3)
+        r_first = int(np.argmax([s["searches"] for s in g.replica_stats()]))
+        done = g.search_async(q, 3)   # must route to the OTHER replica
+        done()
+        pending()
+        stats = g.replica_stats()
+        assert [s["searches"] for s in stats] == [1, 1], stats
+        assert r_first in (0, 1)
+    finally:
+        FLAGS.set("mesh_replica_route", old)
+
+
+def test_replica_group_via_factory(corpus):
+    ids, x, q = corpus
+    from dingo_tpu.index.factory import new_index
+
+    old_flag = FLAGS.get("use_mesh_sharded_flat")
+    old_rep = FLAGS.get("mesh_replicas")
+    FLAGS.set("use_mesh_sharded_flat", True)
+    FLAGS.set("mesh_replicas", 2)
+    try:
+        idx = new_index(19, IndexParameter(
+            index_type=IndexType.FLAT, dimension=DIM,
+        ))
+        assert isinstance(idx, ReplicaGroup)
+        idx.upsert(ids[:64], x[:64])
+        got = np.asarray([r.ids for r in idx.search(q, 3)])
+        want = _exact(ids[:64], x[:64], q, 3, Metric.L2)
+        np.testing.assert_array_equal(got, want)
+    finally:
+        FLAGS.set("use_mesh_sharded_flat", old_flag)
+        FLAGS.set("mesh_replicas", old_rep)
+
+
+# ---------------------------------------------------------------------------
+# coordinator replica planner
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class _FakeStore:
+    store_id: str
+    leader_region_ids: List[int]
+    region_ids: List[int]
+
+
+@dataclasses.dataclass
+class _FakeRegion:
+    peers: List[str]
+
+
+class _FakeControl:
+    def __init__(self, stores, regions, metrics):
+        self._stores = stores
+        self.regions: Dict[int, _FakeRegion] = regions
+        self._metrics = metrics
+        self.peer_changes = []
+
+    def alive_stores(self):
+        return self._stores
+
+    def get_store_metrics(self):
+        return [(sid, snap, 0.0, False) for sid, snap in
+                self._metrics.items()]
+
+    def change_peer(self, region_id, peers):
+        self.regions[region_id] = _FakeRegion(list(peers))
+        self.peer_changes.append((region_id, list(peers)))
+
+
+def _planner_fixture(qps: float):
+    stores = [
+        _FakeStore("s1", [1], [1]),
+        _FakeStore("s2", [], []),
+        _FakeStore("s3", [], []),
+    ]
+    regions = {1: _FakeRegion(["s1"])}
+    metrics = {
+        "s1": StoreMetricsSnapshot("s1", regions=[
+            RegionMetricsSnapshot(1, is_leader=True, search_qps=qps),
+        ]),
+        "s2": StoreMetricsSnapshot("s2", regions=[]),
+        "s3": StoreMetricsSnapshot("s3", regions=[]),
+    }
+    return _FakeControl(stores, regions, metrics)
+
+
+def test_replica_planner_scales_up_hot_region():
+    from dingo_tpu.coordinator.balance import ReplicaPlanScheduler
+
+    control = _planner_fixture(qps=120.0)
+    sched = ReplicaPlanScheduler(control, mode="auto", qps_target=50.0)
+    ops = sched.plan()
+    assert len(ops) == 1
+    op = ops[0]
+    assert (op.region_id, op.current, op.target) == (1, 1, 2)
+    assert op.add_stores and op.add_stores[0] in ("s2", "s3")
+    assert sched.dispatch() == 1
+    assert len(control.regions[1].peers) == 2
+
+
+def test_replica_planner_scales_down_cold_region():
+    from dingo_tpu.coordinator.balance import ReplicaPlanScheduler
+
+    control = _planner_fixture(qps=1.0)
+    control.regions[1] = _FakeRegion(["s1", "s2", "s3"])
+    sched = ReplicaPlanScheduler(control, mode="auto", qps_target=50.0)
+    ops = sched.plan()
+    assert len(ops) == 1
+    assert ops[0].drop_stores and ops[0].drop_stores[0] != "s1"
+    assert ops[0].target == 2
+
+
+def test_replica_planner_respects_quorum_floor():
+    """A quiet region must never shrink below the cluster's configured
+    raft replication — base peers are quorum, not elastic read capacity."""
+    from dingo_tpu.coordinator.balance import ReplicaPlanScheduler
+
+    control = _planner_fixture(qps=1.0)
+    control.regions[1] = _FakeRegion(["s1", "s2", "s3"])
+    control.replication = 3
+    sched = ReplicaPlanScheduler(control, mode="auto", qps_target=50.0)
+    assert sched.plan() == []
+    # replicas ADDED beyond the base do drain back down to the floor
+    control.regions[1] = _FakeRegion(["s1", "s2", "s3", "s2b"])
+    ops = sched.plan()
+    assert len(ops) == 1 and ops[0].target == 3
+
+
+def test_replica_planner_off_and_stale():
+    from dingo_tpu.coordinator.balance import ReplicaPlanScheduler
+
+    control = _planner_fixture(qps=500.0)
+    assert ReplicaPlanScheduler(control, mode="off").plan() == []
+    # stale metrics: no fresh figures -> no ops (never plan on dead data)
+    control.get_store_metrics = lambda: [
+        (sid, snap, 0.0, True) for sid, snap in control._metrics.items()
+    ]
+    assert ReplicaPlanScheduler(
+        control, mode="auto", qps_target=50.0
+    ).plan() == []

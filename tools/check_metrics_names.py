@@ -15,9 +15,10 @@ Also linted:
   method names: `rpc.DebugService.MetricsDump`), but the name must start
   lowercase and stay inside the identifier-plus-dots alphabet.
 - curated metric families: literal registrations under the `xla.` /
-  `hbm.` / `flight.` prefixes (the device-runtime observability plane)
-  must name a series declared in FAMILY_NAMES below — dashboards key on
-  these exact names, so additions are explicit, not incidental.
+  `hbm.` / `flight.` / `ivf.` / `mesh.` prefixes (the device-runtime
+  observability + mesh serving planes) must name a series declared in
+  FAMILY_NAMES below — dashboards key on these exact names, so additions
+  are explicit, not incidental.
 
 Wired as a tier-1 test (tests/test_metrics_names.py) so a bad name fails
 CI, not the scrape.
@@ -73,6 +74,18 @@ FAMILY_NAMES = {
     "flight": {
         "flight.bundles",        # captured bundles by reason
         "flight.suppressed",     # rate-limited triggers by reason
+    },
+    "mesh": {
+        "mesh.searches",            # collective-merge searches per region
+        "mesh.merge_bytes",         # shortlist bytes the all_gather moved
+        "mesh.fallback_searches",   # non-collective (host-merge) arm uses
+        "mesh.shard_rows",          # per-shard live rows (shard label)
+        "mesh.shard_skew",          # max/mean live-row ratio per region
+        "mesh.replicas",            # replica-group member count
+        "mesh.replica.searches",    # routed searches (replica label)
+        "mesh.replica.inflight",    # concurrent searches per replica
+        "mesh.replica.search_ms",   # per-replica latency (carries the
+                                    # windowed QPS the planner reads)
     },
     "ivf": {
         "ivf.inplace_appends",      # view maintenance (PR 3)

@@ -74,6 +74,73 @@ def _span_rows(spans: List[Dict[str, Any]]) -> List[List[str]]:
     return rows
 
 
+def _series_labels(key: str):
+    """`name{k=v,...}suffix` -> (name + suffix, {k: v}). Flattened latency
+    series keep their `.count` / `.sum_us` suffix AFTER the label brace
+    (`mesh.replica.search_ms{region=5,replica=0}.count`), so the suffix
+    must rejoin the name, not leak into the last label value."""
+    if "{" not in key:
+        return key, {}
+    name, _, rest = key.partition("{")
+    body, _, suffix = rest.partition("}")
+    labels = dict(
+        pair.split("=", 1) for pair in body.split(",") if "=" in pair
+    )
+    return name + suffix, labels
+
+
+def _mesh_section(mesh: Dict[str, Any]) -> List[str]:
+    """Per-shard row balance + replica routing state at capture time
+    (absolute mesh.* series the recorder snapshots alongside the deltas):
+    a slow sharded search with one overloaded shard or a starved replica
+    reads straight off this table."""
+    shard_rows: Dict[str, Dict[str, float]] = {}
+    skew: Dict[str, float] = {}
+    replicas: Dict[str, Dict[str, Dict[str, float]]] = {}
+    for key, val in mesh.items():
+        name, labels = _series_labels(key)
+        region = labels.get("region", "-")
+        if name == "mesh.shard_rows":
+            shard_rows.setdefault(region, {})[labels.get("shard", "?")] = val
+        elif name == "mesh.shard_skew":
+            skew[region] = val
+        elif name.startswith("mesh.replica."):
+            field = name[len("mesh.replica."):]
+            replicas.setdefault(region, {}).setdefault(
+                labels.get("replica", "?"), {}
+            )[field] = val
+    out = [f"-- mesh serving state ({len(mesh)} series)"]
+    rows = []
+    for region in sorted(shard_rows):
+        per = shard_rows[region]
+        for shard in sorted(per, key=lambda s: int(s) if s.isdigit() else 0):
+            rows.append([region, shard, f"{per[shard]:.0f}"])
+        rows.append([region, "SKEW", f"{skew.get(region, 1.0):.2f}x"])
+    if rows:
+        out.extend(_table(["REGION", "SHARD", "ROWS"], rows))
+    rrows = []
+    for region in sorted(replicas):
+        for rid in sorted(replicas[region]):
+            st = replicas[region][rid]
+            cnt = st.get("search_ms.count", 0.0)
+            avg = (st.get("search_ms.sum_us", 0.0) / cnt / 1000.0
+                   if cnt else 0.0)
+            rrows.append([
+                region, rid,
+                f"{st.get('searches', 0):.0f}",
+                f"{st.get('inflight', 0):.0f}",
+                f"{avg:.2f}",
+            ])
+    if rrows:
+        out.append("")
+        out.extend(_table(
+            ["REGION", "REPLICA", "SEARCHES", "INFLIGHT", "AVG_MS"], rrows
+        ))
+    if not rows and not rrows:
+        out.append("  (no shard/replica series)")
+    return out
+
+
 def render(bundle: Dict[str, Any]) -> str:
     out: List[str] = []
     created = bundle.get("created_ms", 0) / 1000.0
@@ -168,6 +235,11 @@ def render(bundle: Dict[str, Any]) -> str:
         ])
     if rows:
         out.extend(_table(["REGION", "OWNER", "BYTES", "PEAK"], rows))
+
+    mesh = bundle.get("mesh") or {}
+    if mesh:
+        out.append("")
+        out.extend(_mesh_section(mesh))
 
     slow = bundle.get("slow_queries") or []
     if slow:
