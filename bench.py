@@ -321,6 +321,122 @@ def pruning_sweep(platform):
     return out
 
 
+def hnsw_sweep(platform):
+    """ISSUE 8: host C++ graph walk vs device batched beam search on one
+    HNSW config — QPS, recall@10, mean hops, visited fraction, and the
+    steady-state-recompiles gate for the device path, plus the
+    byte-identical-final-ordering check (both paths end in the same exact
+    device rerank, so equal candidate sets must produce equal id lists).
+    The spec point is matrix row 4 (1M x 768) on TPU; the CPU smoke runs a
+    reduced, labeled scale where the XLA walk executes on the host — its
+    QPS column is a correctness signal there, not a speed claim."""
+    import time as _time
+
+    from dingo_tpu.common.config import FLAGS
+    from dingo_tpu.common.metrics import METRICS
+    from dingo_tpu.index import IndexParameter, IndexType, new_index
+
+    big = platform == "tpu"
+    n = int(os.environ.get("DINGO_BENCH_HNSW_N",
+                           200_000 if big else 20_000))
+    d = int(os.environ.get("DINGO_BENCH_HNSW_D", 768 if big else 64))
+    m_links = int(os.environ.get("DINGO_BENCH_HNSW_M", 16))
+    efc = int(os.environ.get("DINGO_BENCH_HNSW_EFC", 100))
+    ef = int(os.environ.get("DINGO_BENCH_HNSW_EF", 64))
+    batch, k = (64 if big else 32), 10
+    iters = 20 if big else 5
+    rng = np.random.default_rng(13)
+    ncl = max(64, n // 1000)
+    centers = rng.standard_normal((ncl, d), dtype=np.float32)
+    x = centers[rng.integers(0, ncl, n)] + 0.35 * rng.standard_normal(
+        (n, d)
+    ).astype(np.float32)
+    ids = np.arange(n, dtype=np.int64)
+    queries = x[rng.choice(n, batch, replace=False)] + 0.05 * (
+        rng.standard_normal((batch, d)).astype(np.float32)
+    )
+    qs = queries[:16]
+    dmat = (
+        (qs ** 2).sum(1)[:, None] - 2.0 * qs @ x.T + (x ** 2).sum(1)[None, :]
+    )
+    gt = ids[np.argsort(dmat, axis=1)[:, :k]]
+
+    def recall_of(res):
+        return float(np.mean(
+            [len(set(r.ids) & set(g)) / k for r, g in zip(res, gt)]
+        ))
+
+    idx = new_index(300, IndexParameter(
+        index_type=IndexType.HNSW, dimension=d, nlinks=m_links,
+        efconstruction=efc,
+    ))
+    idx.store.reserve(n)
+    t0 = _time.perf_counter()
+    step = 25_000
+    for i in range(0, n, step):
+        idx.upsert(ids[i:i + step], x[i:i + step])
+    log(f"hnsw build: {_time.perf_counter() - t0:.1f}s "
+        f"({n}x{d}, M={m_links}, efc={efc})")
+    conf_mode = str(FLAGS.get("hnsw_device_search"))
+    out = {
+        "config": f"hnsw_sweep_{n//1000}k_x{d}_M{m_links}_ef{ef}",
+        # conf default at bench time — each mode row below records the
+        # value it actually forced, so BENCH_r*.json trajectories can
+        # attribute the row-4 delta to the serving path
+        "hnsw_device_search_conf": conf_mode,
+    }
+    final_ids = {}
+    try:
+        for mode in ("host", "device"):
+            FLAGS.set("hnsw_device_search", mode == "device")
+            idx.warmup(batches=(batch,), topk=k, ef=ef)
+            rec = recall_of(idx.search(qs, k, ef=ef))
+            final_ids[mode] = np.asarray(
+                [r.ids for r in idx.search(qs, k, ef=ef)]
+            )
+            rc_c = METRICS.counter("xla.recompiles")
+            rc0 = rc_c.get()
+            t0 = _time.perf_counter()
+            thunks = [idx.search_async(queries, k, ef=ef)
+                      for _ in range(iters)]
+            for t in thunks:
+                t()
+            dt = (_time.perf_counter() - t0) / iters
+            row = {
+                "qps": round(batch / dt, 1),
+                "recall_at_10": round(rec, 4),
+                "steady_state_recompiles": int(rc_c.get() - rc0),
+                "hnsw_device_search": str(FLAGS.get("hnsw_device_search")),
+            }
+            if mode == "device":
+                row["mean_hops"] = round(float(
+                    METRICS.gauge("hnsw.mean_hops", region_id=300).get()
+                ), 2)
+                row["visited_fraction"] = round(float(METRICS.gauge(
+                    "hnsw.visited_fraction", region_id=300
+                ).get()), 4)
+                row["beam_occupancy"] = round(float(METRICS.gauge(
+                    "hnsw.beam_occupancy", region_id=300
+                ).get()), 4)
+            out[mode] = row
+            log(f"hnsw {mode}: {row['qps']:,.0f} QPS "
+                f"recall@10={rec:.4f} "
+                f"{row['steady_state_recompiles']} steady recompiles"
+                + (f" hops={row['mean_hops']}" if mode == "device" else ""))
+    finally:
+        FLAGS.set("hnsw_device_search", conf_mode)
+    out["recall_delta_device_vs_host"] = round(
+        out["device"]["recall_at_10"] - out["host"]["recall_at_10"], 4
+    )
+    out["final_order_match_fraction"] = round(float(
+        (final_ids["host"] == final_ids["device"]).all(axis=1).mean()
+    ), 4)
+    out["byte_identical_final_order"] = bool(
+        (final_ids["host"] == final_ids["device"]).all()
+    )
+    return out
+
+
 def _mesh_corpus(n, d, seed=5):
     """Deterministic clustered corpus shared by every mesh_scaling child —
     identical bytes at every device count, so shortlists must match."""
@@ -715,6 +831,9 @@ def main():
     # --- mesh scaling: QPS vs device count, subprocess per point (ISSUE 7) ---
     mesh = mesh_scaling(platform)
 
+    # --- hnsw: host graph walk vs device beam search (ISSUE 8) ---
+    hnsw = hnsw_sweep(platform)
+
     # --- CPU baseline: numpy/OpenBLAS IVF-flat with same layout ---
     centroids = np.asarray(idx.centroids)
     assign = idx._assign_h[np.asarray(idx.store.slots_of(ids))]
@@ -803,6 +922,12 @@ def main():
         # with shortlist-parity + zero-recompile gates; on-chip these
         # rows become the 1 -> N device scaling story
         "mesh_scaling": mesh,
+        # device graph tier (ISSUE 8): host C++ beam vs device lockstep
+        # beam on one HNSW config — recall-vs-host, mean hops, the
+        # byte-identical final-ordering gate, and the per-mode
+        # hnsw.device_search value so the matrix row-4 delta is
+        # attributable to the serving path
+        "hnsw_sweep": hnsw,
     }
     if platform == "tpu":
         result["measured_at"] = time.time()

@@ -266,6 +266,26 @@ FLAGS.define("ivf_prune_scan", "auto", mutable=True,
                    "blocked metadata. 'auto' (default) = on (the kernels "
                    "fall back to the plain fused scan when the dimension "
                    "doesn't block); False forces the non-pruning kernels")
+FLAGS.define("hnsw_device_search", "auto", mutable=True,
+             help_="route HNSW searches through the device-resident graph "
+                   "tier: a batched lockstep beam search over the flattened "
+                   "level-0 adjacency (ops/beam.py), quantized-tier compute "
+                   "+ exact device rerank of the final beam. 'auto' "
+                   "(default) enables it on TPU only — the XLA walk wins "
+                   "when hundreds of queries amortize each gather/einsum "
+                   "round; the host C++ beam stays the CPU arm and the "
+                   "parity oracle. True/False force")
+FLAGS.define("hnsw_device_beam", 0, mutable=True,
+             help_="fixed candidate-beam width for the device HNSW walk; "
+                   "0 (default) derives it from the request ef via the "
+                   "{1,1.5}x-pow2 shape-bucket ladder so steady-state "
+                   "serving reuses a handful of compiled programs")
+FLAGS.define("hnsw_max_iters", 48, mutable=True,
+             help_="hard cap on lockstep beam-expansion rounds of the "
+                   "device HNSW walk (one round = expand every beam entry "
+                   "one hop). The walk exits earlier once every query's "
+                   "beam has converged; the cap bounds worst-case latency "
+                   "on adversarial graphs")
 FLAGS.define("vector_blocked_layout", "auto", mutable=True,
              help_="maintain a dimension-blocked ([n_blocks, capacity, "
                    "block_d]) scan mirror + per-block norms in float/sq8 "
@@ -332,6 +352,17 @@ def prune_scan_enabled() -> bool:
     blocked metadata, so there is no separate hardware condition)."""
     v = _parse_tri(FLAGS.get("ivf_prune_scan"))
     return True if v is None else v
+
+
+def hnsw_device_enabled() -> bool:
+    """Tri-state hnsw.device_search: 'auto' keeps the device graph walk
+    TPU-only (the lockstep beam needs MXU batch throughput to beat the
+    native C++ graph; on CPU the host path wins and doubles as the
+    parity oracle). True/False force."""
+    v = _parse_tri(FLAGS.get("hnsw_device_search"))
+    if v is None:
+        return _on_tpu()
+    return v
 
 
 def blocked_layout_enabled() -> bool:

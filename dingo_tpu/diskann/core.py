@@ -38,7 +38,7 @@ import numpy as np
 
 from dingo_tpu.index.base import IndexParameter, InvalidParameter
 from dingo_tpu.index.ivf_layout import build_layout, expand_probes_ranked
-from dingo_tpu.ops.distance import Metric, squared_norms
+from dingo_tpu.ops.distance import Metric, np_normalize, squared_norms
 from dingo_tpu.ops.kmeans import MAX_POINTS_PER_CENTROID, kmeans_assign, train_kmeans
 from dingo_tpu.ops.pq import pq_train, split_subvectors
 
@@ -165,8 +165,7 @@ class DiskAnnCore:
         if len(ids) != len(vectors):
             raise InvalidParameter("ids/vectors length mismatch")
         if self.metric is Metric.COSINE:
-            norms = np.linalg.norm(vectors, axis=1, keepdims=True)
-            vectors = vectors / np.maximum(norms, 1e-30)
+            vectors = np_normalize(vectors)
         with self._lock:
             # upsert semantics: an already-pushed id overwrites its row in
             # place instead of appending a duplicate physical row
@@ -349,8 +348,7 @@ class DiskAnnCore:
         if queries.ndim == 1:
             queries = queries[None, :]
         if self.metric is Metric.COSINE:
-            norms = np.linalg.norm(queries, axis=1, keepdims=True)
-            queries = queries / np.maximum(norms, 1e-30)
+            queries = np_normalize(queries)
         b = queries.shape[0]
         k = int(topk)
         kprime = min(count, k * (rerank_factor or RERANK_FACTOR))

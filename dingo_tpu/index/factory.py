@@ -85,6 +85,11 @@ def new_index(index_id: int, parameter: IndexParameter) -> VectorIndex:
 
         return TpuDiskann(index_id, parameter)
     if t is IndexType.HNSW:
+        if parameter.host_vectors:
+            # the device graph tier walks + reranks against the
+            # device-resident SlotStore rows; host_vectors only fits
+            # code-serving indexes (IVF_PQ / DISKANN)
+            raise InvalidParameter("HNSW does not support host_vectors")
         from dingo_tpu.index.hnsw import TpuHnsw
 
         return TpuHnsw(index_id, parameter)

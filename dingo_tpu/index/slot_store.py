@@ -150,6 +150,17 @@ class SlotStore:
                 self.bsq_blk = jnp.zeros(
                     (self.nblk, self.capacity), jnp.float32
                 )
+        # Graph adjacency mirror (device HNSW tier, index/hnsw.py): dense
+        # [capacity, deg] int32 slot-space neighbor lists, -1 padded, read
+        # by the batched beam kernel (ops/beam.py). Installed/refreshed by
+        # set_graph(); grows with capacity like the blocked mirror above.
+        self.graph_deg = 0
+        self.adj: Optional[jax.Array] = None
+        # Monotonic host-mutation counter: bumped by put/remove/growth.
+        # Cache keys that depend on the slot<->id mapping (the HNSW
+        # filter-mask cache, the device adjacency mirror) key on it the
+        # way IVF caches key on view.version.
+        self.mutation_version = 0
         self.vecs, self.sqnorm = self._alloc_storage(self.capacity)
         self.ids_by_slot = np.full((self.capacity,), -1, np.int64)
         self.valid_h = np.zeros((self.capacity,), np.bool_)
@@ -220,7 +231,22 @@ class SlotStore:
         if self.vecs_blk is not None:
             # blocked scan mirror: one more copy of the rows + block norms
             size += self.capacity * (self.dim * itemsize + self.nblk * 4)
+        if self.adj is not None:
+            size += self.capacity * self.graph_deg * 4
         return size
+
+    def set_graph(self, adj: np.ndarray, deg: int) -> None:
+        """Install the slot-space adjacency mirror: [capacity, deg] int32
+        neighbor slots, -1 padded. The owning index (TpuHnsw) builds it
+        from the native graph export; a full swap (not a scatter) because
+        one node insert can rewire arbitrary neighbors' lists."""
+        if adj.shape != (self.capacity, deg):
+            raise ValueError(
+                f"adjacency shape {adj.shape} != ({self.capacity}, {deg})"
+            )
+        with self.device_lock:
+            self.graph_deg = deg
+            self.adj = jnp.asarray(adj, jnp.int32)
 
     def reserve(self, capacity: int) -> None:
         """Pre-size device arrays (bulk ingest avoids per-growth recompiles
@@ -261,6 +287,7 @@ class SlotStore:
                 self._write_segment(int(sslots[seg_lo]), svecs[seg_lo:seg_hi])
         self.valid_h[slots] = True
         self._dmask = None
+        self.mutation_version += 1
         return slots
 
     def _write_segment(self, start: int, rows: np.ndarray) -> None:
@@ -356,6 +383,7 @@ class SlotStore:
                 removed += 1
         if removed:
             self._dmask = None
+            self.mutation_version += 1
         return slots
 
     # -- in-flight search accounting --------------------------------------
@@ -374,6 +402,13 @@ class SlotStore:
         pad = new_capacity - self.capacity
         with self.device_lock:
             self.vecs, self.sqnorm = self._grow_storage(pad)
+            if self.adj is not None:
+                # slots are stable across growth: existing adjacency rows
+                # stay correct, fresh capacity starts unlinked
+                self.adj = jnp.concatenate(
+                    [self.adj,
+                     jnp.full((pad, self.graph_deg), -1, jnp.int32)]
+                )
             if self.vecs_blk is not None:
                 self.vecs_blk = jnp.concatenate(
                     [self.vecs_blk,
@@ -393,6 +428,9 @@ class SlotStore:
         self._dmask = None
         self._free.extend(range(new_capacity - 1, self.capacity - 1, -1))
         self.capacity = new_capacity
+        # capacity is part of every [capacity]-shaped cached artifact
+        # (filter masks, adjacency) — growth invalidates them all
+        self.mutation_version += 1
 
     # -- host round-trips --------------------------------------------------
     def gather(self, ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:

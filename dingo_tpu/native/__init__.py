@@ -84,6 +84,16 @@ def load_hnsw() -> ctypes.CDLL:
     lib.hnsw_deleted_count.argtypes = [c.c_void_p]
     lib.hnsw_memory.restype = c.c_int64
     lib.hnsw_memory.argtypes = [c.c_void_p]
+    lib.hnsw_total_count.restype = c.c_int64
+    lib.hnsw_total_count.argtypes = [c.c_void_p]
+    lib.hnsw_graph_version.restype = c.c_int64
+    lib.hnsw_graph_version.argtypes = [c.c_void_p]
+    lib.hnsw_entry_label.restype = c.c_int64
+    lib.hnsw_entry_label.argtypes = [c.c_void_p]
+    lib.hnsw_export_level0.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int,
+        c.POINTER(c.c_int64), c.POINTER(c.c_int32),
+    ]
     lib.hnsw_save_size.restype = c.c_int64
     lib.hnsw_save_size.argtypes = [c.c_void_p]
     lib.hnsw_save.restype = c.c_int64

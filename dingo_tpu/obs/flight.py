@@ -119,13 +119,16 @@ class FlightRecorder:
             "deltas": deltas,
         }
 
-    def _mesh_state(self, now_flat: Dict[str, float]) -> Dict[str, Any]:
-        """Current mesh.* series values (per-shard live rows, skew ratio,
-        replica routing counters) — ABSOLUTE values, unlike the delta
-        window: a slow-query bundle must show the shard balance at
-        capture time, not only how it moved during the window. Shares
-        the capture's single registry dump."""
-        return {k: v for k, v in now_flat.items() if k.startswith("mesh.")}
+    def _family_state(self, now_flat: Dict[str, float],
+                      prefix: str) -> Dict[str, Any]:
+        """Current values of one curated series family — ABSOLUTE values,
+        unlike the delta window: a slow-query bundle must show the shard
+        balance / graph-walk health at capture time, not only how it
+        moved during the window. Shares the capture's single registry
+        dump. Captured families: mesh.* (shard rows, skew, replica
+        routing) and hnsw.* (hops, visited fraction, beam occupancy,
+        adjacency rebuilds)."""
+        return {k: v for k, v in now_flat.items() if k.startswith(prefix)}
 
     # ---- triggers ----------------------------------------------------------
     def on_slow_query(self, rec: Dict[str, Any]) -> str:
@@ -268,7 +271,8 @@ class FlightRecorder:
             "metrics": self._metrics_delta(now_flat),
             "kernel_cache": SENTINEL.state(),
             "hbm": HBM.state(),
-            "mesh": self._mesh_state(now_flat),
+            "mesh": self._family_state(now_flat, "mesh."),
+            "hnsw": self._family_state(now_flat, "hnsw."),
             "config": config,
         }
         blob = zlib.compress(

@@ -1,0 +1,216 @@
+"""Batched lockstep beam search over a device-resident graph index.
+
+TPU-native HNSW serving (ROADMAP item 5 / ISSUE 8 tentpole): the host
+C++ graph (native/hnsw) walks pointers one query at a time; this kernel
+walks hundreds of queries in lockstep over the FLATTENED level-0
+adjacency — a dense ``[capacity, deg]`` int32 array in slot space
+(SlotStore.adj) — so every step is regular gather + matmul + masked
+top-k work the MXU/VPU are built for:
+
+  frontier gather    one ``jnp.take`` on the adjacency: [b, beam] beam
+                     slots -> [b, beam*deg] candidate slots
+  candidate scores   one ``[b, beam*deg] x d`` einsum against the
+                     SlotStore rows (bf16 pairs down for the bf16 tier,
+                     sq8 decodes on the fly — the PR 4 precision tiers)
+  visited set        a per-query PACKED bitmask over capacity
+                     ([b, capacity/32] uint32, 1 bit per slot). Marking
+                     uses scatter-ADD, which is a correct bitwise OR
+                     here: a slot passes the not-yet-visited mask at
+                     most once over the whole walk and in-batch
+                     duplicates are removed first, so no bit is ever
+                     added twice
+  dedup              candidates sort by slot id per iteration; repeats
+                     (two beam entries sharing an unvisited neighbor)
+                     mask to -1 so they cannot burn beam width
+  beam update        masked ``lax.top_k`` over old beam + candidates
+
+Termination: a fixed iteration cap (``hnsw.max_iters``) plus an
+early-exit-by-convergence flag — a query goes inactive once an
+expansion round admits no new candidate into its beam, and the
+``lax.while_loop`` stops when every query is inactive. Inactive queries
+ride along (lockstep has no partial shapes) but cannot change state.
+
+Filter pushdown (the PR 3 filter-mask cache, applied device-side): the
+kernel keeps TWO candidate lists. The ROUTING beam admits any
+store-valid node — a filtered-out node must still conduct the walk or
+low-selectivity filters would disconnect the graph — while the RESULT
+list only ever admits mask-eligible candidates, so masked candidates
+never enter the beam the caller reranks and no host post-filter pass
+exists. Unfiltered searches pass the validity mask for both and the two
+lists coincide.
+
+Returned slots are UNORDERED evidence: the caller reranks them with the
+exact device rerank (ops/rerank.py) so final ordering is byte-identical
+with the host graph path whenever the candidate sets agree.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from dingo_tpu.obs.sentinel import sentinel_jit
+
+
+def _candidate_scores(vecs, sqnorm, qd, slots, metric, sq, vmin, scale):
+    """'Larger is better' scores [b, C] for candidate slots [b, C] (-1 =
+    hole, scored -inf). One gather + one einsum through the SAME metric
+    math as the rerank kernels (ops/rerank._scores_from_rows) — the
+    byte-identical host/device ordering guarantee depends on it; bf16
+    tiers pair the query down, sq8 decodes to the bf16 surrogate, f32
+    accumulation everywhere."""
+    from dingo_tpu.ops.rerank import _scores_from_rows
+
+    safe = jnp.where(slots >= 0, slots, 0)
+    rows = jnp.take(vecs, safe, axis=0)                  # [b, C, d]
+    if sq:
+        from dingo_tpu.ops.sq import sq_decode_device
+
+        rows = sq_decode_device(rows, vmin, scale)       # bf16 surrogate
+    csq = jnp.take(sqnorm, safe)
+    scores = _scores_from_rows(rows, csq, qd, metric)
+    return jnp.where(slots >= 0, scores, -jnp.inf)
+
+
+@sentinel_jit("ops.beam.search",
+              static_argnames=("beam", "max_iters", "metric", "sq"))
+def beam_search(adj, vecs, sqnorm, valid, fmask, queries, entry, vmin,
+                scale, beam, max_iters, metric, sq):
+    """Lockstep graph walk; see module docstring for the design.
+
+    adj     [cap, deg] int32 slot-space adjacency (-1 padded)
+    vecs    [cap, d] rows (f32 / bf16 / uint8 sq codes when sq=True)
+    sqnorm  [cap] f32 stored/decoded row norms (SlotStore convention)
+    valid   [cap] bool — store validity: gates ROUTING and results
+    fmask   [cap] bool — filter pushdown: gates RESULTS only (pass
+            `valid` again when unfiltered)
+    queries [b, d] f32 (pre-normalized for cosine), entry [] int32
+            slot of the graph entry point (-1 = empty graph)
+    vmin/scale [d] f32 sq8 codec params (ignored when sq=False)
+
+    Returns (res_slots [b, beam] int32 candidate set (-1 padded,
+    unordered — rerank it), hops [b] int32 expansion rounds per query,
+    visited [b] int32 marked-slot count, occupancy [b] int32 live
+    result entries).
+    """
+    b, _ = queries.shape
+    cap, deg = adj.shape
+    nwords = (cap + 31) // 32
+    qd = queries.astype(jnp.float32)
+    res_ok = valid & fmask
+    rowix = jnp.arange(b)[:, None]
+
+    def score(slots):
+        return _candidate_scores(
+            vecs, sqnorm, qd, slots, metric, sq, vmin, scale
+        )
+
+    entry = entry.astype(jnp.int32)
+    entry_ok = entry >= 0
+    e_safe = jnp.maximum(entry, 0)
+    visited = jnp.zeros((b, nwords), jnp.uint32)
+    ebit = jnp.where(
+        entry_ok,
+        jnp.uint32(1) << (e_safe.astype(jnp.uint32) & 31),
+        jnp.uint32(0),
+    )
+    visited = visited.at[
+        jnp.arange(b), jnp.broadcast_to(e_safe >> 5, (b,))
+    ].add(jnp.broadcast_to(ebit, (b,)))
+
+    # seed: the entry always anchors the ROUTING beam (even when it is
+    # tombstoned or filtered out — its neighbors must still be reachable;
+    # a -inf score drops it at the first merge, after expansion), and
+    # joins the RESULT list only when eligible.
+    bslots = jnp.full((b, beam), -1, jnp.int32).at[:, 0].set(
+        jnp.where(entry_ok, entry, -1)
+    )
+    es = score(jnp.broadcast_to(entry, (b, 1)))[:, 0]
+    e_elig = entry_ok & jnp.take(res_ok, e_safe)
+    bscores = jnp.full((b, beam), -jnp.inf, jnp.float32).at[:, 0].set(
+        jnp.where(entry_ok & jnp.take(valid, e_safe), es, -jnp.inf)
+    )
+    rslots = jnp.full((b, beam), -1, jnp.int32).at[:, 0].set(
+        jnp.where(e_elig, entry, -1)
+    )
+    rscores = jnp.full((b, beam), -jnp.inf, jnp.float32).at[:, 0].set(
+        jnp.where(e_elig, es, -jnp.inf)
+    )
+    active = jnp.broadcast_to(entry_ok, (b,))
+    hops = jnp.zeros((b,), jnp.int32)
+
+    def cond(st):
+        it, active = st[0], st[6]
+        return (it < max_iters) & jnp.any(active)
+
+    def body(st):
+        it, bslots, bscores, rslots, rscores, visited, active, hops = st
+        hops = hops + active.astype(jnp.int32)
+        # 1) frontier gather: every beam entry expands one hop
+        safe_b = jnp.where(bslots >= 0, bslots, 0)
+        neigh = jnp.take(adj, safe_b, axis=0)            # [b, beam, deg]
+        neigh = jnp.where((bslots >= 0)[:, :, None], neigh, -1)
+        neigh = neigh.reshape(b, beam * deg)
+        # 2) drop holes, already-visited and store-invalid candidates
+        ok = neigh >= 0
+        safe_n = jnp.where(ok, neigh, 0)
+        words = safe_n >> 5
+        bits = (safe_n & 31).astype(jnp.uint32)
+        seen = (jnp.take_along_axis(visited, words, axis=1) >> bits) & 1
+        new = ok & (seen == 0) & jnp.take(valid, safe_n)
+        # 3) in-batch dedup: sort by slot (cap sorts holes last), mask
+        #    runs — duplicates of one slot carry identical scores, so
+        #    keeping the first survivor is exact
+        cs = jnp.where(new, safe_n, cap).astype(jnp.int32)
+        cs = jnp.sort(cs, axis=1)
+        dup = jnp.concatenate(
+            [jnp.zeros((b, 1), bool), cs[:, 1:] == cs[:, :-1]], axis=1
+        )
+        cand = jnp.where((cs < cap) & ~dup, cs, -1)
+        # 4) one einsum scores the whole candidate wave
+        cscores = score(cand)
+        # 5) mark survivors visited (scatter-add == OR: each slot
+        #    survives the not-visited mask at most once per walk, and
+        #    step 3 removed in-batch repeats)
+        csafe = jnp.where(cand >= 0, cand, 0)
+        addv = jnp.where(
+            cand >= 0,
+            jnp.uint32(1) << (csafe.astype(jnp.uint32) & 31),
+            jnp.uint32(0),
+        )
+        visited = visited.at[rowix, csafe >> 5].add(addv)
+        # 6) routing-beam merge: any store-valid candidate competes
+        mv, mi = lax.top_k(
+            jnp.concatenate([bscores, cscores], axis=1), beam
+        )
+        mslots = jnp.take_along_axis(
+            jnp.concatenate([bslots, cand], axis=1), mi, axis=1
+        )
+        mslots = jnp.where(jnp.isneginf(mv), -1, mslots)
+        entered = jnp.any((mi >= beam) & ~jnp.isneginf(mv), axis=1)
+        # 7) result merge: masked candidates never enter this beam
+        relig = (cand >= 0) & jnp.take(res_ok, csafe)
+        rv, ri = lax.top_k(
+            jnp.concatenate(
+                [rscores, jnp.where(relig, cscores, -jnp.inf)], axis=1
+            ),
+            beam,
+        )
+        nrslots = jnp.take_along_axis(
+            jnp.concatenate([rslots, cand], axis=1), ri, axis=1
+        )
+        nrslots = jnp.where(jnp.isneginf(rv), -1, nrslots)
+        # 8) convergence: a query with no beam admission is done — every
+        #    reachable unvisited node is now worse than its whole beam
+        active = active & entered
+        return (it + 1, mslots, mv, nrslots, rv, visited, active, hops)
+
+    st = (jnp.int32(0), bslots, bscores, rslots, rscores, visited, active,
+          hops)
+    st = lax.while_loop(cond, body, st)
+    rslots, visited, hops = st[3], st[5], st[7]
+    vcount = jnp.sum(
+        lax.population_count(visited), axis=1
+    ).astype(jnp.int32)
+    occ = jnp.sum((rslots >= 0).astype(jnp.int32), axis=1)
+    return rslots, hops, vcount, occ

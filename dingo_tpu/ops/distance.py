@@ -111,6 +111,20 @@ def normalize(x: jax.Array, eps: float = 1e-30) -> jax.Array:
     return (x32 / n[:, None]).astype(x.dtype)
 
 
+def np_normalize(x, eps: float = 1e-30):
+    """Host-side counterpart of ``normalize`` with the SAME epsilon
+    convention (floor on the SQUARED norm): cosine rows must normalize
+    to the same values no matter which side of the H2D boundary prepped
+    them — index families post-filter and parity-check each other, so
+    one divergent near-zero-row convention shows up as a ranking flake.
+    Pure numpy: no device round-trip on the write/search prep path."""
+    import numpy as np
+
+    x = np.ascontiguousarray(x, np.float32)
+    n = np.sqrt(np.maximum((x * x).sum(axis=1, dtype=np.float32), eps))
+    return np.ascontiguousarray(x / n[:, None])
+
+
 def pairwise_cosine(
     q: jax.Array,
     x: jax.Array,

@@ -20,6 +20,14 @@ descending) so they drop in right after any scan kernel:
                           the cache get exact scores; the rest keep their
                           quantized score, so a partial cache can only
                           IMPROVE the ranking, never lose a candidate.
+  sq_rerank_device      — the sq8 tier's exact-for-the-tier rerank:
+                          candidates gather as uint8 codes, decode to the
+                          bf16 surrogate in-kernel, and score with f32
+                          accumulation. Used by the HNSW device/host graph
+                          paths so both produce the same final ordering
+                          from the same candidate set; chain
+                          cached_rerank_device after it to upgrade cached
+                          rows to true f32-exact scores.
 """
 
 from __future__ import annotations
@@ -38,26 +46,40 @@ from dingo_tpu.ops.distance import (
 )
 
 
-def _exact_candidate_scores(vecs, sqnorm, queries, rows, metric):
-    """Exact 'larger is better' scores [b, k'] for candidate row indices
-    [b, k'] into vecs (callers pre-clamp negatives to 0)."""
-    cand = jnp.take(vecs, rows, axis=0)                 # [b, k', d]
+def _scores_from_rows(rows, c_sq, queries, metric):
+    """THE shared 'larger is better' metric math for per-candidate
+    scoring: every rerank kernel here AND the beam walk (ops/beam.py)
+    score through this one function, because the HNSW tier's
+    byte-identical host/device final-ordering guarantee holds only while
+    the L2/cosine/IP formulas (and the cosine epsilon) stay bit-equal
+    across paths.
+
+    rows [b, k', d] arrive ALREADY in the compute dtype — f32 for exact
+    scoring, the bf16 surrogate for quantized tiers (the query pairs
+    down to match); c_sq [b, k'] are the cached norms of exactly those
+    rows (unused for IP — XLA drops the dead gather)."""
     qd = queries.astype(jnp.float32)
     dots = jnp.einsum(
         "bd,bkd->bk",
-        qd,
-        cand.astype(jnp.float32),
+        qd.astype(rows.dtype),
+        rows,
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     )
     if metric is Metric.L2:
-        c_sq = jnp.take(sqnorm, rows, axis=0)           # [b, k']
         return -(squared_norms(qd)[:, None] - 2.0 * dots + c_sq)
     if metric is Metric.COSINE:
-        c_sq = jnp.take(sqnorm, rows, axis=0)
-        inv = jax.lax.rsqrt(jnp.maximum(c_sq, 1e-30))
-        return dots * inv
+        return dots * jax.lax.rsqrt(jnp.maximum(c_sq, 1e-30))
     return dots
+
+
+def _exact_candidate_scores(vecs, sqnorm, queries, rows, metric):
+    """Exact 'larger is better' scores [b, k'] for candidate row indices
+    [b, k'] into vecs (callers pre-clamp negatives to 0); rows widen to
+    f32 so bf16 caches still rerank with f32 multiplies."""
+    cand = jnp.take(vecs, rows, axis=0).astype(jnp.float32)  # [b, k', d]
+    c_sq = jnp.take(sqnorm, rows, axis=0)                    # [b, k']
+    return _scores_from_rows(cand, c_sq, queries, metric)
 
 
 def _topk_epilogue(scores, cand_slots, k, metric):
@@ -88,6 +110,28 @@ def exact_rerank_device(
     `_exact_rerank_host`, minus the host gather."""
     safe = jnp.where(cand_slots >= 0, cand_slots, 0)
     scores = _exact_candidate_scores(vecs, sqnorm, queries, safe, metric)
+    return _topk_epilogue(scores, cand_slots, k, metric)
+
+
+@sentinel_jit("ops.rerank.sq", static_argnames=("k", "metric"))
+def sq_rerank_device(
+    codes, vmin, scale, sqnorm, queries, cand_slots, k, metric
+):
+    """Top-k over candidate slots whose device rows are SQ8 CODES.
+
+    codes   — [capacity, d] uint8 (SqSlotStore.vecs)
+    sqnorm  — [capacity] f32 norms of the DECODED surrogate rows (the
+              SqSlotStore convention), so L2/cosine stay self-consistent
+              with the values actually scored.
+    Same (wire distances [b, k], slots [b, k]) contract as
+    exact_rerank_device; exact with respect to the decoded surrogate —
+    the best ordering the tier can produce without f32 rows."""
+    from dingo_tpu.ops.sq import sq_decode_device
+
+    safe = jnp.where(cand_slots >= 0, cand_slots, 0)
+    rows = sq_decode_device(jnp.take(codes, safe, axis=0), vmin, scale)
+    c_sq = jnp.take(sqnorm, safe, axis=0)
+    scores = _scores_from_rows(rows, c_sq, queries, metric)
     return _topk_epilogue(scores, cand_slots, k, metric)
 
 

@@ -42,7 +42,7 @@ from dingo_tpu.index.base import (
     resolve_precision,
     strip_invalid,
 )
-from dingo_tpu.ops.distance import Metric
+from dingo_tpu.ops.distance import Metric, np_normalize
 from dingo_tpu.parallel.sharded_store import (
     ShardedFlatStore,
     account_merge,
@@ -253,8 +253,7 @@ class TpuShardedFlat(VectorIndex):
         if vectors.ndim != 2 or vectors.shape[1] != self.dimension:
             raise InvalidParameter(f"vector dim {vectors.shape}")
         if self.metric is Metric.COSINE:
-            norms = np.linalg.norm(vectors, axis=1, keepdims=True)
-            vectors = vectors / np.maximum(norms, 1e-30)
+            vectors = np_normalize(vectors)
         return vectors
 
     def reserve(self, n: int) -> None:

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dingo_tpu.ops.distance import Metric
+from dingo_tpu.ops.distance import Metric, np_normalize
 from dingo_tpu.parallel.compat import shard_map
 from dingo_tpu.ops.topk import merge_sharded_topk, topk_scores
 from dingo_tpu.obs.sentinel import sentinel_jit
@@ -231,8 +231,7 @@ class ShardedFlatStore:
     def load(self, ids: np.ndarray, vectors: np.ndarray) -> None:
         vectors = np.asarray(vectors, np.float32)
         if self.metric is Metric.COSINE:
-            norms = np.linalg.norm(vectors, axis=1, keepdims=True)
-            vectors = vectors / np.maximum(norms, 1e-30)
+            vectors = np_normalize(vectors)
         n = vectors.shape[0]
         cap = -(-n // self.n_data)          # ceil
         cap = max(8, cap + (-cap) % 8)      # pad to sublane multiple
@@ -347,8 +346,7 @@ class ShardedFlatStore:
         queries = np.asarray(queries, np.float32)
         b = queries.shape[0]
         if self.metric is Metric.COSINE:
-            norms = np.linalg.norm(queries, axis=1, keepdims=True)
-            queries = queries / np.maximum(norms, 1e-30)
+            queries = np_normalize(queries)
         queries = pad_query_batch(queries, self.mesh)
         q = jax.device_put(
             queries, NamedSharding(self.mesh, batch_spec(self.mesh, "dim"))

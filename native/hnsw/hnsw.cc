@@ -43,6 +43,11 @@ struct Hnsw {
   std::unordered_map<int64_t, int> label_to_node;
   int entry = -1;
   int max_level = -1;
+  // Bumped whenever the adjacency STRUCTURE can have changed (new node
+  // inserted, snapshot loaded). In-place vector replacement and tombstone
+  // deletes keep the links untouched and do NOT bump it — the Python side
+  // keys its device adjacency mirror on (graph_version, store version).
+  int64_t graph_version = 0;
   std::mutex mu;
 
   int cap(int level) const { return level == 0 ? M0 : M; }
@@ -210,6 +215,7 @@ struct Hnsw {
       return it->second;
     }
     int node = (int)labels.size();
+    ++graph_version;
     labels.push_back(label);
     deleted.push_back(0);
     vecs.insert(vecs.end(), v, v + dim);
@@ -413,7 +419,55 @@ void* hnsw_load(const uint8_t* buf, int64_t len) {
   }
   for (size_t i = 0; i < n; ++i)
     h->label_to_node.emplace(h->labels[i], (int)i);
+  h->graph_version = (int64_t)n;
   return h;
+}
+
+// ---- device-graph export: flattened level-0 adjacency ----------------------
+// The TPU beam kernel walks a dense fixed-degree [n, deg] int array; these
+// hooks hand the Python side the level-0 neighbor lists (node indices,
+// -1 padded) plus the labels needed to remap node space -> slot space.
+
+int64_t hnsw_total_count(void* p) {
+  // total nodes INCLUDING tombstones (adjacency indexes by node id)
+  auto* h = (Hnsw*)p;
+  return (int64_t)h->labels.size();
+}
+
+int64_t hnsw_graph_version(void* p) {
+  auto* h = (Hnsw*)p;
+  return h->graph_version;
+}
+
+int64_t hnsw_entry_label(void* p) {
+  auto* h = (Hnsw*)p;
+  return h->entry >= 0 ? h->labels[h->entry] : -1;
+}
+
+void hnsw_export_level0(void* p, int64_t max_nodes, int deg_cap,
+                        int64_t* out_labels, int32_t* out_adj) {
+  auto* h = (Hnsw*)p;
+  std::lock_guard<std::mutex> g(h->mu);
+  // Clamp to the CALLER'S buffer capacity: the caller sized its arrays
+  // from an earlier hnsw_total_count() read, and a concurrent insert may
+  // have grown labels since — writing labels.size() entries would
+  // overflow the caller's heap. A clamped (stale) export is fine: the
+  // caller keys its mirror on graph_version and re-exports next search.
+  size_t n = std::min(h->labels.size(), (size_t)std::max<int64_t>(0, max_nodes));
+  if (n == 0) return;
+  std::memcpy(out_labels, h->labels.data(), n * sizeof(int64_t));
+  std::fill(out_adj, out_adj + n * (size_t)deg_cap, -1);
+  if (h->links.empty()) return;
+  const int c = h->cap(0);
+  const int take = std::min(deg_cap, c);
+  for (size_t i = 0; i < n; ++i) {
+    int cnt = std::min(h->link_count[0][i], take);
+    const int* nb = h->links[0].data() + i * (size_t)c;
+    for (int j = 0; j < cnt; ++j)
+      // neighbors past the clamp (concurrently inserted nodes wired
+      // into existing lists) have no label in the caller's view: pad
+      out_adj[i * (size_t)deg_cap + j] = nb[j] < (int64_t)n ? nb[j] : -1;
+  }
 }
 
 }  // extern "C"

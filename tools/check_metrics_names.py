@@ -15,10 +15,10 @@ Also linted:
   method names: `rpc.DebugService.MetricsDump`), but the name must start
   lowercase and stay inside the identifier-plus-dots alphabet.
 - curated metric families: literal registrations under the `xla.` /
-  `hbm.` / `flight.` / `ivf.` / `mesh.` prefixes (the device-runtime
-  observability + mesh serving planes) must name a series declared in
-  FAMILY_NAMES below — dashboards key on these exact names, so additions
-  are explicit, not incidental.
+  `hbm.` / `flight.` / `ivf.` / `mesh.` / `hnsw.` prefixes (the
+  device-runtime observability, mesh serving, and device graph planes)
+  must name a series declared in FAMILY_NAMES below — dashboards key on
+  these exact names, so additions are explicit, not incidental.
 
 Wired as a tier-1 test (tests/test_metrics_names.py) so a bad name fails
 CI, not the scrape.
@@ -86,6 +86,18 @@ FAMILY_NAMES = {
         "mesh.replica.inflight",    # concurrent searches per replica
         "mesh.replica.search_ms",   # per-replica latency (carries the
                                     # windowed QPS the planner reads)
+    },
+    "hnsw": {
+        "hnsw.device_searches",     # device graph-walk searches (PR 8)
+        "hnsw.host_searches",       # native C++ beam fallback searches
+        "hnsw.adjacency_rebuilds",  # level-0 exports into the device
+                                    # mirror (writes dirty it)
+        "hnsw.graph_nodes",         # exported nodes incl. tombstones
+        "hnsw.mean_hops",           # beam-expansion rounds per walk
+        "hnsw.visited_fraction",    # visited-bitmask population / capacity
+        "hnsw.beam_occupancy",      # live result-beam entries / beam width
+        "hnsw.filter_mask_hits",    # (fingerprint, store version) cache
+        "hnsw.filter_mask_misses",
     },
     "ivf": {
         "ivf.inplace_appends",      # view maintenance (PR 3)

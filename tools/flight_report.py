@@ -141,6 +141,40 @@ def _mesh_section(mesh: Dict[str, Any]) -> List[str]:
     return out
 
 
+def _hnsw_section(hnsw: Dict[str, Any]) -> List[str]:
+    """Per-region device graph-walk health at capture time (absolute
+    hnsw.* series): a slow HNSW search with a max_iters-bound walk or a
+    near-full visited set reads straight off this table."""
+    per: Dict[str, Dict[str, float]] = {}
+    for key, val in hnsw.items():
+        name, labels = _series_labels(key)
+        if not name.startswith("hnsw."):
+            continue
+        per.setdefault(labels.get("region", "-"), {})[name[5:]] = val
+    out = [f"-- hnsw device graph state ({len(hnsw)} series)"]
+    rows = []
+    for region in sorted(per):
+        st = per[region]
+        rows.append([
+            region,
+            f"{st.get('graph_nodes', 0):.0f}",
+            f"{st.get('mean_hops', 0):.1f}",
+            f"{st.get('visited_fraction', 0):.4f}",
+            f"{st.get('beam_occupancy', 0):.2f}",
+            f"{st.get('device_searches', 0):.0f}"
+            f"/{st.get('host_searches', 0):.0f}",
+            f"{st.get('adjacency_rebuilds', 0):.0f}",
+        ])
+    if rows:
+        out.extend(_table(
+            ["REGION", "NODES", "HOPS", "VISITED", "BEAM_OCC",
+             "DEV/HOST", "REBUILDS"], rows
+        ))
+    else:
+        out.append("  (no hnsw series)")
+    return out
+
+
 def render(bundle: Dict[str, Any]) -> str:
     out: List[str] = []
     created = bundle.get("created_ms", 0) / 1000.0
@@ -240,6 +274,11 @@ def render(bundle: Dict[str, Any]) -> str:
     if mesh:
         out.append("")
         out.extend(_mesh_section(mesh))
+
+    hnsw = bundle.get("hnsw") or {}
+    if hnsw:
+        out.append("")
+        out.extend(_hnsw_section(hnsw))
 
     slow = bundle.get("slow_queries") or []
     if slow:
