@@ -61,8 +61,22 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
     GetStoreMetricsResponse (pure render — tests drive it directly)."""
     store_rows = []
     region_rows = []
+
+    def _recall_cell(recall: float, samples: int) -> str:
+        # 0 scored queries = no evidence (sampling off / idle region):
+        # '-' beats a misleading 0.000
+        return f"{recall:.3f}" if samples else "-"
+
     for entry in resp.stores:
         m = entry.metrics
+        # store-level recall: sample-weighted mean over leader regions
+        # with evidence (the quality plane scores on the serving leader)
+        q_samples = sum(r.quality_samples for r in m.regions if r.is_leader)
+        q_recall = (
+            sum(r.quality_recall * r.quality_samples
+                for r in m.regions if r.is_leader) / q_samples
+            if q_samples else 0.0
+        )
         store_rows.append([
             entry.store_id,
             "STALE" if entry.stale else "ok",
@@ -75,6 +89,7 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
             _fmt_bytes(sum(r.device_peak_bytes for r in m.regions)),
             _fmt_bytes(m.device_bytes_in_use),
             f"{sum(r.search_qps for r in m.regions if r.is_leader):.1f}",
+            _recall_cell(q_recall, q_samples),
         ])
         for r in m.regions:
             if region_id and r.region_id != region_id:
@@ -97,19 +112,20 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
                 _fmt_bytes(r.device_peak_bytes),
                 str(r.apply_lag),
                 f"{r.search_qps:.1f}",
+                _recall_cell(r.quality_recall, r.quality_samples),
                 ",".join(flags) or "-",
             ])
     region_rows.sort(key=lambda r: (int(r[0]), r[1]))
     out = [
         _render_table(
             ["STORE", "METRICS", "REGIONS", "LEADERS", "KEYS", "VECTORS",
-             "MEM", "DEVMEM", "DEVPEAK", "DEV-IN-USE", "QPS"],
+             "MEM", "DEVMEM", "DEVPEAK", "DEV-IN-USE", "QPS", "RECALL"],
             store_rows,
         ),
         "",
         _render_table(
             ["REGION", "STORE", "ROLE", "KEYS", "VECTORS", "MEM", "DEVMEM",
-             "DEVPEAK", "LAG", "QPS", "FLAGS"],
+             "DEVPEAK", "LAG", "QPS", "RECALL", "FLAGS"],
             region_rows,
         ),
     ]

@@ -286,6 +286,38 @@ FLAGS.define("hnsw_max_iters", 48, mutable=True,
                    "one hop). The walk exits earlier once every query's "
                    "beam has converged; the cap bounds worst-case latency "
                    "on adversarial graphs")
+FLAGS.define("quality_sample_rate", 0.0, mutable=True,
+             help_="fraction of live searches re-answered EXACTLY by the "
+                   "shadow scan and scored for recall/RBO/score-gap "
+                   "(obs/quality.py). Head-sampled like tracing: 0 "
+                   "(default) is a zero-alloc noop — no shadow kernels, "
+                   "no mirrors, no estimator state; 1 scores every batch "
+                   "(bench/tests). Scoring runs on an async lane off the "
+                   "request's critical path")
+FLAGS.define("quality_slo_recall", 0.95, mutable=True,
+             help_="recall@k service-level objective the quality plane "
+                   "reports against and the SLO tuner steers toward: the "
+                   "tuner tightens knobs while the live estimate's CI "
+                   "upper bound sits below this, relaxes when the lower "
+                   "bound clears it with margin")
+FLAGS.define("quality_window_s", 60.0, mutable=True,
+             help_="sliding window of the live quality estimators: "
+                   "samples older than this age out of the recall "
+                   "estimate/CI (longer = tighter CI, slower reaction)")
+FLAGS.define("tuner_enabled", False, mutable=True,
+             help_="run the closed-loop SLO parameter controller "
+                   "(obs/tuner.py) on the store crontab: one "
+                   "cheap-to-expensive ladder step per tick per region, "
+                   "driven by the live recall CI vs quality.slo_recall. "
+                   "Requires quality.sample_rate > 0 to have a sensor")
+FLAGS.define("tuner_interval_s", 30.0, mutable=True,
+             help_="period of the quality_tuner crontab (one knob step "
+                   "at most per region per tick; the estimator window "
+                   "reset after each step is the hysteresis)")
+FLAGS.define("tuner_latency_budget_ms", 0.0, mutable=True,
+             help_="vector_search p99 budget the tuner respects: it "
+                   "never tightens past it, and relaxes while over it "
+                   "(if recall allows). 0 = no latency constraint")
 FLAGS.define("vector_blocked_layout", "auto", mutable=True,
              help_="maintain a dimension-blocked ([n_blocks, capacity, "
                    "block_d]) scan mirror + per-block norms in float/sq8 "

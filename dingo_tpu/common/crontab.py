@@ -51,6 +51,21 @@ class CrontabManager:
         with self._lock:
             self._crontabs.pop(name, None)
 
+    def set_interval(self, name: str, interval_s: float) -> bool:
+        """Hot-change a crontab's period (takes effect when the tab next
+        comes due — crontab bodies that advertise a hot-changeable
+        interval flag re-apply it here per tick). False if unknown."""
+        with self._lock:
+            tab = self._crontabs.get(name)
+            if tab is None:
+                return False
+            if tab.interval_s != interval_s:
+                tab.interval_s = interval_s
+                tab._next_due = min(
+                    tab._next_due, time.monotonic() + interval_s
+                )
+            return True
+
     def start(self) -> None:
         if self._thread is not None:
             return

@@ -219,6 +219,18 @@ class VectorIndex(abc.ABC):
         self.apply_log_id: int = 0     # wrapper consistency contract (§3.2)
         self.snapshot_log_id: int = 0
         self.write_count_since_save: int = 0
+        #: per-region serving-default overrides written by the SLO tuner
+        #: (obs/tuner.py): {"nprobe"|"ef"|"rerank_factor": int}. Search
+        #: paths consult these via tuned() when the REQUEST didn't pin the
+        #: parameter — a client-chosen nprobe/ef always wins. Values are
+        #: shape-ladder members, so overrides never mint new programs.
+        self.tuning: dict = {}
+
+    def tuned(self, knob: str, fallback: int) -> int:
+        """Effective serving default for `knob`: the tuner's override when
+        set, else the configured fallback."""
+        v = self.tuning.get(knob)
+        return int(v) if v else int(fallback)
 
     # -- metadata ----------------------------------------------------------
     @property

@@ -33,6 +33,7 @@ from dingo_tpu.index.rerank_cache import DeviceRerankCache
 from dingo_tpu.index.slot_store import SlotStore, SqSlotStore, _next_pow2
 from dingo_tpu.ops.distance import Metric, normalize, score_matrix, scores_to_distances
 from dingo_tpu.ops.topk import topk_scores
+from dingo_tpu.obs.quality import QUALITY
 from dingo_tpu.obs.sentinel import sentinel_jit
 
 
@@ -129,13 +130,17 @@ class _SlotStoreIndex(VectorIndex):
 
     def _rerank_shortlist(self, topk: int):
         """k' to over-fetch for the rerank stage, or None when the stage
-        is off (fp32 tier, no cache, empty cache, or factor <= 1)."""
+        is off (fp32 tier, no cache, empty cache, or factor <= 1). The
+        SLO tuner can override the conf factor per region (obs/tuner.py),
+        riding the same ladder values."""
         cache = self._rerank_cache
         if cache is None or not len(cache):
             return None
         from dingo_tpu.common.config import FLAGS
 
-        factor = int(FLAGS.get("quantized_rerank_factor"))
+        factor = self.tuned(
+            "rerank_factor", int(FLAGS.get("quantized_rerank_factor"))
+        )
         if factor <= 1:
             return None
         return topk * factor
@@ -210,12 +215,17 @@ class _SlotStoreIndex(VectorIndex):
             raise InvalidParameter("ids/vectors length mismatch")
         slots = self.store.put(np.asarray(ids, np.int64), vectors)
         self._offer_rerank(slots, vectors)
+        # quality plane: quantized tiers keep an fp32 ground-truth mirror
+        # fed the PRE-quantization rows (no-op while sampling is off)
+        QUALITY.observe_write(self, np.asarray(ids, np.int64), vectors)
         self.write_count_since_save += len(ids)
 
     def delete(self, ids: np.ndarray) -> None:
-        slots = self.store.remove_slots(np.asarray(ids, np.int64))
+        ids = np.asarray(ids, np.int64)
+        slots = self.store.remove_slots(ids)
         removed = int((slots >= 0).sum())
         self._invalidate_rerank(slots)
+        QUALITY.observe_delete(self, ids)
         self.write_count_since_save += removed
 
     # -- search ------------------------------------------------------------
@@ -297,6 +307,13 @@ class _SlotStoreIndex(VectorIndex):
                     self._note_prune_stats(jax.device_get(stats)[:b])
                 ids = store.ids_of_slots(slots_h[:b])
                 dists_h = self._convert_distances(dists_h)
+                # head-sampled shadow scoring (async lane; noop at rate 0);
+                # filtered searches carry their spec so the ground truth
+                # is restricted to the same candidate set
+                QUALITY.observe_search(
+                    self, queries, topk, ids, dists_h[:b], bucket="flat",
+                    filter_spec=filter_spec,
+                )
                 return [strip_invalid(i, d) for i, d in zip(ids, dists_h[:b])]
             finally:
                 lease.release()

@@ -146,6 +146,11 @@ class TpuHnsw(_SlotStoreIndex):
             raise InvalidParameter("ids/vectors length mismatch")
         slots = self.store.put(ids, vectors)
         self._offer_rerank(slots, vectors)
+        from dingo_tpu.obs.quality import QUALITY
+
+        # quality plane: quantized tiers mirror the pre-quantization rows
+        # for shadow ground truth (no-op while sampling is off)
+        QUALITY.observe_write(self, ids, vectors)
         _lib().hnsw_add(
             self._graph,
             len(ids),
@@ -159,6 +164,9 @@ class TpuHnsw(_SlotStoreIndex):
         slots = self.store.remove_slots(ids)
         removed = int((slots >= 0).sum())
         self._invalidate_rerank(slots)
+        from dingo_tpu.obs.quality import QUALITY
+
+        QUALITY.observe_delete(self, ids)
         _lib().hnsw_delete(
             self._graph, len(ids),
             ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
@@ -310,7 +318,10 @@ class TpuHnsw(_SlotStoreIndex):
     ):
         queries = self._prep_queries(queries)
         b = queries.shape[0]
-        ef = max(int(ef or self.ef_search_default), int(topk))
+        # request-pinned ef wins; else the SLO tuner's override; else the
+        # construction-derived default (obs/tuner.py walks ladder values)
+        ef = max(int(ef or self.tuned("ef", self.ef_search_default)),
+                 int(topk))
         self._count_search()
         if self._device_search_on():
             return self._device_search_async(
@@ -396,6 +407,14 @@ class TpuHnsw(_SlotStoreIndex):
                     hops_h[:b], vc_h[:b], occ_h[:b], cap, beam
                 )
                 ids = store.ids_of_slots(slots_h[:b])
+                # head-sampled shadow scoring, attributed to the beam
+                # bucket the walk ran with (async lane; noop at rate 0)
+                from dingo_tpu.obs.quality import QUALITY
+
+                QUALITY.observe_search(
+                    self, queries, topk, ids, dists_h[:b],
+                    bucket=f"ef={beam}", filter_spec=filter_spec,
+                )
                 return [strip_invalid(i, d)
                         for i, d in zip(ids, dists_h[:b])]
             finally:
@@ -454,6 +473,16 @@ class TpuHnsw(_SlotStoreIndex):
             try:
                 dists_h, slots_h = jax.device_get((dists, out_slots))
                 ids = store.ids_of_slots(slots_h[:b])
+                from dingo_tpu.obs.quality import QUALITY
+
+                # bucket = the LADDER value (same attribution as the
+                # device path): raw client-pinned ef would mint unbounded
+                # label cardinality and split one setting across names
+                QUALITY.observe_search(
+                    self, queries, topk, ids, dists_h[:b],
+                    bucket=f"ef={self._beam_width(ef, topk)}",
+                    filter_spec=filter_spec,
+                )
                 return [strip_invalid(i, d)
                         for i, d in zip(ids, dists_h[:b])]
             finally:

@@ -527,6 +527,11 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
             raise InvalidParameter("ids/vectors length mismatch")
         slots = self.store.put(np.asarray(ids, np.int64), vectors)
         self._offer_rerank(slots, vectors)
+        from dingo_tpu.obs.quality import QUALITY
+
+        # quality plane: quantized tiers mirror the pre-quantization rows
+        # for shadow ground truth (no-op while sampling is off)
+        QUALITY.observe_write(self, np.asarray(ids, np.int64), vectors)
         if self._assign_h.shape[0] < self.store.capacity:
             grown = np.full((self.store.capacity,), -1, np.int32)
             grown[: self._assign_h.shape[0]] = self._assign_h
@@ -545,9 +550,13 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         self.write_count_since_save += len(ids)
 
     def delete(self, ids: np.ndarray) -> None:
-        slots = self.store.remove_slots(np.asarray(ids, np.int64))
+        ids = np.asarray(ids, np.int64)
+        slots = self.store.remove_slots(ids)
         removed = int((slots >= 0).sum())
         self._invalidate_rerank(slots)
+        from dingo_tpu.obs.quality import QUALITY
+
+        QUALITY.observe_delete(self, ids)
         if removed:
             if self._view is not None and not self._view_dirty:
                 self._view_apply_delete(slots[slots >= 0])
@@ -742,7 +751,12 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         self._count_search()
         b = queries.shape[0]
         topk = int(topk)
-        nprobe = min(nprobe or self.parameter.default_nprobe, self.nlist)
+        # request-pinned nprobe wins; else the SLO tuner's override; else
+        # the configured default (obs/tuner.py walks ladder values only)
+        nprobe = min(
+            nprobe or self.tuned("nprobe", self.parameter.default_nprobe),
+            self.nlist,
+        )
         kprime = self._rerank_shortlist(topk)
         k_eff, nprobe = self._shape_buckets(max(topk, kprime or 0), nprobe)
         qpad = jnp.asarray(_pad_batch(queries))
@@ -864,6 +878,14 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
                 # shape bucketing may have run a larger k; slice back
                 ids = store.ids_of_slots(slots_h[:b, :topk])
                 dists_h = self._convert_distances(dists_h[:b, :topk])
+                # head-sampled shadow scoring, attributed to the nprobe
+                # bucket actually scanned (async lane; noop at rate 0)
+                from dingo_tpu.obs.quality import QUALITY
+
+                QUALITY.observe_search(
+                    self, queries, topk, ids, dists_h,
+                    bucket=f"nprobe={nprobe}", filter_spec=filter_spec,
+                )
                 return [strip_invalid(i, d) for i, d in zip(ids, dists_h)]
             finally:
                 lease.release()

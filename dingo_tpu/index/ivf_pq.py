@@ -354,6 +354,11 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
             raise InvalidParameter("ids/vectors length mismatch")
         slots = self.store.put(np.asarray(ids, np.int64), vectors)
         self._ensure_code_capacity()
+        from dingo_tpu.obs.quality import QUALITY
+
+        # quality plane: the fp32 store/host rows ARE the shadow ground
+        # truth for IVF_PQ, so this only syncs mirror-mode oracles
+        QUALITY.observe_write(self, np.asarray(ids, np.int64), vectors)
         if self.is_trained():
             dv = jnp.asarray(vectors)
             assign = kmeans_assign(dv, self.centroids)
@@ -372,8 +377,12 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
         self.write_count_since_save += len(ids)
 
     def delete(self, ids: np.ndarray) -> None:
-        slots = self.store.remove_slots(np.asarray(ids, np.int64))
+        ids = np.asarray(ids, np.int64)
+        slots = self.store.remove_slots(ids)
         removed = int((slots >= 0).sum())
+        from dingo_tpu.obs.quality import QUALITY
+
+        QUALITY.observe_delete(self, ids)
         if removed:
             if self._view is not None and not self._view_dirty:
                 self._view_apply_delete(slots[slots >= 0])
@@ -499,6 +508,11 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
         self._count_search()
         try:
             rerank = False
+            # quality-estimator bucket: the untrained hybrid arm scans
+            # EXACTLY regardless of any requested nprobe — labeling it
+            # with the caller's nprobe would pool recall-1.0 evidence
+            # into the post-training nprobe window
+            quality_bucket = "exact"
             if not self.is_trained():
                 # Hybrid contract: exact flat scan until trained
                 # (vector_index_ivf_pq.h:113-115).
@@ -526,10 +540,15 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
                         )
             else:
                 self._ensure_view()
+                # request-pinned nprobe wins; else the SLO tuner's
+                # override; else the configured default (obs/tuner.py)
                 nprobe = min(
-                    nprobe or self.parameter.default_nprobe, self.nlist
+                    nprobe
+                    or self.tuned("nprobe", self.parameter.default_nprobe),
+                    self.nlist,
                 )
                 k_eff, nprobe = self._shape_buckets(int(topk), nprobe)
+                quality_bucket = f"nprobe={nprobe}"
                 probes = _probe_lists(
                     qpad, self.centroids, self._c_sqnorm, nprobe
                 )
@@ -537,7 +556,9 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
                 # share one residual LUT across a list's spill buckets when
                 # the [b, nprobe, m, ksub] table fits comfortably in HBM
                 lut_bytes = qpad.shape[0] * nprobe * self.m * self.ksub * 4
-                factor = FLAGS.get("ivfpq_rerank_factor")
+                factor = self.tuned(
+                    "rerank_factor", int(FLAGS.get("ivfpq_rerank_factor"))
+                )
                 # ADC prune + exact rerank: host-resident rows rerank at
                 # resolve time (host gather); DEVICE-resident rows rerank
                 # on device right after the scan — no host gather, no
@@ -634,6 +655,15 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
                     dists_h, slots_h = jax.device_get((dists, slots))
                 # shape bucketing may have run a larger k; slice back
                 ids = store.ids_of_slots(slots_h[:b, : int(topk)])
+                # head-sampled shadow scoring (async lane; noop at rate 0)
+                from dingo_tpu.obs.quality import QUALITY
+
+                QUALITY.observe_search(
+                    self, queries, int(topk), ids,
+                    dists_h[:b, : int(topk)],
+                    bucket=quality_bucket,
+                    filter_spec=filter_spec,
+                )
                 return [
                     strip_invalid(i, d)
                     for i, d in zip(ids, dists_h[:b, : int(topk)])

@@ -165,6 +165,16 @@ class StoreMetricsCollector:
         rm.search_qps = self.registry.latency(
             "vector_search", region.id
         ).windowed_qps()
+        # live quality estimate (obs/quality.py): rides the heartbeat so
+        # the coordinator's rollups/cluster top can see recall per region
+        from dingo_tpu.obs.quality import QUALITY
+
+        est = QUALITY.region_estimate(region.id)
+        if est is not None:
+            rm.quality_recall = est["recall"]
+            rm.quality_recall_ci_low = est["ci_low"]
+            rm.quality_recall_ci_high = est["ci_high"]
+            rm.quality_samples = int(est["queries"])
         return rm
 
     def _approximate_bytes(self, start: bytes, end, key_count: int) -> int:
@@ -189,10 +199,13 @@ class StoreMetricsCollector:
         # retire series of regions this store no longer hosts (deleted,
         # merged away, moved) — their gauges would otherwise report the
         # last values forever and scrapers would double-count moved HBM
+        from dingo_tpu.obs.quality import QUALITY
+
         current = {rm.region_id for rm in snap.regions}
         for rid in self._published_regions - current:
             self.registry.drop_region(rid)
             HBM.forget_region(rid)
+            QUALITY.forget_region(rid)
         self._published_regions = current
         g = self.registry.gauge
         g("store.device.bytes_in_use").set(snap.device_bytes_in_use)

@@ -37,6 +37,15 @@ class RegionMetricsSnapshot:
     #: HBM high-watermark of the region total (obs hbm ledger); peaks are
     #: what size a region move or explain an OOM — instants don't
     device_peak_bytes: int = 0
+    #: live recall estimate from the quality plane (obs/quality.py):
+    #: windowed shadow-scan recall@k with its Wilson CI. quality_samples
+    #: is the number of scored queries in the window — 0 means the other
+    #: three fields are meaningless (sampling off or no traffic), so
+    #: renderers show '-' instead of 0.000
+    quality_recall: float = 0.0
+    quality_recall_ci_low: float = 0.0
+    quality_recall_ci_high: float = 0.0
+    quality_samples: int = 0
 
 
 @persist.register

@@ -14,23 +14,35 @@ observability"):
   OOM, snapshots spans + metric deltas + kernel cache + hbm ledger into
   a compressed bundle (DebugService ``FlightDump``,
   ``tools/flight_report.py``).
+- ``quality`` — live recall observability: shadow exact scans for a
+  head-sampled fraction of searches, windowed recall/RBO/score-gap
+  estimators with confidence intervals (``quality.*`` metrics family).
+- ``tuner`` — closed-loop SLO controller walking (rerank_factor, nprobe,
+  ef, precision) one shape-ladder step per tick against
+  ``quality.slo_recall`` and a latency budget.
 """
 
 from dingo_tpu.obs.flight import FLIGHT, FlightRecorder  # noqa: F401
 from dingo_tpu.obs.hbm import HBM, HbmLedger, looks_like_oom  # noqa: F401
+from dingo_tpu.obs.quality import QUALITY, QualityPlane  # noqa: F401
 from dingo_tpu.obs.sentinel import (  # noqa: F401
     SENTINEL,
     RecompileSentinel,
     sentinel_jit,
 )
+from dingo_tpu.obs.tuner import QualityTunerRunner, SloTuner  # noqa: F401
 
 __all__ = [
     "FLIGHT",
     "FlightRecorder",
     "HBM",
     "HbmLedger",
+    "QUALITY",
+    "QualityPlane",
+    "QualityTunerRunner",
     "RecompileSentinel",
     "SENTINEL",
+    "SloTuner",
     "looks_like_oom",
     "sentinel_jit",
 ]

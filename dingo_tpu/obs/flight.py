@@ -126,8 +126,10 @@ class FlightRecorder:
         balance / graph-walk health at capture time, not only how it
         moved during the window. Shares the capture's single registry
         dump. Captured families: mesh.* (shard rows, skew, replica
-        routing) and hnsw.* (hops, visited fraction, beam occupancy,
-        adjacency rebuilds)."""
+        routing), hnsw.* (hops, visited fraction, beam occupancy,
+        adjacency rebuilds), and quality.* (live recall/CI/RBO + tuner
+        knob positions — was the store trading recall when the incident
+        hit?)."""
         return {k: v for k, v in now_flat.items() if k.startswith(prefix)}
 
     # ---- triggers ----------------------------------------------------------
@@ -273,6 +275,7 @@ class FlightRecorder:
             "hbm": HBM.state(),
             "mesh": self._family_state(now_flat, "mesh."),
             "hnsw": self._family_state(now_flat, "hnsw."),
+            "quality": self._family_state(now_flat, "quality."),
             "config": config,
         }
         blob = zlib.compress(

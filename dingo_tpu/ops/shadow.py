@@ -1,0 +1,43 @@
+"""Shadow exact scan: the quality plane's ground-truth kernel.
+
+For a head-sampled fraction of live queries (obs/quality.py) the store
+re-answers the SAME query exactly — a whole-store scan + masked top-k over
+the best fp32 rows available for the region — and scores the served
+(approximate) result against it. This is the FLAT search kernel's math
+under its own sentinel name: shadow traffic must be attributable in the
+recompile sentinel / xla.* metrics as shadow work, never mistaken for a
+serving-path compile, and the serving kernels' per-shape signature
+accounting must not absorb the shadow path's (small, fixed) shape set.
+
+Shape discipline: callers pad the query batch to the fixed shadow batch
+bucket and round k up the {1,1.5}x-pow2 ladder, so the whole quality plane
+compiles a handful of programs once and then never again — the
+``quality.sample_rate = 0`` path dispatches nothing at all.
+"""
+
+from __future__ import annotations
+
+from dingo_tpu.obs.sentinel import sentinel_jit
+from dingo_tpu.ops.distance import Metric, score_matrix, scores_to_distances
+from dingo_tpu.ops.topk import topk_scores
+
+
+@sentinel_jit("ops.shadow.exact", static_argnames=("k", "metric"))
+def shadow_exact_topk(vecs, sqnorm, mask, queries, k, metric):
+    """Exact top-k over the whole store: [b, capacity] scores + masked
+    top-k; returns (wire distances [b, k], slot indices [b, k]).
+
+    vecs/sqnorm — [capacity, d] fp32 reference rows + cached ||x||^2 (for
+    cosine the rows are stored normalized, matching every float index's
+    write-side prep, so plain IP over them IS cosine).
+    mask        — [capacity] bool validity (tombstones already excluded).
+    """
+    scores = score_matrix(
+        queries,
+        vecs,
+        metric,
+        x_sqnorm=sqnorm,
+        x_is_normalized=(metric is Metric.COSINE),
+    )
+    vals, slots = topk_scores(scores, k, valid=mask)
+    return scores_to_distances(vals, metric), slots

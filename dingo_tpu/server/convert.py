@@ -251,6 +251,10 @@ _REGION_METRIC_FIELDS = (
     "index_building", "index_build_error", "index_apply_log_id",
     "index_snapshot_log_id", "apply_lag", "is_leader", "search_qps",
     "document_count", "device_peak_bytes",
+    # quality plane (obs/quality.py): windowed live recall + Wilson CI;
+    # quality_samples == 0 means the figures carry no evidence
+    "quality_recall", "quality_recall_ci_low", "quality_recall_ci_high",
+    "quality_samples",
 )
 
 _STORE_METRIC_FIELDS = (

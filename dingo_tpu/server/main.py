@@ -268,6 +268,18 @@ def serve_store(args) -> None:
         node.metrics.collect,
         immediately=True,
     )
+    # closed-loop SLO parameter controller (obs/tuner.py): one
+    # cheap-to-expensive ladder step per region per tick against the live
+    # recall CI from the quality plane. Hot-gated on tuner.enabled per
+    # tick (the replica-planner wiring pattern), so it always rides the
+    # crontab and no-ops while disabled or while estimates are stale
+    from dingo_tpu.obs import QualityTunerRunner
+
+    crontab.add(
+        "quality_tuner",
+        float(FLAGS.get("tuner_interval_s")),
+        QualityTunerRunner(node, crontab=crontab).tick,
+    )
     # device-runtime observability: process HBM watermark poll (per-region
     # owner ledgers refresh with each store_metrics pass) + region/index
     # config snapshots for flight-recorder bundles

@@ -68,6 +68,22 @@ def test_checker_enforces_curated_families(tmp_path):
     assert "FAMILY_NAMES" in problems[0][1]
 
 
+def test_checker_curates_quality_family(tmp_path):
+    """The quality plane's series are curated: declared names pass,
+    additions must be explicit in FAMILY_NAMES."""
+    f = tmp_path / "qual.py"
+    f.write_text(
+        "from dingo_tpu.common.metrics import METRICS\n"
+        "METRICS.gauge('quality.recall').set(0.97)\n"          # declared
+        "METRICS.counter('quality.shadow_scans').add(1)\n"     # declared
+        "METRICS.gauge('quality.tuner_nprobe').set(16)\n"      # declared
+        "METRICS.counter('quality.bogus_series').add(1)\n"     # undeclared
+    )
+    problems = checker.check_file(str(f))
+    assert [p[0] for p in problems] == [5], problems
+    assert "quality" in problems[0][1]
+
+
 def test_registry_name_rule_matches_lint():
     from dingo_tpu.common.metrics import valid_metric_name
 

@@ -1,0 +1,630 @@
+"""Quality observability plane + SLO tuner (ISSUE 9).
+
+Covers: estimator correctness vs brute force on seeded data; the
+sampling-off path dispatching ZERO shadow scans while leaving served
+results byte-identical (spy on the shadow kernel); CI width shrinking
+with evidence; tuner monotone stepping / ladder bounds / stale-metrics
+no-op via fake control; the heartbeat pb round-trip of the quality
+fields; and the recompile-sentinel invariant across tuner steps.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from dingo_tpu.common.config import FLAGS
+from dingo_tpu.common.metrics import METRICS
+from dingo_tpu.index import IndexParameter, IndexType, new_index
+from dingo_tpu.obs.quality import (
+    QUALITY,
+    WindowedEstimator,
+    rank_biased_overlap,
+    recall_hits,
+    score_gap,
+    wilson_interval,
+)
+from dingo_tpu.obs.tuner import (
+    RERANK_LADDER,
+    SloTuner,
+    ladder_step,
+    ladder_values,
+)
+
+
+@pytest.fixture(autouse=True)
+def _quality_env():
+    """Sampling off by default; every test that turns it on gets a clean
+    plane and restored flags afterwards."""
+    old_rate = FLAGS.get("quality_sample_rate")
+    old_win = FLAGS.get("quality_window_s")
+    FLAGS.set("quality_window_s", 3600.0)
+    yield
+    FLAGS.set("quality_sample_rate", old_rate)
+    FLAGS.set("quality_window_s", old_win)
+    QUALITY.clear()
+
+
+def _corpus(n=2000, d=32, seed=3, noise=2.0, nq=8):
+    rng = np.random.default_rng(seed)
+    ncl = 16
+    centers = rng.standard_normal((ncl, d), dtype=np.float32)
+    x = centers[rng.integers(0, ncl, n)] + noise * rng.standard_normal(
+        (n, d)
+    ).astype(np.float32)
+    ids = np.arange(n, dtype=np.int64)
+    queries = x[rng.choice(n, nq, replace=False)] + 0.3 * (
+        rng.standard_normal((nq, d)).astype(np.float32)
+    )
+    return ids, x, queries
+
+
+def _exact_gt(x, ids, queries, k):
+    dmat = (
+        (queries ** 2).sum(1)[:, None] - 2.0 * queries @ x.T
+        + (x ** 2).sum(1)[None, :]
+    )
+    return ids[np.argsort(dmat, axis=1)[:, :k]]
+
+
+def _recall(res, gt, k):
+    return float(np.mean(
+        [len(set(r.ids) & set(g)) / k for r, g in zip(res, gt)]
+    ))
+
+
+def _ivf(region_id, d=32, nlist=16, nprobe=2, precision=""):
+    return new_index(region_id, IndexParameter(
+        index_type=IndexType.IVF_FLAT, dimension=d, ncentroids=nlist,
+        default_nprobe=nprobe, precision=precision,
+    ))
+
+
+def _fake_estimate(recall, half=0.01, queries=100, trials=1000, age_s=0.0):
+    return {
+        "recall": recall,
+        "ci_low": max(0.0, recall - half),
+        "ci_high": min(1.0, recall + half),
+        "queries": queries,
+        "trials": trials,
+        "newest_ts": time.time() - age_s,
+        "oldest_ts": time.time() - age_s - 1.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# scoring math units
+# ---------------------------------------------------------------------------
+
+def test_recall_hits_ignores_padding():
+    served = np.asarray([1, 2, 3, -1, -1])
+    gt = np.asarray([2, 3, 9, -1, -1])
+    assert recall_hits(served, gt) == (2, 3)
+    assert recall_hits(np.asarray([-1]), np.asarray([-1])) == (0, 0)
+
+
+def test_rbo_order_sensitivity():
+    a = np.arange(10)
+    assert rank_biased_overlap(a, a) == pytest.approx(1.0)
+    assert rank_biased_overlap(a, a + 100) == pytest.approx(0.0)
+    # same SET, reversed order: overlap penalized but nonzero
+    r = rank_biased_overlap(a, a[::-1])
+    assert 0.0 < r < 1.0
+    # a prefix-correct list beats a suffix-correct one (top-weighted)
+    half_front = np.concatenate([a[:5], a[:5] + 100])
+    half_back = np.concatenate([a[5:] + 100, a[5:]])
+    assert rank_biased_overlap(half_front, a) > rank_biased_overlap(
+        half_back, a)
+
+
+def test_score_gap_relative_regret():
+    gt = np.asarray([0.5, 0.8, 1.0], np.float32)
+    served = np.asarray([0.5, 0.9, 1.2], np.float32)
+    assert score_gap(served, gt, ascending=True) == pytest.approx(0.2)
+    assert score_gap(gt, gt, ascending=True) == 0.0
+    # descending (IP): a SMALLER served k-th score is the regret
+    assert score_gap(
+        np.asarray([0.9], np.float32), np.asarray([1.0], np.float32),
+        ascending=False,
+    ) == pytest.approx(0.1)
+
+
+def test_wilson_ci_width_shrinks_with_samples():
+    lo1, hi1 = wilson_interval(95, 100)
+    lo2, hi2 = wilson_interval(950, 1000)
+    assert hi1 - lo1 > hi2 - lo2
+    assert lo1 < 0.95 < hi1 and lo2 < 0.95 < hi2
+    # p = 1.0 keeps a nonzero-width interval (the SLO regime)
+    lo, hi = wilson_interval(100, 100)
+    assert hi == 1.0 and 0.9 < lo < 1.0
+
+
+def test_estimator_windowing_and_reset():
+    est = WindowedEstimator()
+    est.add(8, 70, 80, 7.5, [0.1, 0.2])
+    st = est.stats()
+    assert st["recall"] == pytest.approx(70 / 80)
+    assert st["queries"] == 8 and st["trials"] == 80
+    assert st["ci_low"] < st["recall"] < st["ci_high"]
+    est.reset()
+    assert est.stats() is None
+    # aged-out entries leave the window (read-time pruning)
+    FLAGS.set("quality_window_s", 0.05)
+    est.add(4, 40, 40, 4.0, [])
+    time.sleep(0.12)
+    assert est.stats() is None
+
+
+# ---------------------------------------------------------------------------
+# live estimator vs brute force
+# ---------------------------------------------------------------------------
+
+def test_live_estimate_matches_brute_force():
+    ids, x, queries = _corpus()
+    k = 10
+    gt = _exact_gt(x, ids, queries, k)
+    idx = _ivf(9301)
+    idx.store.reserve(len(ids))
+    idx.upsert(ids, x)
+    idx.train()
+    FLAGS.set("quality_sample_rate", 1.0)
+    res = idx.search(queries, k)
+    assert QUALITY.flush()
+    est = QUALITY.region_estimate(9301)
+    offline = _recall(res, gt, k)
+    assert est is not None and est["queries"] == len(queries)
+    # the shadow oracle reads the same fp32 rows numpy scanned: the live
+    # estimate IS the brute-force recall of the served result
+    assert est["recall"] == pytest.approx(offline, abs=1e-6)
+    assert est["ci_low"] <= est["recall"] <= est["ci_high"]
+    # curated gauges published for the region rollup
+    assert METRICS.gauge("quality.recall", 9301).get() == pytest.approx(
+        offline, abs=1e-6)
+
+
+def test_sampling_off_is_inert(monkeypatch):
+    """quality.sample_rate = 0: zero shadow kernels dispatched, zero
+    estimator state, and served results identical to a sampled run."""
+    import dingo_tpu.ops.shadow as shadow_mod
+
+    calls = {"n": 0}
+    real = shadow_mod.shadow_exact_topk
+
+    def spy(*args, **kw):
+        calls["n"] += 1
+        return real(*args, **kw)
+
+    monkeypatch.setattr(shadow_mod, "shadow_exact_topk", spy)
+    ids, x, queries = _corpus(n=1500)
+    k = 10
+    idx = _ivf(9302)
+    idx.store.reserve(len(ids))
+    idx.upsert(ids, x)
+    idx.train()
+    scans0 = METRICS.counter("quality.shadow_scans", 9302).get()
+    res_off = idx.search(queries, k)
+    res_off2 = idx.search(queries, k)
+    QUALITY.flush()
+    assert calls["n"] == 0
+    assert METRICS.counter("quality.shadow_scans", 9302).get() == scans0
+    assert QUALITY.region_estimate(9302) is None
+    # sampling ON must not perturb the served results either
+    FLAGS.set("quality_sample_rate", 1.0)
+    res_on = idx.search(queries, k)
+    QUALITY.flush()
+    assert calls["n"] >= 1
+    for a, b, c in zip(res_off, res_off2, res_on):
+        np.testing.assert_array_equal(a.ids, b.ids)
+        np.testing.assert_array_equal(a.ids, c.ids)
+        np.testing.assert_allclose(a.distances, c.distances)
+
+
+def test_ci_width_shrinks_with_sample_count():
+    ids, x, queries = _corpus()
+    idx = _ivf(9303)
+    idx.store.reserve(len(ids))
+    idx.upsert(ids, x)
+    idx.train()
+    FLAGS.set("quality_sample_rate", 1.0)
+    idx.search(queries, 10)
+    QUALITY.flush()
+    one = QUALITY.region_estimate(9303)
+    for _ in range(9):
+        idx.search(queries, 10)
+    QUALITY.flush()
+    many = QUALITY.region_estimate(9303)
+    assert many["queries"] > one["queries"]
+    assert (many["ci_high"] - many["ci_low"]) < (
+        one["ci_high"] - one["ci_low"])
+
+
+def test_quantized_mirror_keeps_original_rows():
+    """sq8 tier: the oracle's ground truth is the ORIGINAL fp32 rows fed
+    at write time — not the decoded surrogate — so the live estimate sees
+    quantization loss; deletes leave the mirror too."""
+    FLAGS.set("quality_sample_rate", 1.0)
+    rng = np.random.default_rng(11)
+    d = 16
+    x = rng.standard_normal((64, d)).astype(np.float32)
+    ids = np.arange(64, dtype=np.int64)
+    idx = new_index(9304, IndexParameter(
+        index_type=IndexType.FLAT, dimension=d, precision="sq8",
+    ))
+    idx.upsert(ids, x)
+    oracle = QUALITY._oracle_for(idx)
+    assert oracle.mode == "mirror"
+    snap = oracle._mirror.to_host()
+    order = np.argsort(snap["ids"])
+    np.testing.assert_array_equal(snap["ids"][order], ids)
+    # bit-exact originals, NOT sq8-decoded values
+    np.testing.assert_array_equal(snap["vectors"][order], x)
+    idx.delete(ids[:8])
+    answer = oracle.exact_topk(x[:2], k=4)
+    assert answer is not None
+    gt_ids, _ = answer
+    assert not (set(gt_ids.ravel().tolist()) & set(range(8)))
+
+
+def test_filtered_search_scored_against_filtered_truth():
+    """A filtered search's ground truth is restricted to the SAME
+    candidate set (review finding): low-selectivity filters must not
+    read as recall collapses and stampede the tuner."""
+    from dingo_tpu.index.base import FilterSpec
+
+    ids, x, queries = _corpus(n=2000)
+    k = 10
+    idx = _ivf(9309, nlist=16, nprobe=16)     # full probe: exact
+    idx.store.reserve(len(ids))
+    idx.upsert(ids, x)
+    idx.train()
+    # 1/8 selectivity whitelist
+    keep = ids[ids % 8 == 3]
+    spec = FilterSpec(include_ids=keep)
+    FLAGS.set("quality_sample_rate", 1.0)
+    res = idx.search(queries, k, spec)
+    assert QUALITY.flush()
+    est = QUALITY.region_estimate(9309)
+    # full-probe IVF over the filtered set IS exact: against filtered
+    # truth the estimate reads ~1.0 (vs ~0.125 against unfiltered truth)
+    assert est is not None and est["recall"] > 0.95
+    # sanity: the served sets really were filtered
+    assert all(set(r.ids) <= set(keep.tolist()) for r in res)
+    # and the oracle agrees with a numpy brute force over the subset
+    mask = np.isin(ids, keep)
+    gt_f = _exact_gt(x[mask], ids[mask], queries, k)
+    assert est["recall"] == pytest.approx(
+        _recall(res, gt_f, k), abs=1e-6)
+
+
+def test_mirror_survives_sample_rate_toggle():
+    """An attached mirror keeps syncing while sampling is momentarily
+    off: rate 1 -> 0 -> 1 around a write burst must not leave deleted
+    rows in the ground truth or miss fresh ones (review finding)."""
+    FLAGS.set("quality_sample_rate", 1.0)
+    rng = np.random.default_rng(21)
+    d = 16
+    x = rng.standard_normal((64, d)).astype(np.float32)
+    ids = np.arange(64, dtype=np.int64)
+    idx = new_index(9306, IndexParameter(
+        index_type=IndexType.FLAT, dimension=d, precision="sq8",
+    ))
+    idx.upsert(ids[:32], x[:32])
+    oracle = QUALITY._oracle_for(idx)
+    # incident: operator flips sampling off; writes keep flowing
+    FLAGS.set("quality_sample_rate", 0.0)
+    idx.delete(ids[:8])
+    idx.upsert(ids[32:], x[32:])
+    FLAGS.set("quality_sample_rate", 1.0)
+    answer = oracle.exact_topk(x[40:42], k=4)
+    gt_ids, _ = answer
+    found = set(gt_ids.ravel().tolist())
+    assert not (found & set(range(8)))          # deletes left the mirror
+    snap = oracle._mirror.to_host()
+    assert set(snap["ids"]) == set(ids[8:].tolist())   # fresh rows landed
+
+
+def test_tuner_skips_rerank_knob_without_cache():
+    """bf16/sq8 regions with no rerank cache must not burn ticks on a
+    disconnected rerank_factor dial (review finding): the first tighten
+    goes straight to nprobe."""
+    FLAGS.set("rerank_cache_rows", 0)
+    idx = _ivf(9307, nlist=16, nprobe=1, precision="bf16")
+    assert idx._rerank_cache is None
+    tuner = SloTuner(slo_recall=0.95, latency_budget_ms=0.0,
+                     quality_plane=_PlaneRecorder())
+    op = tuner.step_index(idx, _fake_estimate(0.5))
+    assert op.knob == "nprobe"
+
+
+def test_precision_advisory_fires_once_per_episode():
+    """The unapplied precision advisory is rate-limited to one per
+    stuck-at-ceiling episode, re-armed by leaving the regime (review
+    finding: it used to re-fire every tick forever)."""
+    FLAGS.set("rerank_cache_rows", 0)
+    idx = _ivf(9308, nlist=16, nprobe=16, precision="sq8")
+    tuner = SloTuner(slo_recall=0.99, latency_budget_ms=0.0,
+                     quality_plane=_PlaneRecorder())
+    op = tuner.step_index(idx, _fake_estimate(0.5))
+    assert op is not None and op.knob == "precision" and not op.applied
+    for _ in range(3):
+        assert tuner.step_index(idx, _fake_estimate(0.5)) is None
+    # recovery (in band) re-arms the advisory for the next episode
+    assert tuner.step_index(idx, _fake_estimate(0.99, half=0.02)) is None
+    op = tuner.step_index(idx, _fake_estimate(0.5))
+    assert op is not None and op.knob == "precision"
+
+
+def test_install_reference_and_score_direct():
+    """The mesh-bench rider mechanism: a standalone fp32 reference +
+    synchronous scoring through the same estimator plumbing."""
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((256, 16)).astype(np.float32)
+    ids = np.arange(256, dtype=np.int64)
+    queries = x[:4]
+    k = 5
+    gt = _exact_gt(x, ids, queries, k)
+    QUALITY.install_reference(9305, ids, x)
+    perfect = QUALITY.score_direct(9305, queries, gt, k, kind="mesh")
+    assert perfect["recall"] == pytest.approx(1.0)
+    wrong = gt.copy()
+    wrong[:, 0] = -1            # drop the top hit of every query
+    partial = QUALITY.score_direct(9305, queries, wrong, k, kind="mesh")
+    assert partial["recall"] == pytest.approx((k - 1) / k)
+    est = QUALITY.region_estimate(9305)
+    assert est is not None and est["queries"] == 8
+
+
+# ---------------------------------------------------------------------------
+# tuner
+# ---------------------------------------------------------------------------
+
+def test_ladder_helpers():
+    vals = ladder_values(64)
+    assert vals == (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64)
+    assert ladder_step(vals, 1, up=True) == 2
+    assert ladder_step(vals, 8, up=True) == 12
+    assert ladder_step(vals, 80, up=True) is None     # past the cap
+    assert ladder_step(vals, 64, up=True) is None     # ceiling
+    assert ladder_step(vals, 1, up=False) is None     # floor
+    assert ladder_step(vals, 12, up=False) == 8
+    # off-ladder current value (operator-configured): snaps to neighbors
+    assert ladder_step(vals, 10, up=True) == 12
+    assert ladder_step(vals, 10, up=False) == 8
+
+
+class _PlaneRecorder:
+    def __init__(self):
+        self.resets = []
+
+    def reset_region(self, region_id):
+        self.resets.append(region_id)
+
+
+def test_tuner_monotone_tighten_to_ladder_ceiling():
+    idx = _ivf(9310, nlist=16, nprobe=1)
+    plane = _PlaneRecorder()
+    tuner = SloTuner(slo_recall=0.95, latency_budget_ms=0.0,
+                     quality_plane=plane)
+    seen = []
+    for _ in range(12):
+        op = tuner.step_index(idx, _fake_estimate(0.5))
+        if op is None or op.knob == "precision":
+            break
+        seen.append(op.new)
+    assert seen == [2, 3, 4, 6, 8, 12, 16]     # strictly ladder-monotone
+    assert idx.tuning["nprobe"] == 16
+    # ceiling reached on a fp32 index: nothing further to tighten
+    assert tuner.step_index(idx, _fake_estimate(0.5)) is None
+    assert plane.resets == [9310] * 7          # window reset per step
+
+
+def test_tuner_relax_floors_at_ladder_bottom():
+    idx = _ivf(9311, nlist=16, nprobe=4)
+    plane = _PlaneRecorder()
+    tuner = SloTuner(slo_recall=0.90, latency_budget_ms=0.0,
+                     quality_plane=plane)
+    comfortably_above = _fake_estimate(0.999, half=0.001)
+    steps = []
+    for _ in range(6):
+        op = tuner.step_index(idx, comfortably_above)
+        if op is None:
+            break
+        steps.append(op.new)
+    assert steps == [3, 2, 1]
+    assert tuner.step_index(idx, comfortably_above) is None   # floor
+
+
+def test_tuner_stale_or_thin_evidence_is_noop():
+    idx = _ivf(9312, nlist=16, nprobe=4)
+    tuner = SloTuner(slo_recall=0.95, latency_budget_ms=0.0,
+                     quality_plane=_PlaneRecorder(), min_queries=32)
+    assert tuner.step_index(idx, None) is None
+    assert tuner.step_index(
+        idx, _fake_estimate(0.5, queries=8)) is None        # too thin
+    assert tuner.step_index(
+        idx, _fake_estimate(0.5, age_s=3600 * 5)) is None   # stale
+    assert "nprobe" not in idx.tuning
+
+
+def test_tuner_in_band_holds_and_budget_blocks():
+    idx = _ivf(9313, nlist=16, nprobe=4)
+    tuner = SloTuner(slo_recall=0.95, latency_budget_ms=5.0,
+                     quality_plane=_PlaneRecorder())
+    # CI straddles the SLO: no confident violation, no comfortable excess
+    assert tuner.step_index(idx, _fake_estimate(0.95, half=0.02)) is None
+    # confident violation but the latency budget is blown: hold + count
+    blocked0 = METRICS.counter("quality.tuner_blocked", 9313).get()
+    assert tuner.step_index(
+        idx, _fake_estimate(0.5), p99_ms=50.0) is None
+    assert METRICS.counter(
+        "quality.tuner_blocked", 9313).get() == blocked0 + 1
+    # over budget AND above SLO: relax toward faster settings
+    op = tuner.step_index(
+        idx, _fake_estimate(0.999, half=0.0005), p99_ms=50.0)
+    assert op is not None and op.direction == "relax"
+
+
+def test_tuner_quantized_knob_order_and_precision_advisory():
+    """Quantized IVF: rerank_factor is the cheap knob (walked first);
+    when every live knob tops out, the remaining move is an ADVISORY
+    precision upgrade (never auto-applied)."""
+    FLAGS.set("rerank_cache_rows", 64)
+    try:
+        idx = _ivf(9314, nlist=16, nprobe=16, precision="sq8")
+        idx.tuning["rerank_factor"] = RERANK_LADDER[-1] - 1
+        tuner = SloTuner(slo_recall=0.99, latency_budget_ms=0.0,
+                         quality_plane=_PlaneRecorder())
+        op = tuner.step_index(idx, _fake_estimate(0.5))
+        assert op.knob == "rerank_factor" and op.new == RERANK_LADDER[-1]
+        # rerank + nprobe both at ceiling -> advisory tier upgrade
+        op = tuner.step_index(idx, _fake_estimate(0.5))
+        assert op.knob == "precision" and op.new == "bf16"
+        assert not op.applied
+        assert getattr(idx, "_precision") == "sq8"   # NOT flipped live
+    finally:
+        FLAGS.set("rerank_cache_rows", 0)
+
+
+def test_tuner_knobs_for_hnsw():
+    idx = new_index(9315, IndexParameter(
+        index_type=IndexType.HNSW, dimension=8, nlinks=4,
+        efconstruction=32,
+    ))
+    tuner = SloTuner(slo_recall=0.95, latency_budget_ms=0.0,
+                     quality_plane=_PlaneRecorder())
+    op = tuner.step_index(idx, _fake_estimate(0.5))
+    assert op.knob == "ef" and op.new > idx.ef_search_default
+    assert idx.tuning["ef"] == op.new
+
+
+def test_tuner_override_reaches_the_search_path():
+    """The applied override changes what the region actually serves: a
+    tightened nprobe must measurably raise recall on a hard corpus."""
+    ids, x, queries = _corpus(n=3000, noise=2.0)
+    k = 10
+    gt = _exact_gt(x, ids, queries, k)
+    idx = _ivf(9316, nlist=16, nprobe=1)
+    idx.store.reserve(len(ids))
+    idx.upsert(ids, x)
+    idx.train()
+    before = _recall(idx.search(queries, k), gt, k)
+    idx.tuning["nprobe"] = 16                      # ladder ceiling
+    after = _recall(idx.search(queries, k), gt, k)
+    assert after >= before
+    assert after == pytest.approx(
+        _recall(idx.search(queries, k, nprobe=16), gt, k))
+    # a request-pinned nprobe overrides the tuner's default
+    pinned = _recall(idx.search(queries, k, nprobe=1), gt, k)
+    assert pinned == pytest.approx(before, abs=1e-6)
+
+
+def test_recompile_sentinel_invariant_across_tuner_steps():
+    """Tuner steps only ever pick shape-ladder values, so a warmed region
+    serves the WHOLE walk with zero jit-cache misses — the PR 5 sentinel
+    makes it checkable."""
+    ids, x, queries = _corpus(n=2000, noise=2.0)
+    k = 10
+    idx = _ivf(9317, nlist=16, nprobe=1)
+    idx.store.reserve(len(ids))
+    idx.upsert(ids, x)
+    idx.train()
+    for np_ in ladder_values(16):
+        idx.warmup(batches=(len(queries),), topk=k, nprobe=np_)
+    FLAGS.set("quality_sample_rate", 1.0)
+    idx.search(queries, k)             # warm the shadow kernel's shapes
+    assert QUALITY.flush()
+    rc = METRICS.counter("xla.recompiles")
+    rc0 = rc.get()
+    tuner = SloTuner(slo_recall=0.99, latency_budget_ms=0.0,
+                     min_queries=4)
+    for _ in range(8):
+        idx.search(queries, k)
+        assert QUALITY.flush()
+        tuner.step_index(idx, QUALITY.region_estimate(9317))
+    assert idx.tuning.get("nprobe", 1) > 1         # the walk happened
+    assert rc.get() - rc0 == 0
+
+
+# ---------------------------------------------------------------------------
+# heartbeat / surfacing
+# ---------------------------------------------------------------------------
+
+def test_quality_fields_ride_heartbeat_pb_roundtrip():
+    from dingo_tpu.metrics.snapshot import (
+        RegionMetricsSnapshot,
+        StoreMetricsSnapshot,
+    )
+    from dingo_tpu.server import convert
+
+    rm = RegionMetricsSnapshot(
+        region_id=7, vector_count=100, is_leader=True, search_qps=12.5,
+        quality_recall=0.971, quality_recall_ci_low=0.95,
+        quality_recall_ci_high=0.988, quality_samples=64,
+    )
+    snap = StoreMetricsSnapshot(store_id="s1", regions=[rm])
+    msg = convert.store_metrics_to_pb(snap)
+    wire = type(msg).FromString(msg.SerializeToString())
+    back = convert.store_metrics_from_pb(wire)
+    got = back.region(7)
+    assert got.quality_recall == pytest.approx(0.971)
+    assert got.quality_recall_ci_low == pytest.approx(0.95)
+    assert got.quality_recall_ci_high == pytest.approx(0.988)
+    assert got.quality_samples == 64
+    # persist round-trip (the replicated coordinator's raft leg)
+    from dingo_tpu.common import persist
+
+    again = persist.loads(persist.dumps(snap))
+    assert again.region(7).quality_recall == pytest.approx(0.971)
+
+
+def test_cluster_top_renders_recall_column():
+    from dingo_tpu.client.cli import format_cluster_top
+    from dingo_tpu.server import pb
+
+    resp = pb.GetStoreMetricsResponse()
+    entry = resp.stores.add()
+    entry.store_id = "s1"
+    entry.metrics.store_id = "s1"
+    r1 = entry.metrics.regions.add()
+    r1.region_id = 1
+    r1.is_leader = True
+    r1.quality_recall = 0.973
+    r1.quality_samples = 80
+    r2 = entry.metrics.regions.add()
+    r2.region_id = 2          # no evidence: renders '-'
+    out = format_cluster_top(resp)
+    assert "RECALL" in out
+    assert "0.973" in out
+    # region 2 has no evidence: its RECALL cell is '-'
+    line2 = next(ln for ln in out.splitlines() if ln.startswith("2 "))
+    cells = line2.split()
+    assert cells[-2] == "-"     # RECALL sits before FLAGS
+
+
+def test_flight_bundle_captures_quality_state(tmp_path):
+    from dingo_tpu.obs.flight import FLIGHT
+
+    ids, x, queries = _corpus(n=1500)
+    idx = _ivf(9320)
+    idx.store.reserve(len(ids))
+    idx.upsert(ids, x)
+    idx.train()
+    FLAGS.set("quality_sample_rate", 1.0)
+    idx.search(queries, 10)
+    assert QUALITY.flush()
+    bid = FLIGHT.trigger("slow_query", name="test.quality")
+    assert bid
+    bundle = FLIGHT.get_json(bid)
+    assert any(k.startswith("quality.recall") for k in bundle["quality"])
+    # the report tool renders a per-region quality table from it
+    import importlib
+
+    report = importlib.import_module("tools.flight_report")
+    text = report.render(bundle)
+    assert "quality / slo-tuner state" in text
+    assert "RECALL" in text
+    # and parse_bundle round-trips the payload file form
+    p = tmp_path / "bundle.json"
+    p.write_text(json.dumps(bundle))
+    assert report.parse_bundle(str(p))["id"] == bundle["id"]

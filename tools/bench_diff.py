@@ -1,0 +1,191 @@
+"""Diff two bench.py JSON summaries and flag performance regressions.
+
+The BENCH_r0*.json trajectory has been eyeball-only since round 1; this
+makes it machine-checkable:
+
+    python tools/bench_diff.py OLD.json NEW.json \
+        [--qps-drop 0.15] [--recall-drop 0.02] [--bytes-grow 0.25] [--json]
+
+Both files are flattened to dotted numeric paths; a metric is compared
+only when BOTH summaries carry it (new scenarios / removed scenarios are
+reported as coverage changes, never as regressions). Classification is by
+key name, so the tool keeps working as bench grows scenarios:
+
+  qps        — any key named/suffixed `qps` or a top-level `value` whose
+               sibling `unit` is qps: regression when it drops by more
+               than --qps-drop (relative).
+  recall     — keys containing `recall` (excluding deltas/booleans):
+               regression when it drops by more than --recall-drop
+               (absolute — recall is already a fraction).
+  bytes      — `hbm`/`bytes` keys: regression when they GROW by more
+               than --bytes-grow (relative).
+  recompiles — `recompiles` keys: regression when a steady-state counter
+               that was meeting the invariant (0) becomes nonzero, or
+               grows at all.
+
+Exit status: 0 = no regressions, 1 = regressions found (CI-gateable),
+2 = usage/file errors. All human output goes to stdout; --json emits the
+machine-readable comparison instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def flatten(obj: Any, prefix: str = "") -> Dict[str, float]:
+    """Numeric leaves only, dotted paths; bools excluded (gates, not
+    magnitudes); list elements index into the path."""
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(flatten(v, f"{prefix}.{k}" if prefix else str(k)))
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            out.update(flatten(v, f"{prefix}[{i}]"))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        out[prefix] = float(obj)
+    return out
+
+
+def classify(path: str, summary: Optional[dict] = None) -> Optional[str]:
+    """Metric kind for a flattened path, or None (not perf-compared)."""
+    if "trajectory" in path.lower():
+        # recall_slo's per-tick convergence trail: it INTENTIONALLY
+        # starts mistuned (~0.4 recall at tick 1) and mid-walk estimates
+        # vary run to run — diagnostics, never a regression signal
+        return None
+    leaf = path.rsplit(".", 1)[-1]
+    low = leaf.lower()
+    if "baseline" in low:
+        # the CPU reference measurement drifts with the host, not with
+        # the code under test — never a regression signal
+        return None
+    if low == "value" and summary is not None and (
+        summary.get("unit") == "qps"
+    ):
+        return "qps"
+    if low == "qps" or low.endswith("_qps") or low.startswith("qps_"):
+        return "qps"
+    if "recall" in low:
+        # deltas/differences around recall are signed diagnostics, not
+        # magnitudes to threshold
+        if "delta" in low or "vs" in low:
+            return None
+        return "recall"
+    if "recompile" in low:
+        return "recompiles"
+    if "hbm" in low or low.endswith("bytes") or low.endswith(
+            "bytes_per_vector"):
+        return "bytes"
+    return None
+
+
+def compare(old: dict, new: dict, qps_drop: float = 0.15,
+            recall_drop: float = 0.02, bytes_grow: float = 0.25
+            ) -> Dict[str, Any]:
+    """Full comparison record: per-metric rows + regression list +
+    coverage changes."""
+    fo, fn = flatten(old), flatten(new)
+    rows: List[Dict[str, Any]] = []
+    regressions: List[Dict[str, Any]] = []
+    for path in sorted(set(fo) & set(fn)):
+        kind = classify(path, new if "." not in path else None)
+        if kind is None:
+            continue
+        ov, nv = fo[path], fn[path]
+        row = {"path": path, "kind": kind, "old": ov, "new": nv}
+        bad = False
+        if kind == "qps":
+            change = (nv - ov) / ov if ov else 0.0
+            row["change"] = round(change, 4)
+            bad = ov > 0 and change < -qps_drop
+        elif kind == "recall":
+            row["change"] = round(nv - ov, 4)
+            bad = (ov - nv) > recall_drop
+        elif kind == "bytes":
+            change = (nv - ov) / ov if ov else 0.0
+            row["change"] = round(change, 4)
+            bad = ov > 0 and change > bytes_grow
+        elif kind == "recompiles":
+            row["change"] = round(nv - ov, 4)
+            # the steady-state invariant: any growth is a regression
+            bad = nv > ov
+        row["regression"] = bad
+        rows.append(row)
+        if bad:
+            regressions.append(row)
+    return {
+        "compared": len(rows),
+        "rows": rows,
+        "regressions": regressions,
+        "only_old": sorted(p for p in set(fo) - set(fn) if classify(p)),
+        "only_new": sorted(p for p in set(fn) - set(fo) if classify(p)),
+    }
+
+
+def _fmt(v: float) -> str:
+    return f"{v:g}"
+
+
+def render(result: Dict[str, Any]) -> str:
+    out: List[str] = []
+    regs = result["regressions"]
+    out.append(
+        f"compared {result['compared']} metrics: "
+        f"{len(regs)} regression(s)"
+    )
+    if regs:
+        w = max(len(r["path"]) for r in regs)
+        for r in regs:
+            out.append(
+                f"  REGRESSION {r['path'].ljust(w)}  {r['kind']:<10} "
+                f"{_fmt(r['old'])} -> {_fmt(r['new'])} "
+                f"(change {r['change']:+g})"
+            )
+    for key, label in (("only_old", "dropped from new"),
+                       ("only_new", "new coverage")):
+        if result[key]:
+            out.append(f"  {label}: {len(result[key])} metric path(s)")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("old", help="baseline bench JSON summary")
+    ap.add_argument("new", help="candidate bench JSON summary")
+    ap.add_argument("--qps-drop", type=float, default=0.15,
+                    help="max tolerated relative QPS drop (default 0.15)")
+    ap.add_argument("--recall-drop", type=float, default=0.02,
+                    help="max tolerated absolute recall drop "
+                         "(default 0.02)")
+    ap.add_argument("--bytes-grow", type=float, default=0.25,
+                    help="max tolerated relative HBM/bytes growth "
+                         "(default 0.25)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable comparison")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.old) as f:
+            old = json.load(f)
+        with open(args.new) as f:
+            new = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+    result = compare(old, new, qps_drop=args.qps_drop,
+                     recall_drop=args.recall_drop,
+                     bytes_grow=args.bytes_grow)
+    if args.json:
+        json.dump(result, sys.stdout, indent=1, sort_keys=True)
+        print()
+    else:
+        print(render(result))
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

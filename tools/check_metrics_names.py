@@ -15,10 +15,11 @@ Also linted:
   method names: `rpc.DebugService.MetricsDump`), but the name must start
   lowercase and stay inside the identifier-plus-dots alphabet.
 - curated metric families: literal registrations under the `xla.` /
-  `hbm.` / `flight.` / `ivf.` / `mesh.` / `hnsw.` prefixes (the
-  device-runtime observability, mesh serving, and device graph planes)
-  must name a series declared in FAMILY_NAMES below — dashboards key on
-  these exact names, so additions are explicit, not incidental.
+  `hbm.` / `flight.` / `ivf.` / `mesh.` / `hnsw.` / `quality.` prefixes
+  (the device-runtime observability, mesh serving, device graph, and
+  quality planes) must name a series declared in FAMILY_NAMES below —
+  dashboards key on these exact names, so additions are explicit, not
+  incidental.
 
 Wired as a tier-1 test (tests/test_metrics_names.py) so a bad name fails
 CI, not the scrape.
@@ -111,6 +112,28 @@ FAMILY_NAMES = {
                                     # (candidate, dim-block) work skipped
         "ivf.pruned_candidates",    # candidates dropped before their
                                     # last dimension block
+    },
+    "quality": {
+        # live recall observability (obs/quality.py): windowed shadow-
+        # scan estimates per region (rollup) and per (kind, precision,
+        # bucket) split — labels ride separately
+        "quality.recall",           # windowed recall@k estimate
+        "quality.recall_ci_low",    # Wilson 95% CI bounds
+        "quality.recall_ci_high",
+        "quality.rbo",              # rank-biased overlap (order-aware)
+        "quality.score_gap_p50",    # relative k-th-best regret quantiles
+        "quality.score_gap_p99",
+        "quality.samples",          # scored queries (counter)
+        "quality.shadow_scans",     # exact shadow kernels dispatched
+        "quality.dropped",          # async-lane overflow drops
+        "quality.window_queries",   # queries inside the current window
+        # SLO tuner (obs/tuner.py)
+        "quality.tuner_steps",      # knob steps by {knob, direction}
+        "quality.tuner_blocked",    # tighten wanted but latency-blocked
+        "quality.tuner_nprobe",     # current tuned serving defaults
+        "quality.tuner_ef",
+        "quality.tuner_rerank_factor",
+        "quality.tuner_precision_target",  # advisory tier (ladder index)
     },
 }
 

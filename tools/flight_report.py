@@ -175,6 +175,51 @@ def _hnsw_section(hnsw: Dict[str, Any]) -> List[str]:
     return out
 
 
+def _quality_section(quality: Dict[str, Any]) -> List[str]:
+    """Per-region live-quality state at capture time (absolute quality.*
+    series): a slow or degraded search reads next to the recall the store
+    was actually serving — and the tuner knob positions say whether the
+    SLO controller was trading quality when the incident hit. The table
+    uses the REGION-ROLLUP series (region label only); per-(kind,
+    precision, bucket) splits stay in the raw bundle JSON."""
+    per: Dict[str, Dict[str, float]] = {}
+    for key, val in quality.items():
+        name, labels = _series_labels(key)
+        if not name.startswith("quality."):
+            continue
+        if set(labels) - {"region"}:
+            continue     # bucket-attributed split series: JSON only
+        per.setdefault(labels.get("region", "-"), {})[name[8:]] = val
+    out = [f"-- quality / slo-tuner state ({len(quality)} series)"]
+    rows = []
+    for region in sorted(per):
+        st = per[region]
+        knobs = ",".join(
+            f"{k[6:]}={st[k]:.0f}" for k in
+            ("tuner_nprobe", "tuner_ef", "tuner_rerank_factor")
+            if k in st
+        )
+        rows.append([
+            region,
+            f"{st.get('recall', 0):.4f}",
+            f"[{st.get('recall_ci_low', 0):.4f},"
+            f"{st.get('recall_ci_high', 0):.4f}]",
+            f"{st.get('rbo', 0):.4f}",
+            f"{st.get('window_queries', 0):.0f}",
+            f"{st.get('samples', 0):.0f}",
+            f"{st.get('shadow_scans', 0):.0f}",
+            knobs or "-",
+        ])
+    if rows:
+        out.extend(_table(
+            ["REGION", "RECALL", "CI95", "RBO", "WINDOW_Q", "SAMPLES",
+             "SCANS", "TUNED"], rows
+        ))
+    else:
+        out.append("  (no quality series)")
+    return out
+
+
 def render(bundle: Dict[str, Any]) -> str:
     out: List[str] = []
     created = bundle.get("created_ms", 0) / 1000.0
@@ -279,6 +324,11 @@ def render(bundle: Dict[str, Any]) -> str:
     if hnsw:
         out.append("")
         out.extend(_hnsw_section(hnsw))
+
+    quality = bundle.get("quality") or {}
+    if quality:
+        out.append("")
+        out.extend(_quality_section(quality))
 
     slow = bundle.get("slow_queries") or []
     if slow:

@@ -56,6 +56,13 @@ NEW_MESSAGES = {
         ("document_count", 15, T.TYPE_INT64, None, False),
         # HBM high-watermark for the region total (obs hbm ledger, PR 5)
         ("device_peak_bytes", 16, T.TYPE_INT64, None, False),
+        # quality plane (obs/quality.py, PR 9): windowed live recall@k
+        # estimate with its Wilson CI; quality_samples = scored queries
+        # in the window (0 = no evidence, renderers show '-')
+        ("quality_recall", 17, T.TYPE_DOUBLE, None, False),
+        ("quality_recall_ci_low", 18, T.TYPE_DOUBLE, None, False),
+        ("quality_recall_ci_high", 19, T.TYPE_DOUBLE, None, False),
+        ("quality_samples", 20, T.TYPE_INT64, None, False),
     ],
     # whole-store snapshot (process device gauges + per-region list)
     "StoreMetrics": [
