@@ -86,18 +86,16 @@ def load_tpu_cache(max_age_h: float = 12.0):
 
 
 def precision_sweep_and_hybrid(platform):
-    """ISSUE 4: (a) fp32/bf16/sq8 sweep — QPS, recall@10, device bytes per
-    vector — on one reduced-scale IVF_FLAT config; (b) first measurement
-    of benchmark-matrix ROW 5 (hybrid scalar-filtered IVF search) at the
-    same reduced scale, labeled as such. Scale knobs env-tunable
-    (DINGO_BENCH_SWEEP_N/_D/_NLIST); both sections share one corpus +
-    ground truth so the whole block stays a few CPU-minutes."""
+    """ISSUE 4: fp32/bf16/sq8 sweep — QPS, recall@10, device bytes per
+    vector — on one reduced-scale IVF_FLAT config. Scale knobs env-tunable
+    (DINGO_BENCH_SWEEP_N/_D/_NLIST). (The hybrid row-5 fill that used to
+    ride this block at reduced scale moved to hybrid_row5() in main(),
+    which measures it on the FULL bench-scale index.)"""
     import time as _time
 
     from dingo_tpu.common.config import FLAGS
     from dingo_tpu.common.metrics import METRICS
     from dingo_tpu.index import IndexParameter, IndexType, new_index
-    from dingo_tpu.index.base import FilterSpec
     from dingo_tpu.obs import HBM
 
     n = int(os.environ.get("DINGO_BENCH_SWEEP_N", 50_000))
@@ -139,7 +137,6 @@ def precision_sweep_and_hybrid(platform):
     cache_rows = int(os.environ.get("DINGO_BENCH_RERANK_ROWS", 4096))
     sweep = {}
     fp32_qps = None
-    fp32_index = None
     for tier in ("fp32", "bf16", "sq8"):
         # rerank cache rides the sq8 run (the tier whose recall gate the
         # rerank stage exists for); bf16 holds recall without it
@@ -194,7 +191,6 @@ def precision_sweep_and_hybrid(platform):
         bytes_per_vec = idx.get_device_memory_size() / max(1, idx.get_count())
         if tier == "fp32":
             fp32_qps = qps
-            fp32_index = idx
         sweep[tier] = {
             "qps": round(qps, 1),
             "qps_vs_fp32": round(qps / fp32_qps, 3),
@@ -225,37 +221,73 @@ def precision_sweep_and_hybrid(platform):
             f"{steady_recompiles} steady-state recompiles")
     FLAGS.set("rerank_cache_rows", 0)
     FLAGS.set("rerank_cache_dtype", "float32")
+    return sweep
 
-    # ---- ROW 5 (reduced scale): hybrid scalar-filtered IVF search ----
-    # Scalar predicate: category = id % 16 == 3 (the compiled include-set
-    # FilterSpec the scalar pre-filter path produces, vector_reader.cc:853
-    # analog). Ground truth restricted to the matching subset.
+
+def hybrid_row5(platform, idx, x, ids, queries, n, d, nlist, nprobe, k):
+    """Benchmark-matrix ROW 5 (hybrid scalar-filtered IVF search) at the
+    FULL bench scale, on the main bench index — replacing the PR 4
+    reduced-scale labeled fill. Scalar predicate: category = id % 16 == 3
+    (the compiled include-set FilterSpec the scalar pre-filter path
+    produces, vector_reader.cc:853 analog); ground truth restricted to
+    the matching subset. Rides the filter-mask cache: the first search
+    compiles the [capacity] mask (miss), every timed iteration reuses it
+    keyed on (FilterSpec.fingerprint(), view version) — the cache-hit
+    delta is reported as a gate that the cache actually carried the
+    run."""
+    import time as _time
+
+    from dingo_tpu.common.metrics import METRICS
+    from dingo_tpu.index.base import FilterSpec
+
     cat_mask = (ids % 16) == 3
     spec = FilterSpec(include_ids=ids[cat_mask])
-    gt_f = exact_topk(cat_mask)
+    qs = queries[:16]
+    xs, xids = x[cat_mask], ids[cat_mask]
+    dmat = (
+        (qs ** 2).sum(1)[:, None] - 2.0 * qs @ xs.T
+        + (xs ** 2).sum(1)[None, :]
+    )
+    gt_f = xids[np.argsort(dmat, axis=1)[:, :k]]
     # 1/16 selectivity thins every probed list ~16x, so the hybrid
-    # operating point probes wider than the unfiltered sweep
+    # operating point probes wider than the unfiltered headline point
     nprobe_f = min(nlist, max(nprobe * 4, 64))
-    rec_f = recall_of(fp32_index.search(qs, k, spec, nprobe=nprobe_f), gt_f)
-    fp32_index.search(queries, k, spec, nprobe=nprobe_f)  # warm compile+mask
+    res = idx.search(qs, k, spec, nprobe=nprobe_f)
+    rec_f = float(np.mean(
+        [len(set(r.ids) & set(g)) / k for r, g in zip(res, gt_f)]
+    ))
+    hits_c = METRICS.counter("ivf.filter_mask_hits", region_id=idx.id)
+    recompiles_c = METRICS.counter("xla.recompiles")
+    idx.search(queries, k, spec, nprobe=nprobe_f)   # warm compile + mask
+    hits0, recompiles0 = hits_c.get(), recompiles_c.get()
+    iters = int(os.environ.get("DINGO_BENCH_HYBRID_ITERS", 10))
+    batch = len(queries)
     t0 = _time.perf_counter()
-    thunks = [fp32_index.search_async(queries, k, spec, nprobe=nprobe_f)
+    thunks = [idx.search_async(queries, k, spec, nprobe=nprobe_f)
               for _ in range(iters)]
     for t in thunks:
         t()
     dt = (_time.perf_counter() - t0) / iters
     hybrid = {
-        # row 5 spec is 10M x 768 over 3 mesh regions; this is the
-        # REDUCED-SCALE first fill of the cell, labeled as such
-        "config": f"row5_hybrid_ivf_scalar_filter_reduced_{n//1000}k_x{d}"
+        # row 5 spec is 10M x 768 over 3 mesh regions; this is the single-
+        # region fill at the SAME scale as the headline row (200k x 768
+        # CPU smoke / 1M x 768 on chip) — no longer the 50k reduced cell
+        "config": f"row5_hybrid_ivf_scalar_filter_{n//1000}k_x{d}"
                   f"_nlist{nlist}_nprobe{nprobe_f}",
         "selectivity": round(float(cat_mask.mean()), 4),
         "qps": round(batch / dt, 1),
         "recall_at_10": round(rec_f, 4),
+        # every timed search must reuse the compiled filter mask — a miss
+        # per iteration would mean the cache key churns and row 5 is
+        # benchmarking mask builds, not filtered search
+        "filter_mask_cache_hits": int(hits_c.get() - hits0),
+        "filter_mask_cache_carried": bool(hits_c.get() - hits0 >= iters),
+        "steady_state_recompiles": int(recompiles_c.get() - recompiles0),
     }
-    log(f"row5 hybrid (reduced): {hybrid['qps']:,.0f} QPS "
-        f"recall@10={rec_f:.4f} sel={hybrid['selectivity']}")
-    return sweep, hybrid
+    log(f"row5 hybrid (full scale): {hybrid['qps']:,.0f} QPS "
+        f"recall@10={rec_f:.4f} sel={hybrid['selectivity']} "
+        f"mask-hits={hybrid['filter_mask_cache_hits']}")
+    return hybrid
 
 
 def pruning_sweep(platform):
@@ -830,6 +862,241 @@ def mesh_scaling(platform):
     return out
 
 
+def overload(platform):
+    """ISSUE 10: open-loop arrival at ~2x measured capacity through the
+    QoS coalescer, with QoS ON vs OFF.
+
+    Open-loop means the arrival schedule does not slow down because the
+    server is slow — exactly the regime where a queue either sheds or
+    melts. Deadlines are measured from the SCHEDULED arrival instant (a
+    loadgen that slips still charges the request), so the unshaped arm
+    honestly shows the collapse: the backlog grows linearly and after
+    ~one deadline's worth of queue every reply is late. With QoS on, the
+    coalescer expires dead work before dispatch, sheds hopeless/over-
+    pressure work at admission, and the served remainder stays inside
+    its deadline.
+
+    Reported per arm: goodput (replies within deadline, per second of
+    offered window), served/shed/expired counts, p99 of served replies.
+    Gates: goodput(on) >= 1.5x goodput(off), served p99 <= deadline with
+    QoS on, expired work never dispatched to a kernel, and
+    steady_state_recompiles == 0 under priority-mixed batch forming."""
+    import threading
+    import time as _time
+
+    from dingo_tpu.common.config import FLAGS
+    from dingo_tpu.common.coalescer import SearchCoalescer
+    from dingo_tpu.common.metrics import METRICS
+    from dingo_tpu.index import IndexParameter, IndexType, new_index
+    from dingo_tpu.obs.pressure import (
+        PRESSURE,
+        Budget,
+        DeadlineExceeded,
+        RequestShed,
+        attach_budget,
+        detach_budget,
+    )
+
+    n = int(os.environ.get("DINGO_BENCH_OVERLOAD_N", 20_000))
+    d = int(os.environ.get("DINGO_BENCH_OVERLOAD_D", 64))
+    nlist, nprobe, k = 32, 8, 10
+    req_rows = 4                    # rows per request
+    deadline_ms = float(os.environ.get("DINGO_BENCH_OVERLOAD_DL_MS", 250.0))
+    window_s = float(os.environ.get("DINGO_BENCH_OVERLOAD_S", 6.0))
+    rng = np.random.default_rng(17)
+    ncl = 64
+    centers = rng.standard_normal((ncl, d), dtype=np.float32)
+    x = centers[rng.integers(0, ncl, n)] + 0.3 * rng.standard_normal(
+        (n, d)).astype(np.float32)
+    ids = np.arange(n, dtype=np.int64)
+    idx = new_index(900, IndexParameter(
+        index_type=IndexType.IVF_FLAT, dimension=d, ncentroids=nlist,
+        default_nprobe=nprobe,
+    ))
+    idx.store.reserve(n)
+    idx.upsert(ids, x)
+    idx.train()
+    # warm every pow2 batch bucket the coalescer can form (1..max_batch):
+    # batch forming must never mint a compile under ANY priority mix.
+    # 64-row cap: one batch run is then <= ~20% of the deadline, so the
+    # dispatch-time expiry check acts on a granule fine enough that a
+    # served reply's tail cannot blow past the deadline on run-time
+    # variance alone (128-row granules left p99 straddling the bound on
+    # a contended 1-core host)
+    max_batch = 64
+    warm = []
+    b = 1
+    while b <= max_batch:
+        warm.append(b)
+        b *= 2
+    idx.warmup(batches=tuple(warm), topk=k, nprobe=nprobe)
+    qpool = x[rng.choice(n, 4096, replace=False)] + 0.05 * (
+        rng.standard_normal((4096, d)).astype(np.float32))
+
+    dispatched_rows = [0]
+
+    def run(key, stacked):
+        dispatched_rows[0] += len(stacked)
+        return idx.search(np.asarray(stacked), k, nprobe=nprobe)
+
+    def measure_capacity():
+        """Closed-loop rows/s through the coalescer (QoS off)."""
+        FLAGS.set("qos_enabled", False)
+        co = SearchCoalescer(run, window_ms=2.0, max_batch=max_batch)
+        done = 0
+        t0 = _time.perf_counter()
+        while _time.perf_counter() - t0 < 1.5:
+            futs = [co.submit("cap", qpool[:req_rows])
+                    for _ in range(16)]
+            for f in futs:
+                f.result(timeout=30)
+                done += req_rows
+        dt = _time.perf_counter() - t0
+        co.stop()
+        return done / dt
+
+    capacity_rows_s = measure_capacity()
+    offered_rows_s = 2.0 * capacity_rows_s
+    interval_s = req_rows / offered_rows_s
+    log(f"overload: capacity ~{capacity_rows_s:,.0f} rows/s, offering "
+        f"{offered_rows_s:,.0f} rows/s for {window_s:.0f}s per arm "
+        f"(deadline {deadline_ms:.0f}ms)")
+
+    def one_arm(qos_on: bool):
+        FLAGS.set("qos_enabled", False)
+        FLAGS.set("qos_shed_policy", "degrade_drop")
+        FLAGS.set("qos_max_queue_ms", deadline_ms / 2.0)
+        co = SearchCoalescer(run, window_ms=3.0, max_batch=max_batch)
+        # seed the coalescer's service-rate EWMA with a short closed-loop
+        # burst BEFORE opening the tap: admission decisions in the first
+        # instants must not run on an unmeasured service rate
+        seed_end = _time.perf_counter() + 0.5
+        while _time.perf_counter() < seed_end:
+            for f in [co.submit("load", qpool[:req_rows])
+                      for _ in range(16)]:
+                f.result(timeout=30)
+        FLAGS.set("qos_enabled", qos_on)
+        PRESSURE.reset()
+        dispatched_rows[0] = 0
+        recompiles_c = METRICS.counter("xla.recompiles")
+        recompiles0 = recompiles_c.get()
+        lock = threading.Lock()
+        outcomes = []        # (priority, kind, latency_ms_from_sched)
+
+        def on_done(fut, sched_t, prio):
+            lat_ms = (_time.monotonic() - sched_t) * 1000.0
+            exc = fut.exception()
+            if exc is None:
+                kind = "served"
+            elif isinstance(exc, DeadlineExceeded):
+                kind = "expired"
+            elif isinstance(exc, RequestShed):
+                kind = "shed"
+            else:
+                kind = "error"
+            with lock:
+                outcomes.append((prio, kind, lat_ms))
+
+        t0 = _time.monotonic()
+        i = 0
+        end = t0 + window_s
+        while True:
+            sched_t = t0 + i * interval_s
+            now = _time.monotonic()
+            if sched_t >= end:
+                break
+            if sched_t > now:
+                _time.sleep(sched_t - now)
+            # priority-mixed traffic from two tenants: even requests are
+            # batch/background (priority 0), odd are interactive (2)
+            prio = 0 if i % 2 == 0 else 2
+            budget = Budget(deadline_ms, tenant=f"t{i % 2}",
+                            priority=prio, t0=sched_t)
+            token = attach_budget(budget)
+            try:
+                q = qpool[(i * req_rows) % 4096:][:req_rows]
+                fut = co.submit("load", q, region_id=900)
+            finally:
+                detach_budget(token)
+            fut.add_done_callback(
+                lambda f, s=sched_t, p=prio: on_done(f, s, p))
+            i += 1
+        # let in-flight work finish: stop(drain=True) flushes the pending
+        # batch, but cap-displaced batches run on their own threads — wait
+        # until every offered request has an outcome (bounded)
+        co.stop(drain=True)
+        settle_end = _time.monotonic() + 30.0
+        while _time.monotonic() < settle_end:
+            with lock:
+                if len(outcomes) >= i:
+                    break
+            _time.sleep(0.05)
+        recompiles = recompiles_c.get() - recompiles0
+        with lock:
+            outs = list(outcomes)
+        served = [o for o in outs if o[1] == "served"]
+        in_dl = [o for o in served if o[2] <= deadline_ms]
+        shed = sum(1 for o in outs if o[1] == "shed")
+        expired = sum(1 for o in outs if o[1] == "expired")
+        errors = sum(1 for o in outs if o[1] == "error")
+        lat_sorted = sorted(o[2] for o in served)
+        p99 = (lat_sorted[min(len(lat_sorted) - 1,
+                              int(len(lat_sorted) * 0.99))]
+               if lat_sorted else 0.0)
+        # goodput by priority class: shaping must favor the interactive
+        # class, not starve it
+        hi = [o for o in outs if o[0] == 2]
+        hi_good = sum(1 for o in hi
+                      if o[1] == "served" and o[2] <= deadline_ms)
+        arm = {
+            "offered": i,
+            "served": len(served),
+            "goodput_qps": round(len(in_dl) * req_rows / window_s, 1),
+            "served_p99_ms": round(p99, 1),
+            "p99_within_deadline": bool(p99 <= deadline_ms or not served),
+            "shed": shed,
+            "expired": expired,
+            "errors": errors,
+            "high_priority_goodput_fraction": round(
+                hi_good / max(1, len(hi)), 3),
+            "steady_state_recompiles": int(recompiles),
+            # admission/expiry contract: work that was shed or expired
+            # never reached a kernel — every dispatched row belongs to a
+            # request that got a result
+            "expired_reached_kernel": bool(
+                dispatched_rows[0] > (len(served) + errors) * req_rows
+            ),
+            "dispatched_rows": int(dispatched_rows[0]),
+        }
+        return arm
+
+    arm_on = one_arm(True)
+    arm_off = one_arm(False)
+    FLAGS.set("qos_enabled", False)
+    FLAGS.set("qos_max_queue_ms", 50.0)
+    ratio = (arm_on["goodput_qps"] / arm_off["goodput_qps"]
+             if arm_off["goodput_qps"] else float("inf"))
+    result = {
+        "config": f"overload_ivf_{n//1000}k_x{d}_2x_open_loop_"
+                  f"dl{int(deadline_ms)}ms",
+        "capacity_qps": round(capacity_rows_s, 1),
+        "offered_qps": round(offered_rows_s, 1),
+        "deadline_ms": deadline_ms,
+        "qos_on": arm_on,
+        "qos_off": arm_off,
+        "goodput_ratio_on_vs_off": round(min(ratio, 1000.0), 2),
+        # the acceptance gate: shaping must at least 1.5x the goodput the
+        # unshaped queue manages at 2x offered load
+        "goodput_gate_1_5x": bool(ratio >= 1.5),
+    }
+    log(f"overload: goodput on={arm_on['goodput_qps']:,.0f} "
+        f"off={arm_off['goodput_qps']:,.0f} rows/s ({ratio:.1f}x), "
+        f"on-arm p99={arm_on['served_p99_ms']:.0f}ms "
+        f"shed={arm_on['shed']} expired={arm_on['expired']} "
+        f"recompiles={arm_on['steady_state_recompiles']}")
+    return result
+
+
 def main():
     # With a cached TPU result on hand a short probe suffices; without one,
     # keep the generous window — a live run is strictly better than a cache.
@@ -1023,10 +1290,16 @@ def main():
         f"{vstats.get('inplace_appends', 0)} in-place appends, "
         f"{m_recompiles} steady-state recompiles)")
 
-    # --- precision sweep (fp32/bf16/sq8) + row-5 hybrid (ISSUE 4) ---
+    # --- row-5 hybrid scalar-filtered search at FULL bench scale, on the
+    #     main index + filter-mask cache (ISSUE 10 satellite; replaces the
+    #     PR 4 reduced-scale fill) ---
+    hybrid = hybrid_row5(platform, idx, x, ids, queries, n, d, nlist,
+                         nprobe, k)
+
+    # --- precision sweep (fp32/bf16/sq8) (ISSUE 4) ---
     from dingo_tpu.metrics.device import device_memory_stats
 
-    sweep, hybrid = precision_sweep_and_hybrid(platform)
+    sweep = precision_sweep_and_hybrid(platform)
 
     # --- pruning sweep: blocked-scan early pruning on vs off (ISSUE 6) ---
     prune = pruning_sweep(platform)
@@ -1040,6 +1313,9 @@ def main():
     # --- recall SLO closed loop: mistuned region -> tuner convergence
     #     under live quality sampling (ISSUE 9) ---
     slo = recall_slo(platform)
+
+    # --- overload: open-loop 2x capacity, QoS on vs off (ISSUE 10) ---
+    over = overload(platform)
 
     # --- CPU baseline: numpy/OpenBLAS IVF-flat with same layout ---
     centroids = np.asarray(idx.centroids)
@@ -1118,8 +1394,8 @@ def main():
         # fp32/bf16/sq8 at one reduced-scale IVF config: QPS, recall@10,
         # device bytes/vector (the precision-tier capacity win)
         "precision_sweep": sweep,
-        # benchmark-matrix row 5 (hybrid scalar-filtered IVF), first fill
-        # — reduced scale, labeled in the config string
+        # benchmark-matrix row 5 (hybrid scalar-filtered IVF) at the SAME
+        # scale as the headline row, riding the filter-mask cache
         "hybrid_row5": hybrid,
         # blocked-scan early pruning (ISSUE 6): QPS/recall with the
         # pruned kernel on vs off + mean scanned-dim fraction per tier
@@ -1140,6 +1416,11 @@ def main():
         # the live-vs-measured delta and the zero-recompile invariant
         # across every tuner step
         "recall_slo": slo,
+        # traffic shaping (ISSUE 10): open-loop 2x-capacity arrival with
+        # QoS on vs off — goodput, served p99 vs deadline, shed/expired,
+        # the expired-never-reaches-a-kernel gate, and zero recompiles
+        # under priority-mixed batch forming
+        "overload": over,
     }
     if platform == "tpu":
         result["measured_at"] = time.time()
@@ -1157,5 +1438,12 @@ if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--mesh-scaling":
         # standalone: just the mesh_scaling block (MULTICHIP runs)
         print(json.dumps({"mesh_scaling": mesh_scaling("cpu")}))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--overload":
+        # standalone: just the QoS overload arms (acceptance smoke)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps({"overload": overload("cpu")}))
         sys.exit(0)
     main()

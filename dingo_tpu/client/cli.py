@@ -90,6 +90,13 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
             _fmt_bytes(m.device_bytes_in_use),
             f"{sum(r.search_qps for r in m.regions if r.is_leader):.1f}",
             _recall_cell(q_recall, q_samples),
+            str(sum(r.qos_queue_depth for r in m.regions)),
+            # PRESSURE: worst recent queue-wait watermark across hosted
+            # regions (ms) — the figure the shed ladder defends
+            "%.0fms" % max(
+                (r.qos_queue_wait_ms for r in m.regions), default=0.0
+            ),
+            str(sum(r.qos_shed_total for r in m.regions)),
         ])
         for r in m.regions:
             if region_id and r.region_id != region_id:
@@ -101,6 +108,8 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
                 flags.append("build-error")
             if not r.index_ready and r.vector_count:
                 flags.append("not-ready")
+            if r.qos_degrade_level:
+                flags.append(f"degraded-l{r.qos_degrade_level}")
             region_rows.append([
                 str(r.region_id),
                 entry.store_id,
@@ -113,19 +122,24 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
                 str(r.apply_lag),
                 f"{r.search_qps:.1f}",
                 _recall_cell(r.quality_recall, r.quality_samples),
+                str(r.qos_queue_depth),
+                f"{r.qos_queue_wait_ms:.0f}ms",
+                str(r.qos_shed_total),
                 ",".join(flags) or "-",
             ])
     region_rows.sort(key=lambda r: (int(r[0]), r[1]))
     out = [
         _render_table(
             ["STORE", "METRICS", "REGIONS", "LEADERS", "KEYS", "VECTORS",
-             "MEM", "DEVMEM", "DEVPEAK", "DEV-IN-USE", "QPS", "RECALL"],
+             "MEM", "DEVMEM", "DEVPEAK", "DEV-IN-USE", "QPS", "RECALL",
+             "QDEPTH", "PRESS", "SHED"],
             store_rows,
         ),
         "",
         _render_table(
             ["REGION", "STORE", "ROLE", "KEYS", "VECTORS", "MEM", "DEVMEM",
-             "DEVPEAK", "LAG", "QPS", "RECALL", "FLAGS"],
+             "DEVPEAK", "LAG", "QPS", "RECALL", "QDEPTH", "PRESS", "SHED",
+             "FLAGS"],
             region_rows,
         ),
     ]
@@ -179,6 +193,17 @@ def build_parser() -> argparse.ArgumentParser:
     vsearch.add_argument("--partition", type=int, default=0)
     vsearch.add_argument("--dim", type=int, required=True)
     vsearch.add_argument("--topk", type=int, default=5)
+    vsearch.add_argument("--deadline-ms", type=float, default=0.0,
+                         help="per-request time budget propagated to the "
+                              "store (0 = none); expired work is rejected "
+                              "at admission when qos.enabled")
+    vsearch.add_argument("--tenant", default="",
+                         help="tenant id for per-tenant QoS accounting")
+    vsearch.add_argument("--priority", type=int, default=None,
+                         help="0 = batch (shed first), 1 = default, "
+                              ">= 2 = interactive (never pressure-shed); "
+                              "unset = no QoS budget attached unless "
+                              "--deadline-ms/--tenant is given")
     vcount = vec.add_parser("count")
     vcount.add_argument("--partition", type=int, default=0)
 
@@ -383,7 +408,11 @@ def run_command(client: DingoClient, args) -> int:
     elif g == "vector" and c == "search-random":
         rng = np.random.default_rng(1)
         q = rng.standard_normal((1, args.dim)).astype(np.float32)
-        res = client.vector_search(args.partition, q, topk=args.topk)
+        res = client.vector_search(
+            args.partition, q, topk=args.topk,
+            deadline_ms=args.deadline_ms or None,
+            tenant=args.tenant, priority=args.priority,
+        )
         print(json.dumps([[int(i), float(d)] for i, d in res[0]]))
     elif g == "vector" and c == "count":
         print(client.vector_count(args.partition))

@@ -468,10 +468,38 @@ class DingoClient:
 
     def vector_search(
         self, partition_id: int, queries: np.ndarray, topk: int = 10,
-        with_scalar_data: bool = False, **params,
+        with_scalar_data: bool = False, deadline_ms: float = None,
+        tenant: str = "", priority: int = None, **params,
     ) -> List[List[Tuple[int, float]]]:
         """Scatter to every region of the partition, gather + merge top-k
-        client-side (the reference SDK's cross-region story)."""
+        client-side (the reference SDK's cross-region story).
+
+        ``deadline_ms``/``tenant``/``priority`` attach a QoS budget to the
+        calls: the stub injects it as gRPC metadata (remaining-ms form)
+        next to the trace context, so a qos.enabled store can admit,
+        prioritize, or shed the request against ITS clock."""
+        if deadline_ms or tenant or priority is not None:
+            from dingo_tpu.obs.pressure import (
+                DEFAULT_PRIORITY,
+                budget_scope,
+            )
+
+            with budget_scope(
+                # no deadline given: a full day — effectively "account
+                # tenant/priority, never expire"
+                deadline_ms if deadline_ms else 86_400_000.0,
+                tenant=tenant or "default",
+                priority=DEFAULT_PRIORITY if priority is None else priority,
+            ):
+                return self._vector_search_budgeted(
+                    partition_id, queries, topk, with_scalar_data, params
+                )
+        return self._vector_search_budgeted(
+            partition_id, queries, topk, with_scalar_data, params
+        )
+
+    def _vector_search_budgeted(self, partition_id, queries, topk,
+                                with_scalar_data, params):
         regions = self._regions_for_vector_ids(partition_id)
         if not regions:
             raise ClientError("no index regions")

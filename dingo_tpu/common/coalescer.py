@@ -1,5 +1,5 @@
 """Search request coalescing: merge concurrent same-shaped searches into
-one device batch.
+one device batch — grown into the QoS admission/batch-forming layer.
 
 The reference absorbs request-level parallelism with bthread worker sets
 (runnable.h:138-291, index_service.cc:362-365) — more threads, same
@@ -13,11 +13,50 @@ Latency math on the axon tunnel: the D2H hop is ~60-80 ms, so a ~2 ms
 collection window is noise for the requests it helps and a large QPS
 multiplier under concurrency.
 
+QoS (``qos.enabled``, obs/pressure.py is the sensor/policy home): the
+queue in front of the kernel is the ONLY place admission can act, so the
+coalescer is where the loop closes:
+
+- **admission** — a request whose budget is already spent is rejected
+  before it queues (its future carries ``DeadlineExceeded``; no kernel is
+  ever dispatched for it). Under pressure (estimated wait beyond
+  ``qos.max_queue_ms``) low-priority work is shed at admission, and any
+  request that could not finish inside its own remaining budget anyway
+  is shed as hopeless — serving it late would burn capacity that an
+  in-deadline request needs. A per-tenant queued-row cap
+  (``qos.tenant_queue_rows``) bounds any one tenant's share of the queue.
+- **priority batch forming** — entries dispatch highest-priority-first
+  inside a batch, and the full-batch flush threshold sits ON the pow2
+  pad ladder (index/flat._pad_batch), so a full batch is exactly a warm
+  program shape: batch forming never mints a compile (the PR 5 sentinel
+  makes this a tested invariant).
+- **expiry before dispatch** — entries whose deadline passed while
+  queued (or whose remaining budget cannot cover the estimated run) are
+  failed at flush time and their queries EXCLUDED from the stacked
+  batch; a batch of only dead entries skips the kernel entirely.
+- **accounting** — queue-wait, per-stage budget fractions, demand,
+  shed/expired counters all land in the ``qos.*`` family via PRESSURE.
+
+Every QoS decision is budget-driven; with ``qos.enabled = false`` submit
+takes the exact pre-QoS path (one flag read).
+
 Tracing: each submit opens a ``coalesce.wait`` span (queue time) as a
 child of the caller's current span; the batch run opens ``coalesce.run``
 parented to the FIRST sampled waiter and attaches it on the flush thread,
 so device-side spans nest into that caller's trace across the handoff.
-The batch size and co-batched trace ids ride as span attributes.
+The batch size and co-batched trace ids ride as span attributes. The
+request BUDGET makes the same handoff: captured from the contextvar at
+submit, carried on the entry, consulted on the flush thread.
+
+Shutdown contract: ``submit()`` never raises and never hangs — it always
+returns a Future, and every returned Future resolves deterministically.
+A submit racing ``stop(drain=False)`` gets a ``CoalescerStopped`` future:
+the admitted-vs-stopped decision happens atomically under the queue lock
+(the pre-QoS code checked the stop flag and appended in one critical
+section too, but ANY admission work between the check and the append —
+exactly what QoS adds — would have opened a window where a request could
+slip into a queue nobody will ever flush; the decision is now made at
+append time, where it cannot be stale).
 """
 
 from __future__ import annotations
@@ -25,7 +64,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Callable, Dict, List, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -33,24 +72,66 @@ from dingo_tpu.trace import NOOP_SPAN, TRACER
 
 
 class CoalescerStopped(RuntimeError):
-    """Set on futures whose batch was discarded by stop(drain=False)."""
+    """Set on futures whose batch was discarded by stop(drain=False) or
+    that arrived after (or concurrently with) stop()."""
+
+
+#: dispatch-time safety factor on the estimated batch run: an entry whose
+#: remaining budget cannot cover ~2x the estimated run would expire
+#: mid-flight more often than not — serving it is wasted capacity AND a
+#: late reply, the worst of both (2x covers run-time variance on a
+#: contended host; the EWMA itself tracks the mean, and under overload
+#: shedding a marginal request is strictly cheaper than serving it late)
+_EXPIRY_RUN_MARGIN = 2.0
+
+
+def _prev_pow2(n: int) -> int:
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+class _Entry:
+    """One submit: its queries plus everything the flush thread needs."""
+
+    __slots__ = ("queries", "future", "wait_span", "budget", "priority",
+                 "tenant", "region_id", "t0", "qos")
+
+    def __init__(self, queries, future, wait_span, budget, region_id,
+                 qos=False):
+        self.queries = queries
+        self.future = future
+        self.wait_span = wait_span
+        self.budget = budget
+        self.priority = budget.priority if budget is not None else 1
+        self.tenant = budget.tenant if budget is not None else "default"
+        self.region_id = region_id
+        self.t0 = time.monotonic()
+        #: admitted under QoS accounting: dequeue/row-release must mirror
+        #: the admit-side bookkeeping even if the flag flips mid-flight
+        self.qos = qos
 
 
 class _PendingBatch:
-    __slots__ = ("queries", "futures", "created")
+    __slots__ = ("entries", "created")
 
     def __init__(self):
-        self.queries: List[np.ndarray] = []
-        # (future, n_queries, wait_span) per submit
-        self.futures: List[Tuple[Future, int, Any]] = []
+        self.entries: List[_Entry] = []
         self.created = time.monotonic()
+
+    def rows(self) -> int:
+        return sum(len(e.queries) for e in self.entries)
 
 
 class SearchCoalescer:
     """Batches `search(queries) -> per-query results` calls per key.
 
     run_fn(key, queries[batch, d]) must return a list of per-query result
-    rows; callers receive exactly their rows. Flush happens when the window
+    rows; callers receive exactly their rows. run_fn may optionally accept
+    a ``stage_us`` dict kwarg (the VectorReader stage-timing contract) —
+    when it does, the coalescer reads kernel/rerank stage splits out of it
+    for the per-stage budget accounting. Flush happens when the window
     expires or the batch hits max_batch. One daemon timer thread serves all
     keys, sleeping until the earliest pending deadline; a caller whose own
     submission fills a batch runs that batch inline (its results are in
@@ -64,8 +145,27 @@ class SearchCoalescer:
         self.run_fn = run_fn
         self.window_s = window_ms / 1000.0
         self.max_batch = max_batch
+        import inspect
+
+        try:
+            self._run_takes_stages = "stage_us" in inspect.signature(
+                run_fn).parameters
+        except (TypeError, ValueError):
+            self._run_takes_stages = False
         self._lock = threading.Lock()
         self._pending: Dict[Any, _PendingBatch] = {}
+        #: cap-displaced batches awaiting the timer thread (QoS mode):
+        #: serialized dispatch keeps the service-rate model honest —
+        #: ad-hoc flush threads racing each other would make every run
+        #: slower than the EWMA the admission estimates are built on
+        self._ready: List = []
+        #: queued query rows per tenant (admission cap bookkeeping)
+        self._tenant_rows: Dict[str, int] = {}
+        #: EWMA of per-row service time / per-batch run time (seeded
+        #: pessimistically low so the first batches are never shed on a
+        #: figure nobody measured)
+        self._ewma_row_ms = 0.0
+        self._ewma_run_ms = 0.0
         self._wake = threading.Event()
         self._stop = False
         self._thread = threading.Thread(
@@ -73,38 +173,176 @@ class SearchCoalescer:
         )
         self._thread.start()
 
+    # -- QoS helpers ---------------------------------------------------------
+    def _queued_rows(self) -> int:
+        # the backlog is BOTH queues: window-pending batches AND cap-
+        # displaced batches awaiting the timer thread — under overload
+        # most of the real wait sits in _ready, and an estimate that
+        # ignored it would under-shed exactly when shedding matters
+        return (sum(b.rows() for b in self._pending.values())
+                + sum(b.rows() for _, b in self._ready))
+
+    def estimated_wait_ms(self, extra_rows: int = 0) -> float:
+        """Admission estimate: rows ahead x measured per-row service time
+        plus one batch run (the one possibly in flight). Zero until the
+        first batch has been measured."""
+        if self._ewma_row_ms <= 0:
+            return 0.0
+        with self._lock:
+            rows = self._queued_rows()
+        return (rows + extra_rows) * self._ewma_row_ms + self._ewma_run_ms
+
+    def _est_run_ms(self, rows: int) -> float:
+        """Expected run time for a batch of `rows`: the per-batch EWMA
+        floor (fixed dispatch overhead) scaled up by the per-row cost for
+        batches larger than recent history — a 256-row batch must not be
+        judged by the run time of the 8-row batches that preceded it."""
+        if self._ewma_row_ms <= 0:
+            return self._ewma_run_ms
+        return max(self._ewma_run_ms, rows * self._ewma_row_ms)
+
+    def _note_run(self, rows: int, run_ms: float) -> None:
+        if rows <= 0 or run_ms <= 0:
+            return
+        row_ms = run_ms / rows
+        a = 0.3
+        self._ewma_row_ms = (row_ms if self._ewma_row_ms == 0
+                             else a * row_ms + (1 - a) * self._ewma_row_ms)
+        self._ewma_run_ms = (run_ms if self._ewma_run_ms == 0
+                             else a * run_ms + (1 - a) * self._ewma_run_ms)
+
+    def _admission_reject(self, budget, n_rows: int, region_id: int):
+        """QoS admission decision for one submit. Returns an exception to
+        set on the future (after counting it), or None = admit. Called
+        OUTSIDE the queue lock — only estimates are read here."""
+        from dingo_tpu.obs import pressure as qp
+
+        if budget is not None and budget.expired():
+            qp.PRESSURE.on_expired("admission", region_id, budget)
+            return qp.DeadlineExceeded(
+                f"deadline exceeded at admission "
+                f"({-budget.remaining_ms():.1f}ms past)"
+            )
+        policy_drops = qp.shed_policy() in ("drop", "degrade_drop")
+        if not policy_drops:
+            return None
+        from dingo_tpu.common.config import FLAGS
+
+        tenant_cap = int(FLAGS.get("qos_tenant_queue_rows"))
+        if tenant_cap > 0 and budget is not None:
+            with self._lock:
+                queued = self._tenant_rows.get(budget.tenant, 0)
+            if queued + n_rows > tenant_cap:
+                qp.PRESSURE.on_shed("tenant_limit", region_id, budget)
+                return qp.RequestShed(
+                    f"tenant {budget.tenant} over queue cap "
+                    f"({queued}+{n_rows} > {tenant_cap} rows)"
+                )
+        est_ms = self.estimated_wait_ms(extra_rows=n_rows)
+        if budget is not None and budget.deadline_ms > 0 \
+                and est_ms > budget.remaining_ms():
+            # hopeless: it would expire in queue — serving it late only
+            # burns capacity an in-deadline request needs
+            qp.PRESSURE.on_shed("hopeless", region_id, budget)
+            return qp.RequestShed(
+                f"estimated wait {est_ms:.0f}ms exceeds remaining "
+                f"budget {budget.remaining_ms():.0f}ms"
+            )
+        max_queue_ms = float(FLAGS.get("qos_max_queue_ms"))
+        if max_queue_ms > 0:
+            # pressure shed by priority: batch/background (0) sheds at
+            # half the bound, default (1) at the bound, interactive
+            # (>= 2) never pressure-sheds (hopeless-shed still applies)
+            prio = budget.priority if budget is not None else 1
+            allowed = (0.5 * max_queue_ms if prio <= 0
+                       else max_queue_ms if prio == 1
+                       else float("inf"))
+            if est_ms > allowed:
+                qp.PRESSURE.on_shed("pressure", region_id, budget)
+                return qp.RequestShed(
+                    f"queue pressure {est_ms:.0f}ms over bound "
+                    f"{allowed:.0f}ms (priority {prio})"
+                )
+        return None
+
     # -- submission ----------------------------------------------------------
     def submit(self, key: Any, queries: np.ndarray,
-               max_batch: int = 0) -> Future:
+               max_batch: int = 0, region_id: int = 0) -> Future:
         """Queue queries [n, d] under key; resolves to n result rows.
         max_batch (0 = the coalescer default) caps the STACKED row count
         for this key — merging must never build a batch that would trip a
-        limit each request individually respects."""
+        limit each request individually respects.
+
+        Never raises, never hangs: admission rejections
+        (DeadlineExceeded/RequestShed), shutdown (CoalescerStopped), and
+        run errors all resolve the returned future deterministically."""
         cap = min(self.max_batch, max_batch or self.max_batch)
         fut: Future = Future()
         wait_span = TRACER.start_span("coalesce.wait")
+        qos = False
+        budget = None
+        try:
+            from dingo_tpu.obs import pressure as qp
+
+            qos = qp.qos_enabled()
+            if qos:
+                budget = qp.current_budget()
+        except ImportError:  # pragma: no cover — obs package always present
+            pass
+        if qos:
+            rejection = self._admission_reject(budget, len(queries),
+                                               region_id)
+            if rejection is not None:
+                wait_span.end()
+                fut.set_exception(rejection)
+                return fut
+            # a full-ladder batch pads to itself: flushing AT a pow2 row
+            # count hands the kernel an exactly-warm shape
+            cap = _prev_pow2(cap)
+        entry = _Entry(np.asarray(queries), fut, wait_span, budget,
+                       region_id, qos=qos)
         flush_now = None
         flush_first = None
         with self._lock:
             if self._stop:
+                # the submit-vs-stop(drain=False) race resolved: the
+                # stopped check and the append are ONE atomic decision, so
+                # this future fails deterministically instead of entering
+                # a queue whose flush thread is already gone
                 wait_span.end()
-                raise CoalescerStopped("coalescer stopped")
+                fut.set_exception(CoalescerStopped("coalescer stopped"))
+                return fut
             batch = self._pending.get(key)
-            if batch is not None and (
-                sum(len(q) for q in batch.queries) + len(queries) > cap
-            ):
-                # adding would exceed the cap: flush the queued batch on
-                # its own thread (running it HERE would charge the
-                # previous batch's whole search to this caller's latency,
-                # and the shared timer thread must stay free for other
-                # keys' window expiries) and start fresh for this request
-                flush_first = self._pending.pop(key)
+            if batch is not None and batch.rows() + len(queries) > cap:
+                # adding would exceed the cap: flush the queued batch
+                # elsewhere (running it HERE would charge the previous
+                # batch's whole search to this caller's latency) and
+                # start fresh for this request. QoS mode hands it to the
+                # timer thread's ready queue — one dispatcher, honest
+                # service-rate accounting, expiry checked at the moment
+                # it actually runs; classic mode spawns a thread so the
+                # timer stays free for other keys' window expiries
+                displaced = self._pending.pop(key)
+                if qos:
+                    self._ready.append((key, displaced))
+                    displaced = None
+                flush_first = displaced
                 batch = None
             if batch is None:
                 batch = self._pending[key] = _PendingBatch()
-            batch.queries.append(np.asarray(queries))
-            batch.futures.append((fut, len(queries), wait_span))
-            if sum(len(q) for q in batch.queries) >= cap:
+            batch.entries.append(entry)
+            if qos:
+                self._tenant_rows[entry.tenant] = (
+                    self._tenant_rows.get(entry.tenant, 0) + len(queries)
+                )
+                # admit accounting INSIDE the queue lock: a flush can
+                # only pop this batch under the same lock, so on_dequeue
+                # can never be observed before its on_admit (an
+                # admit-after-release race left phantom queue depth)
+                from dingo_tpu.obs.pressure import PRESSURE
+
+                PRESSURE.on_admit(region_id, len(queries), budget)
+            if batch.rows() >= cap:
                 flush_now = self._pending.pop(key)
         if flush_first is not None:
             threading.Thread(
@@ -113,54 +351,175 @@ class SearchCoalescer:
             ).start()
         if flush_now is not None:
             # the caller's own batch is full: run it inline (lowest
-            # latency for everyone already in it)
+            # latency for everyone already in it); wake the timer too —
+            # a QoS-displaced batch may be sitting in the ready queue
+            self._wake.set()
             self._run(key, flush_now)
         else:
             self._wake.set()
         return fut
 
     # -- flushing ------------------------------------------------------------
+    def _release_rows(self, entries: List[_Entry]) -> None:
+        with self._lock:
+            for e in entries:
+                if not e.qos:
+                    continue
+                left = self._tenant_rows.get(e.tenant, 0) - len(e.queries)
+                if left > 0:
+                    self._tenant_rows[e.tenant] = left
+                else:
+                    self._tenant_rows.pop(e.tenant, None)
+
+    def _expire_dead(self, entries: List[_Entry], region_id: int,
+                     now: float) -> List[_Entry]:
+        """Expiry before dispatch: fail entries that died in queue (or
+        whose remaining budget cannot cover the estimated run — they
+        WOULD die mid-flight) and return the survivors."""
+        from dingo_tpu.obs import pressure as qp
+
+        # pure expiry (the deadline contract) always applies; the
+        # hopeless-shed arm is a DROP and obeys the same policy gate as
+        # admission ('off'/'degrade' must never fail a live request)
+        drops = qp._policy_drops()
+        est_run = _EXPIRY_RUN_MARGIN * self._est_run_ms(
+            sum(len(e.queries) for e in entries))
+        live: List[_Entry] = []
+        for e in entries:
+            if e.budget is None or e.budget.deadline_ms <= 0:
+                live.append(e)
+                continue
+            remaining = e.budget.remaining_ms(now)
+            if remaining <= 0:
+                qp.PRESSURE.on_expired("queue", region_id, e.budget)
+                e.future.set_exception(qp.DeadlineExceeded(
+                    f"expired in queue ({-remaining:.1f}ms past deadline)"
+                ))
+            elif drops and est_run > 0 and remaining < est_run:
+                qp.PRESSURE.on_shed("hopeless", region_id, e.budget)
+                e.future.set_exception(qp.RequestShed(
+                    f"remaining {remaining:.0f}ms cannot cover the "
+                    f"~{est_run:.0f}ms batch run"
+                ))
+            else:
+                live.append(e)
+        return live
+
     def _run(self, key: Any, batch: _PendingBatch) -> None:
         # queue-wait ends here; the run span parents to the first sampled
         # waiter so the device work lands in ITS trace, with the rest of
         # the batch recorded as co-batched trace links
+        flush_t0 = time.monotonic()
+        qos = False
+        try:
+            from dingo_tpu.obs import pressure as qp
+
+            qos = qp.qos_enabled()
+        except ImportError:  # pragma: no cover
+            pass
+        entries = batch.entries
+        region_id = entries[0].region_id if entries else 0
         run_span = NOOP_SPAN
         links = []
-        for _, _, ws in batch.futures:
-            ws.end()
-            if ws.sampled:
+        waits_ms: Dict[int, float] = {}
+        for e in entries:
+            e.wait_span.end()
+            waits_ms[id(e)] = (flush_t0 - e.t0) * 1000.0
+            if e.wait_span.sampled:
                 if run_span is NOOP_SPAN:
                     run_span = TRACER.start_span(
-                        "coalesce.run", parent=ws.context
+                        "coalesce.run", parent=e.wait_span.context
                     )
                 else:
-                    links.append(f"{ws.trace_id:016x}")
+                    links.append(f"{e.wait_span.trace_id:016x}")
+        if any(e.qos for e in entries):
+            from dingo_tpu.obs.pressure import PRESSURE
+
+            self._release_rows(entries)
+            for e in entries:
+                if not e.qos:
+                    continue
+                PRESSURE.on_dequeue(e.region_id, len(e.queries), e.budget)
+                PRESSURE.observe_wait(e.region_id, waits_ms[id(e)],
+                                      e.budget)
+        if qos:
+            entries = self._expire_dead(entries, region_id, flush_t0)
+            if not entries:
+                # a batch of only dead requests dispatches NO kernel
+                if run_span is not NOOP_SPAN:
+                    run_span.set_attr("all_expired", True)
+                    run_span.end()
+                return
+            # priority batch forming: highest priority first (stable), so
+            # the result slicing below follows the dispatch order
+            entries = sorted(entries, key=lambda e: -e.priority)
         if run_span is not NOOP_SPAN:
             run_span.set_attr("batch_size",
-                              sum(len(q) for q in batch.queries))
-            run_span.set_attr("requests", len(batch.futures))
+                              sum(len(e.queries) for e in entries))
+            run_span.set_attr("requests", len(entries))
             run_span.set_attr(
                 "queue_wait_us",
-                int((time.monotonic() - batch.created) * 1e6),
+                int((flush_t0 - batch.created) * 1e6),
             )
             if links:
                 run_span.set_attr("cobatched_traces", links)
         token = run_span.attach()
+        stage_us: Optional[Dict[str, int]] = (
+            {} if (qos and self._run_takes_stages) else None
+        )
         try:
-            stacked = np.concatenate(batch.queries, axis=0)
-            results = self.run_fn(key, stacked)
+            stacked = np.concatenate([e.queries for e in entries], axis=0)
+            form_ms = (time.monotonic() - flush_t0) * 1000.0
+            run_t0 = time.monotonic()
+            if stage_us is not None:
+                results = self.run_fn(key, stacked, stage_us=stage_us)
+            else:
+                results = self.run_fn(key, stacked)
+            run_ms = (time.monotonic() - run_t0) * 1000.0
+            self._note_run(len(stacked), run_ms)
             off = 0
-            for fut, n, _ in batch.futures:
-                fut.set_result(list(results[off:off + n]))
+            for e in entries:
+                n = len(e.queries)
+                e.future.set_result(list(results[off:off + n]))
                 off += n
-        except Exception as e:  # noqa: BLE001
-            run_span.set_error(e)
-            for fut, _, _ in batch.futures:
-                if not fut.done():
-                    fut.set_exception(e)
+            if qos:
+                self._account_stages(entries, waits_ms, form_ms, run_ms,
+                                     stage_us)
+        except Exception as exc:  # noqa: BLE001
+            run_span.set_error(exc)
+            for e in entries:
+                if not e.future.done():
+                    e.future.set_exception(exc)
         finally:
             run_span.detach(token)
             run_span.end()
+
+    @staticmethod
+    def _account_stages(entries, waits_ms, form_ms, run_ms, stage_us):
+        """Per-stage time-budget accounting: queue / batch_form / kernel /
+        rerank as fractions of each entry's deadline. The kernel/rerank
+        split comes from the reader's stage_us dict when the run callback
+        exposes it (search_us = the device scan+topk, postfilter+backfill
+        = the rerank/materialize tail); otherwise the whole run counts as
+        kernel time."""
+        from dingo_tpu.obs.pressure import PRESSURE
+
+        kernel_ms, rerank_ms = run_ms, 0.0
+        if stage_us:
+            k = stage_us.get("search_us", 0) / 1000.0
+            r = (stage_us.get("postfilter_us", 0)
+                 + stage_us.get("backfill_us", 0)) / 1000.0
+            if k > 0:
+                kernel_ms, rerank_ms = k, min(r, run_ms - k)
+        for e in entries:
+            if e.budget is None:
+                continue
+            PRESSURE.observe_stages(e.budget, {
+                "queue": waits_ms.get(id(e), 0.0),
+                "batch_form": form_ms,
+                "kernel": kernel_ms,
+                "rerank": rerank_ms,
+            })
 
     def _flush_loop(self) -> None:
         timeout = None   # nothing pending: sleep until a submit wakes us
@@ -173,9 +532,12 @@ class SearchCoalescer:
             if self._stop:
                 return
             now = time.monotonic()
-            due: List[Tuple[Any, _PendingBatch]] = []
             timeout = None
             with self._lock:
+                # QoS-displaced batches first: they are strictly older
+                # than anything still inside its window
+                due = self._ready
+                self._ready = []
                 for key in list(self._pending):
                     age = now - self._pending[key].created
                     if age >= self.window_s:
@@ -184,6 +546,12 @@ class SearchCoalescer:
                         remain = self.window_s - age
                         timeout = remain if timeout is None else min(
                             timeout, remain)
+            # under pressure several keys come due in one sweep: dispatch
+            # the most important batch first (its waiters are the ones a
+            # deadline will kill first among equals)
+            due.sort(key=lambda kb: -max(
+                (e.priority for e in kb[1].entries), default=0
+            ))
             for key, batch in due:
                 self._run(key, batch)
 
@@ -194,16 +562,28 @@ class SearchCoalescer:
         deterministically — nobody is left hung on a dead timer thread."""
         with self._lock:
             self._stop = True
-            leftovers = list(self._pending.items())
+            # ready-queue batches (QoS cap displacement) resolve under the
+            # same contract as window-pending ones
+            leftovers = self._ready + list(self._pending.items())
+            self._ready = []
             self._pending.clear()
+            self._tenant_rows.clear()
         self._wake.set()
         for key, batch in leftovers:
             if drain:
                 self._run(key, batch)
             else:
                 exc = CoalescerStopped("coalescer stopped before flush")
-                for fut, _, ws in batch.futures:
-                    ws.end()
-                    if not fut.done():
-                        fut.set_exception(exc)
+                for e in batch.entries:
+                    e.wait_span.end()
+                    if e.qos:
+                        # mirror _run's dequeue accounting: a discarded
+                        # entry must not leave phantom queue depth in the
+                        # pressure plane (heartbeats ship region_stats)
+                        from dingo_tpu.obs.pressure import PRESSURE
+
+                        PRESSURE.on_dequeue(e.region_id, len(e.queries),
+                                            e.budget)
+                    if not e.future.done():
+                        e.future.set_exception(exc)
         self._thread.join(timeout=2)

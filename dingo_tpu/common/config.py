@@ -318,6 +318,42 @@ FLAGS.define("tuner_latency_budget_ms", 0.0, mutable=True,
              help_="vector_search p99 budget the tuner respects: it "
                    "never tightens past it, and relaxes while over it "
                    "(if recall allows). 0 = no latency constraint")
+FLAGS.define("qos_enabled", False, mutable=True,
+             help_="traffic-shaped serving (obs/pressure.py + the QoS "
+                   "coalescer): deadline-aware admission, priority batch "
+                   "forming, expiry of dead requests before dispatch, and "
+                   "graduated shed/degrade under pressure. Off = observe "
+                   "nothing, act on nothing (zero-alloc like tracing); "
+                   "deadline METADATA still propagates either way so a "
+                   "mid-upgrade fleet keeps the chain")
+FLAGS.define("qos_default_deadline_ms", 0.0, mutable=True,
+             help_="deadline granted to requests arriving WITHOUT an "
+                   "x-dingo-deadline-ms header while qos.enabled (0 = no "
+                   "implied deadline: headerless requests are never "
+                   "expired or deadline-shed)")
+FLAGS.define("qos_tenant_header", "x-dingo-tenant", mutable=True,
+             help_="gRPC metadata key carrying the tenant id for "
+                   "per-tenant demand accounting and admission "
+                   "(deployments can point this at an existing auth "
+                   "header)")
+FLAGS.define("qos_max_queue_ms", 50.0, mutable=True,
+             help_="queue-wait bound the QoS layer defends: admission "
+                   "sheds low-priority work once the estimated wait "
+                   "exceeds it (priority >= 2 is exempt) and the shed "
+                   "controller escalates the degrade ladder while the "
+                   "recent queue-wait watermark sits above it")
+FLAGS.define("qos_shed_policy", "degrade_drop", mutable=True,
+             help_="pressure response: 'off' (observe only), 'degrade' "
+                   "(knob ladder only: drop rerank -> lower nprobe/ef -> "
+                   "advisory sq8), 'drop' (admission shed only), "
+                   "'degrade_drop' (both, default)")
+FLAGS.define("qos_tenant_queue_rows", 0, mutable=True,
+             help_="per-tenant cap on queued query rows inside the "
+                   "coalescer (admission sheds the excess with "
+                   "reason=tenant_limit); 0 = unlimited")
+FLAGS.define("qos_shed_interval_s", 2.0, mutable=True,
+             help_="period of the qos_shed crontab driving the graduated "
+                   "degrade ladder (one level per tick each way)")
 FLAGS.define("vector_blocked_layout", "auto", mutable=True,
              help_="maintain a dimension-blocked ([n_blocks, capacity, "
                    "block_d]) scan mirror + per-block norms in float/sq8 "

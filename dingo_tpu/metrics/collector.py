@@ -175,6 +175,17 @@ class StoreMetricsCollector:
             rm.quality_recall_ci_low = est["ci_low"]
             rm.quality_recall_ci_high = est["ci_high"]
             rm.quality_samples = int(est["queries"])
+        # serving-pressure rollup (obs/pressure.py): queue depth, recent
+        # queue-wait watermark, cumulative shed+expired — rides the same
+        # heartbeat into the coordinator's QDEPTH/PRESS/SHED columns
+        from dingo_tpu.obs.pressure import PRESSURE
+
+        qs = PRESSURE.region_stats(region.id)
+        rm.qos_queue_depth = int(qs["queue_depth"])
+        rm.qos_queue_wait_ms = float(qs["queue_wait_ms"])
+        rm.qos_shed_total = int(qs["shed_total"])
+        rm.qos_degrade_level = int(self.registry.gauge(
+            "qos.degrade_level", region.id).get())
         return rm
 
     def _approximate_bytes(self, start: bytes, end, key_count: int) -> int:
@@ -206,6 +217,9 @@ class StoreMetricsCollector:
             self.registry.drop_region(rid)
             HBM.forget_region(rid)
             QUALITY.forget_region(rid)
+            from dingo_tpu.obs.pressure import PRESSURE
+
+            PRESSURE.forget_region(rid)
         self._published_regions = current
         g = self.registry.gauge
         g("store.device.bytes_in_use").set(snap.device_bytes_in_use)
@@ -236,3 +250,7 @@ class StoreMetricsCollector:
             g("store.region.index_building", rid).set(
                 1.0 if rm.index_building else 0.0)
             g("store.region.document_count", rid).set(rm.document_count)
+            # scrapeable pressure watermark (the harvest the heartbeat
+            # ships; the depth gauge itself is maintained live by the
+            # coalescer's admit/dequeue accounting)
+            g("qos.queue_wait_watermark_ms", rid).set(rm.qos_queue_wait_ms)

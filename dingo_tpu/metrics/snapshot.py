@@ -46,6 +46,15 @@ class RegionMetricsSnapshot:
     quality_recall_ci_low: float = 0.0
     quality_recall_ci_high: float = 0.0
     quality_samples: int = 0
+    #: serving-pressure rollup (obs/pressure.py): coalescer queue depth
+    #: in query rows at collection, recent queue-wait watermark (ms, a
+    #: rolling ~2x5s window max), cumulative shed+expired requests, and
+    #: the shed controller's current degrade level (0 = serving at full
+    #: quality) — the cluster top QDEPTH/PRESS/SHED columns
+    qos_queue_depth: int = 0
+    qos_queue_wait_ms: float = 0.0
+    qos_shed_total: int = 0
+    qos_degrade_level: int = 0
 
 
 @persist.register

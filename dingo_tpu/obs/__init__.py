@@ -20,10 +20,26 @@ observability"):
 - ``tuner`` — closed-loop SLO controller walking (rerank_factor, nprobe,
   ef, precision) one shape-ladder step per tick against
   ``quality.slo_recall`` and a latency budget.
+- ``pressure`` — serving-pressure plane: per-request deadline/tenant/
+  priority budget propagation (contextvar + gRPC metadata), the
+  ``qos.*`` metrics family (queue depth/wait watermarks, per-stage
+  budget fractions, goodput vs throughput, shed/expired counters), and
+  the graduated shed controller extending the tuner's knob ladder.
 """
 
 from dingo_tpu.obs.flight import FLIGHT, FlightRecorder  # noqa: F401
 from dingo_tpu.obs.hbm import HBM, HbmLedger, looks_like_oom  # noqa: F401
+from dingo_tpu.obs.pressure import (  # noqa: F401
+    PRESSURE,
+    Budget,
+    DeadlineExceeded,
+    PressurePlane,
+    QosRejected,
+    RequestShed,
+    ShedController,
+    budget_scope,
+    current_budget,
+)
 from dingo_tpu.obs.quality import QUALITY, QualityPlane  # noqa: F401
 from dingo_tpu.obs.sentinel import (  # noqa: F401
     SENTINEL,
@@ -33,16 +49,25 @@ from dingo_tpu.obs.sentinel import (  # noqa: F401
 from dingo_tpu.obs.tuner import QualityTunerRunner, SloTuner  # noqa: F401
 
 __all__ = [
+    "Budget",
+    "DeadlineExceeded",
     "FLIGHT",
     "FlightRecorder",
     "HBM",
     "HbmLedger",
+    "PRESSURE",
+    "PressurePlane",
     "QUALITY",
     "QualityPlane",
     "QualityTunerRunner",
+    "QosRejected",
     "RecompileSentinel",
+    "RequestShed",
     "SENTINEL",
+    "ShedController",
     "SloTuner",
+    "budget_scope",
+    "current_budget",
     "looks_like_oom",
     "sentinel_jit",
 ]

@@ -129,7 +129,9 @@ class FlightRecorder:
         routing), hnsw.* (hops, visited fraction, beam occupancy,
         adjacency rebuilds), and quality.* (live recall/CI/RBO + tuner
         knob positions — was the store trading recall when the incident
-        hit?)."""
+        hit?), and qos.* (queue depth/wait, shed/expired counters,
+        degrade level — was the store under pressure, and what had
+        admission already given up on?)."""
         return {k: v for k, v in now_flat.items() if k.startswith(prefix)}
 
     # ---- triggers ----------------------------------------------------------
@@ -276,6 +278,7 @@ class FlightRecorder:
             "mesh": self._family_state(now_flat, "mesh."),
             "hnsw": self._family_state(now_flat, "hnsw."),
             "quality": self._family_state(now_flat, "quality."),
+            "qos": self._family_state(now_flat, "qos."),
             "config": config,
         }
         blob = zlib.compress(

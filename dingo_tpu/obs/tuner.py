@@ -215,6 +215,16 @@ class SloTuner:
         budget = float(self._flag("tuner_latency_budget_ms", self._budget))
         if estimate is None or estimate.get("queries", 0) < self.min_queries:
             return None     # no / not enough fresh evidence: hold position
+        if METRICS.gauge("qos.degrade_level",
+                         region_id=index.id).get() > 0:
+            # the pressure shed ladder (obs/pressure.py ShedController) is
+            # actively degrading this region: tightening the very knobs it
+            # just relaxed would make the two controllers fight — hold and
+            # count until pressure clears (the shed controller restores
+            # the saved settings on its way back down)
+            METRICS.counter("quality.tuner_blocked",
+                            region_id=index.id).add(1)
+            return None
         from dingo_tpu.obs.quality import WindowedEstimator
 
         age = time.time() - float(estimate.get("newest_ts", 0.0))

@@ -255,6 +255,10 @@ _REGION_METRIC_FIELDS = (
     # quality_samples == 0 means the figures carry no evidence
     "quality_recall", "quality_recall_ci_low", "quality_recall_ci_high",
     "quality_samples",
+    # serving-pressure plane (obs/pressure.py): queue depth / recent
+    # queue-wait watermark / cumulative shed+expired / degrade level
+    "qos_queue_depth", "qos_queue_wait_ms", "qos_shed_total",
+    "qos_degrade_level",
 )
 
 _STORE_METRIC_FIELDS = (

@@ -280,6 +280,18 @@ def serve_store(args) -> None:
         float(FLAGS.get("tuner_interval_s")),
         QualityTunerRunner(node, crontab=crontab).tick,
     )
+    # graduated load shedding (obs/pressure.py): one degrade-ladder level
+    # per tick per over-pressure region (drop rerank -> lower nprobe/ef ->
+    # advisory sq8), one level back per calm tick. Hot-gated per tick on
+    # qos.enabled + a 'degrade' shed policy (the tuner/replica-planner
+    # wiring pattern), so it always rides the crontab and no-ops off
+    from dingo_tpu.obs import ShedController
+
+    crontab.add(
+        "qos_shed",
+        float(FLAGS.get("qos_shed_interval_s")),
+        ShedController(node, crontab=crontab).tick,
+    )
     # device-runtime observability: process HBM watermark poll (per-region
     # owner ledgers refresh with each store_metrics pass) + region/index
     # config snapshots for flight-recorder bundles

@@ -13,6 +13,12 @@ from typing import Dict, Optional, Tuple
 import grpc
 
 from dingo_tpu.common.config import FLAGS
+from dingo_tpu.obs.pressure import (
+    attach_budget,
+    detach_budget,
+    extract_budget_metadata,
+    inject_budget_metadata,
+)
 from dingo_tpu.raft.core import NotLeader
 from dingo_tpu.server import pb
 from dingo_tpu.trace import (
@@ -238,8 +244,18 @@ def _register(server: grpc.Server, service_name: str, impl) -> None:
                 # (one distributed trace across client -> server -> raft
                 # hops) or mint a root here; attaching makes every deeper
                 # span — coalescer, reader, kernels — a descendant
-                parent = extract_metadata(context.invocation_metadata())
+                metadata = context.invocation_metadata()
+                parent = extract_metadata(metadata)
                 span = TRACER.start_span(span_name, parent=parent)
+                # qos ingress: adopt the caller's time budget (remaining-
+                # ms header -> host-monotonic deadline) or grant the
+                # configured default while qos.enabled; None otherwise —
+                # the budget rides the same contextvar plumbing as the
+                # span, so the coalescer handoff and nested egress calls
+                # see it without any per-layer threading
+                budget = extract_budget_metadata(metadata)
+                btoken = attach_budget(budget) if budget is not None \
+                    else None
                 # always-sample-slow: an unsampled request still gets a
                 # two-clock-read watch so outlier latency is never lost
                 slow_t0 = 0 if span.sampled else TRACER.slow_watch_start()
@@ -291,6 +307,8 @@ def _register(server: grpc.Server, service_name: str, impl) -> None:
                         resp.error.errmsg = f"{type(e).__name__}: {e}"
                     return resp
                 finally:
+                    if btoken is not None:
+                        detach_budget(btoken)
                     if token is not None:
                         span.detach(token)
                     span.end()
@@ -398,6 +416,9 @@ class _TracedCall:
 
     def __call__(self, request, timeout=None, metadata=None, **kwargs):
         with TRACER.start_span(self._name) as span:
+            # qos egress: the current budget (if any) crosses the wire as
+            # remaining-ms + tenant + priority, next to the trace context
+            metadata = inject_budget_metadata(metadata)
             if span.sampled:
                 metadata = inject_metadata(metadata)
             elif current_span() is not None \

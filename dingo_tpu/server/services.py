@@ -152,13 +152,17 @@ class IndexService:
                 self._coalescer.stop()
                 self._coalescer = None
             if self._coalescer is None:
-                def run(key, stacked):
+                def run(key, stacked, stage_us=None):
                     region_id, topn, kw_items = key
                     region = self.node.get_region(region_id)
                     if region is None:
                         raise VectorIndexError(f"region {region_id} gone")
+                    # stage_us (reader stage timings) feeds the QoS
+                    # per-stage budget accounting when qos is on; the
+                    # coalescer only passes it when it wants the split
                     return self.node.storage.vector_batch_search(
-                        region, stacked, topn, **dict(kw_items)
+                        region, stacked, topn, stage_us=stage_us,
+                        **dict(kw_items)
                     )
 
                 self._coalescer = SearchCoalescer(run, window_ms=window)
@@ -181,8 +185,16 @@ class IndexService:
         # panic here; a panic propagates to the generic rpc handler which
         # black-boxes it and answers in-band)
         FAILPOINTS.apply("before_vector_search")
+        from dingo_tpu.obs import pressure as qos
         from dingo_tpu.trace import current_span
 
+        budget = qos.current_budget() if qos.qos_enabled() else None
+        if budget is not None and budget.expired():
+            # deadline-aware admission: a request that arrives already
+            # dead is rejected before ANY index work — no kernel is
+            # dispatched for it (sentinel-verified in tests/test_qos.py)
+            qos.PRESSURE.on_expired("admission", region.id, budget)
+            return _err(resp, 30002, "deadline exceeded at admission"), None
         ingress = current_span()
         if ingress is not None and ingress.sampled:
             ingress.set_attr("region_id", region.id)
@@ -244,8 +256,18 @@ class IndexService:
                 )
                 try:
                     results = self._get_coalescer().submit(
-                        key, queries, max_batch=cap
+                        key, queries, max_batch=cap, region_id=region.id
                     ).result(timeout=30)
+                except qos.QosRejected as e:
+                    # an admission/expiry decision is FINAL — falling back
+                    # to a direct search would serve exactly the work the
+                    # QoS layer decided the store cannot afford
+                    return _err(
+                        resp,
+                        30002 if isinstance(e, qos.DeadlineExceeded)
+                        else 30003,
+                        str(e),
+                    ), None
                 except (RuntimeError, FuturesTimeoutError):
                     # coalescer stopped mid-flight (flag hot-change) or the
                     # batch stalled: serve this request directly
@@ -275,6 +297,11 @@ class IndexService:
                 if v.scalar:
                     convert.scalar_to_pb(item.scalar_data, v.scalar)
         lat.observe_us((time.perf_counter_ns() - t0) / 1000.0)
+        if qos.qos_enabled():
+            # throughput vs goodput: every reply counts served; only the
+            # ones inside their budget count toward goodput (a late reply
+            # additionally black-boxes a deadline_exceeded flight bundle)
+            qos.PRESSURE.on_served(region.id, budget)
         return resp, region
 
     def VectorSearch(self, req: pb.VectorSearchRequest) -> pb.VectorSearchResponse:

@@ -158,3 +158,40 @@ def test_live_quality_recall_estimates_are_gated(tmp_path):
     result = bench_diff.compare(old, new)
     assert [r["path"] for r in result["regressions"]] == [
         "recall_slo.live_recall_estimate"]
+
+
+def test_overload_goodput_classification():
+    """ISSUE 10: the overload scenario's goodput figures regress like QPS
+    — but only on the SHAPED arm. The qos_off arm is the intentional
+    collapse demonstration (noisy by design), and the raw load
+    accounting (shed/expired/offered counts) tracks the offered rate,
+    not code quality."""
+    assert bench_diff.classify("overload.qos_on.goodput_qps") == "qps"
+    assert bench_diff.classify("overload.goodput_ratio_on_vs_off") == "qps"
+    assert bench_diff.classify("overload.capacity_qps") == "qps"
+    assert bench_diff.classify(
+        "overload.qos_on.steady_state_recompiles") == "recompiles"
+    # never regression signals:
+    assert bench_diff.classify("overload.qos_off.goodput_qps") is None
+    assert bench_diff.classify("overload.qos_off.served_p99_ms") is None
+    assert bench_diff.classify("overload.qos_on.shed") is None
+    assert bench_diff.classify("overload.qos_on.expired") is None
+    assert bench_diff.classify("overload.qos_on.offered") is None
+    assert bench_diff.classify("overload.deadline_ms") is None
+
+
+def test_overload_goodput_drop_is_a_regression(tmp_path):
+    old = {"overload": {
+        "capacity_qps": 1800.0, "deadline_ms": 250.0,
+        "qos_on": {"goodput_qps": 1200.0, "shed": 2400, "expired": 10},
+        "qos_off": {"goodput_qps": 120.0},
+        "goodput_ratio_on_vs_off": 10.0,
+    }}
+    new = copy.deepcopy(old)
+    new["overload"]["qos_on"]["goodput_qps"] = 600.0   # halved: regression
+    new["overload"]["qos_off"]["goodput_qps"] = 30.0   # noisy arm: ignored
+    new["overload"]["qos_on"]["shed"] = 3100           # load figure: ignored
+    new["overload"]["goodput_ratio_on_vs_off"] = 20.0  # improved
+    result = bench_diff.compare(old, new)
+    assert [r["path"] for r in result["regressions"]] == [
+        "overload.qos_on.goodput_qps"]

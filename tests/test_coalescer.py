@@ -8,7 +8,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 import pytest
 
-from dingo_tpu.common.coalescer import SearchCoalescer
+from dingo_tpu.common.coalescer import CoalescerStopped, SearchCoalescer
 
 
 def test_coalesces_within_window():
@@ -208,3 +208,59 @@ def test_cap_displaced_batch_does_not_block_submitter():
         assert len(f2.result(timeout=5)) == 4
     finally:
         co.stop()
+
+
+@pytest.mark.parametrize("qos", [False, True])
+def test_submit_racing_stop_never_hangs(qos):
+    """ISSUE 10 regression: a submit racing stop(drain=False) must get a
+    deterministic CoalescerStopped future — never slip into a queue whose
+    flush thread is already gone and hang its caller. The admitted-vs-
+    stopped decision is made atomically under the queue lock at APPEND
+    time, so the QoS admission work a submit now does between "am I
+    stopped?" and "append" cannot make the answer stale (the qos=True arm
+    exercises exactly that widened window)."""
+    from dingo_tpu.common.config import FLAGS
+
+    FLAGS.set("qos_enabled", qos)
+    try:
+        for trial in range(6):
+            def run(key, stacked):
+                return list(range(len(stacked)))
+
+            co = SearchCoalescer(run, window_ms=1.0)
+            start = threading.Barrier(5)
+            futs: list = []
+            flock = threading.Lock()
+
+            def submitter():
+                start.wait()
+                for _ in range(40):
+                    f = co.submit("k", np.zeros((1, 2), np.float32))
+                    with flock:
+                        futs.append(f)
+
+            threads = [threading.Thread(target=submitter)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            start.wait()
+            # vary the interleaving: stop lands anywhere from "before the
+            # first submit ran" to "mid-storm"
+            time.sleep(0.0015 * trial)
+            co.stop(drain=False)
+            for t in threads:
+                t.join(timeout=10)
+                assert not t.is_alive()
+            assert len(futs) == 160
+            served = stopped = 0
+            for f in futs:
+                # every future resolves deterministically within a bound:
+                # a result (flushed before the stop) or CoalescerStopped
+                try:
+                    f.result(timeout=5)
+                    served += 1
+                except CoalescerStopped:
+                    stopped += 1
+            assert served + stopped == 160
+    finally:
+        FLAGS.set("qos_enabled", False)

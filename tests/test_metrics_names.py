@@ -84,6 +84,23 @@ def test_checker_curates_quality_family(tmp_path):
     assert "quality" in problems[0][1]
 
 
+def test_checker_curates_qos_family(tmp_path):
+    """The serving-pressure plane's qos.* series are curated: dashboards
+    key on the exact names, so additions must be explicit."""
+    f = tmp_path / "qos.py"
+    f.write_text(
+        "from dingo_tpu.common.metrics import METRICS\n"
+        "METRICS.counter('qos.shed').add(1)\n"                 # declared
+        "METRICS.gauge('qos.queue_depth').set(4)\n"            # declared
+        "METRICS.latency('qos.stage_budget_pct')\n"            # declared
+        "METRICS.counter('qos.served_in_deadline').add(1)\n"   # declared
+        "METRICS.counter('qos.freelance_series').add(1)\n"     # undeclared
+    )
+    problems = checker.check_file(str(f))
+    assert [p[0] for p in problems] == [6], problems
+    assert "qos" in problems[0][1]
+
+
 def test_registry_name_rule_matches_lint():
     from dingo_tpu.common.metrics import valid_metric_name
 

@@ -599,7 +599,8 @@ def test_cluster_top_renders_recall_column():
     # region 2 has no evidence: its RECALL cell is '-'
     line2 = next(ln for ln in out.splitlines() if ln.startswith("2 "))
     cells = line2.split()
-    assert cells[-2] == "-"     # RECALL sits before FLAGS
+    # RECALL sits before the QDEPTH/PRESS/SHED pressure columns + FLAGS
+    assert cells[-5] == "-"
 
 
 def test_flight_bundle_captures_quality_state(tmp_path):

@@ -252,9 +252,13 @@ def test_coalescer_stop_no_drain_fails_futures_deterministically():
     co.stop(drain=False)
     with pytest.raises(CoalescerStopped):
         fut.result(timeout=1)
-    # post-stop submits are refused with the same typed error
+    # post-stop submits are refused with the same typed error — on the
+    # FUTURE, not by raising: since the QoS layer (ISSUE 10) the submit
+    # contract is "never raises, never hangs; every returned future
+    # resolves deterministically"
+    late = co.submit("k", np.zeros((1, 2), np.float32))
     with pytest.raises(CoalescerStopped):
-        co.submit("k", np.zeros((1, 2), np.float32))
+        late.result(timeout=1)
 
 
 # ---------------- exporters ----------------

@@ -64,6 +64,22 @@ def classify(path: str, summary: Optional[dict] = None) -> Optional[str]:
         # the CPU reference measurement drifts with the host, not with
         # the code under test — never a regression signal
         return None
+    if "qos_off" in path.lower():
+        # the overload scenario's UNSHAPED arm exists to demonstrate the
+        # collapse — its goodput is intentionally terrible and noisy
+        # (whatever survived before the backlog crossed the deadline);
+        # only the shaped arm and the on/off ratio are the signal
+        return None
+    if "goodput" in low:
+        # goodput (replies within deadline) regresses like a QPS figure:
+        # covers goodput_ratio_* and any future non-_qps-suffixed key
+        return "qps"
+    if low in ("shed", "expired", "offered", "served", "dispatched_rows",
+               "deadline_ms"):
+        # overload-scenario load accounting: magnitudes track the offered
+        # rate (2x measured capacity), not code quality — the goodput and
+        # gate keys carry the regression signal
+        return None
     if low == "value" and summary is not None and (
         summary.get("unit") == "qps"
     ):

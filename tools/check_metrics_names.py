@@ -15,11 +15,11 @@ Also linted:
   method names: `rpc.DebugService.MetricsDump`), but the name must start
   lowercase and stay inside the identifier-plus-dots alphabet.
 - curated metric families: literal registrations under the `xla.` /
-  `hbm.` / `flight.` / `ivf.` / `mesh.` / `hnsw.` / `quality.` prefixes
-  (the device-runtime observability, mesh serving, device graph, and
-  quality planes) must name a series declared in FAMILY_NAMES below —
-  dashboards key on these exact names, so additions are explicit, not
-  incidental.
+  `hbm.` / `flight.` / `ivf.` / `mesh.` / `hnsw.` / `quality.` / `qos.`
+  prefixes (the device-runtime observability, mesh serving, device
+  graph, quality, and serving-pressure planes) must name a series
+  declared in FAMILY_NAMES below — dashboards key on these exact names,
+  so additions are explicit, not incidental.
 
 Wired as a tier-1 test (tests/test_metrics_names.py) so a bad name fails
 CI, not the scrape.
@@ -112,6 +112,32 @@ FAMILY_NAMES = {
                                     # (candidate, dim-block) work skipped
         "ivf.pruned_candidates",    # candidates dropped before their
                                     # last dimension block
+    },
+    "qos": {
+        # serving-pressure plane (obs/pressure.py + common/coalescer.py):
+        # admission / queue lifecycle
+        "qos.admitted",             # requests admitted to the queue
+        "qos.demand_rows",          # query rows submitted, by
+                                    # {tenant, priority}
+        "qos.queue_depth",          # live queued rows (gauge, by
+                                    # region + tenant + priority)
+        "qos.queue_wait",           # queue-wait latency recorder (us)
+        "qos.queue_wait_watermark_ms",  # recent rolling-window max the
+                                    # heartbeat rollup ships
+        "qos.stage_budget_pct",     # per-stage deadline share (percent,
+                                    # stage label: queue / batch_form /
+                                    # kernel / rerank)
+        # outcomes: throughput vs goodput
+        "qos.served",               # every reply
+        "qos.served_in_deadline",   # goodput: replies inside their budget
+        "qos.deadline_exceeded",    # served but late (flight-bundled)
+        "qos.expired",              # dead on arrival / died in queue,
+                                    # by {where}
+        "qos.shed",                 # admission drops, by {reason}
+        # graduated degrade ladder (ShedController)
+        "qos.degrade_level",        # current level per region (0-3)
+        "qos.degrade_steps",        # ladder moves, by {direction}
+        "qos.precision_advisory",   # level-3 sq8 advisory flag per region
     },
     "quality": {
         # live recall observability (obs/quality.py): windowed shadow-

@@ -220,6 +220,63 @@ def _quality_section(quality: Dict[str, Any]) -> List[str]:
     return out
 
 
+def _qos_section(qos: Dict[str, Any]) -> List[str]:
+    """Per-region serving-pressure state at capture time (absolute qos.*
+    series): was the store under pressure when the incident hit, what had
+    admission shed/expired, and how far down the degrade ladder the shed
+    controller sat. Region-attributed series render here; the per-
+    (tenant, priority) splits and stage-budget recorders stay in the raw
+    bundle JSON."""
+    per: Dict[str, Dict[str, float]] = {}
+    tenants: Dict[str, float] = {}
+    for key, val in qos.items():
+        name, labels = _series_labels(key)
+        if not name.startswith("qos."):
+            continue
+        if name.startswith("qos.demand_rows"):
+            who = f"{labels.get('tenant', '?')}/p{labels.get('priority', '?')}"
+            tenants[who] = tenants.get(who, 0.0) + val
+            continue
+        region = labels.get("region")
+        if region is None:
+            continue
+        field = name[4:]
+        agg = per.setdefault(region, {})
+        # shed/expired/queue_depth series split by tenant/priority/where/
+        # reason labels: sum them into the region row
+        agg[field] = agg.get(field, 0.0) + val
+    out = [f"-- serving pressure / qos state ({len(qos)} series)"]
+    rows = []
+    for region in sorted(per):
+        st = per[region]
+        served = st.get("served", 0.0)
+        goodput = st.get("served_in_deadline", 0.0)
+        rows.append([
+            region,
+            f"{st.get('queue_depth', 0):.0f}",
+            f"{st.get('queue_wait_watermark_ms', 0):.0f}ms",
+            f"{goodput:.0f}/{served:.0f}",
+            f"{st.get('deadline_exceeded', 0):.0f}",
+            f"{st.get('shed', 0):.0f}",
+            f"{st.get('expired', 0):.0f}",
+            f"{st.get('degrade_level', 0):.0f}",
+        ])
+    if rows:
+        out.extend(_table(
+            ["REGION", "QDEPTH", "PRESS", "GOODPUT/SERVED", "LATE",
+             "SHED", "EXPIRED", "DEGRADE"], rows
+        ))
+    else:
+        out.append("  (no region qos series)")
+    if tenants:
+        out.append("")
+        out.extend(_table(
+            ["TENANT/PRIO", "DEMAND_ROWS"],
+            [[who, f"{rows_:.0f}"] for who, rows_ in sorted(tenants.items())],
+        ))
+    return out
+
+
 def render(bundle: Dict[str, Any]) -> str:
     out: List[str] = []
     created = bundle.get("created_ms", 0) / 1000.0
@@ -329,6 +386,11 @@ def render(bundle: Dict[str, Any]) -> str:
     if quality:
         out.append("")
         out.extend(_quality_section(quality))
+
+    qos = bundle.get("qos") or {}
+    if qos:
+        out.append("")
+        out.extend(_qos_section(qos))
 
     slow = bundle.get("slow_queries") or []
     if slow:

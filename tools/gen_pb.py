@@ -63,6 +63,13 @@ NEW_MESSAGES = {
         ("quality_recall_ci_low", 18, T.TYPE_DOUBLE, None, False),
         ("quality_recall_ci_high", 19, T.TYPE_DOUBLE, None, False),
         ("quality_samples", 20, T.TYPE_INT64, None, False),
+        # serving-pressure plane (obs/pressure.py, PR 10): coalescer
+        # queue depth (rows), recent queue-wait watermark (ms),
+        # cumulative shed+expired requests, shed-ladder degrade level
+        ("qos_queue_depth", 21, T.TYPE_INT64, None, False),
+        ("qos_queue_wait_ms", 22, T.TYPE_DOUBLE, None, False),
+        ("qos_shed_total", 23, T.TYPE_INT64, None, False),
+        ("qos_degrade_level", 24, T.TYPE_INT64, None, False),
     ],
     # whole-store snapshot (process device gauges + per-region list)
     "StoreMetrics": [
