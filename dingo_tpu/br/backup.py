@@ -14,6 +14,7 @@ re-creating regions through the coordinator.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import time
@@ -63,6 +64,10 @@ def backup_cluster(coordinator, nodes: Dict[str, object], path: str,
             "definition": _def_to_json(definition),
             "data_file": fname,
             "bytes": len(blob),
+            # state-integrity: restore verifies the artifact before
+            # installing — a backup that rotted at rest must fail loudly,
+            # not silently seed a corrupt region
+            "sha256": hashlib.sha256(blob).hexdigest(),
         })
     manifest["skipped_regions"] = skipped
     # schema/table meta (the reference's sql-meta group)
@@ -148,7 +153,14 @@ def restore_cluster(coordinator, nodes: Dict[str, object], path: str,
             time.sleep(0.05)
         region_id_map[entry["region_id"]] = created.region_id
         with open(os.path.join(path, entry["data_file"]), "rb") as f:
-            state = wire.decode(f.read())
+            blob = f.read()
+        want = entry.get("sha256")
+        if want and hashlib.sha256(blob).hexdigest() != want:
+            raise ValueError(
+                f"backup artifact {entry['data_file']} corrupt "
+                "(sha256 mismatch) — refusing to install"
+            )
+        state = wire.decode(blob)
         installed = 0
         for sid in created.peers:
             node = nodes.get(sid)

@@ -61,6 +61,7 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
     GetStoreMetricsResponse (pure render — tests drive it directly)."""
     store_rows = []
     region_rows = []
+    diverged = set(getattr(resp, "diverged_region_ids", ()))
 
     def _recall_cell(recall: float, samples: int) -> str:
         # 0 scored queries = no evidence (sampling off / idle region):
@@ -110,6 +111,14 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
                 flags.append("not-ready")
             if r.qos_degrade_level:
                 flags.append(f"degraded-l{r.qos_degrade_level}")
+            if r.region_id in diverged:
+                # replica digest comparison at equal applied indices
+                # disagreed (state-integrity plane)
+                flags.append("DIVERGED")
+            if getattr(r, "integrity_mismatch", False):
+                # this replica's own scrub caught its device state
+                # disagreeing with the incremental ledger
+                flags.append("CORRUPT")
             region_rows.append([
                 str(r.region_id),
                 entry.store_id,
@@ -142,6 +151,89 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
              "FLAGS"],
             region_rows,
         ),
+    ]
+    return "\n".join(out)
+
+
+def format_cluster_consistency(resp, region_id: int = 0) -> str:
+    """`cluster consistency`: per-(region, store) per-artifact digest
+    table from a GetRegionMetricsResponse, with a replica-comparison
+    verdict per region (pure render — tests drive it directly).
+
+    Verdict semantics: replicas are comparable only at EQUAL applied
+    indices; 'ok' = every comparable pair agrees on every shared
+    artifact, 'DIVERGED' = some comparable pair disagrees (or the
+    coordinator flagged it), 'lagging' = no two replicas sit at the same
+    applied index yet, '-' = no digest evidence."""
+    import json as _json
+
+    per_region: Dict[int, List] = {}
+    for entry in resp.regions:
+        m = entry.metrics
+        if region_id and m.region_id != region_id:
+            continue
+        per_region.setdefault(m.region_id, []).append(
+            (entry.store_id, entry.stale, m)
+        )
+    diverged = set(getattr(resp, "diverged_region_ids", ()))
+    rows = []
+    verdicts = []
+    for rid in sorted(per_region):
+        replicas = per_region[rid]
+        vectors = []          # (store, applied, {artifact: digest})
+        for sid, stale, m in replicas:
+            digests = {}
+            if m.integrity_digests:
+                try:
+                    digests = _json.loads(m.integrity_digests)
+                except ValueError:
+                    digests = {}
+            vectors.append((sid, stale, m, digests))
+            arts = sorted(digests) or ["-"]
+            for art in arts:
+                d = digests.get(art, "")
+                rows.append([
+                    str(rid),
+                    sid,
+                    str(m.integrity_applied_index),
+                    art,
+                    # digest hex is count-s0-s1; show count + a short
+                    # prefix (full vectors via --json / GetRegionMetrics)
+                    d.split("-")[0] if d else "-",
+                    (d.split("-")[1][:12] if d else "-"),
+                    ("STALE" if stale else
+                     ("CORRUPT" if m.integrity_mismatch else "ok")),
+                ])
+        # replica comparison at equal applied indices
+        verdict = "-"
+        compared = False
+        bad = rid in diverged
+        for i in range(len(vectors)):
+            for j in range(i + 1, len(vectors)):
+                _si, _st, mi, di = vectors[i]
+                _sj, _stj, mj, dj = vectors[j]
+                if not di or not dj:
+                    continue
+                if mi.integrity_applied_index != mj.integrity_applied_index:
+                    continue
+                compared = True
+                if any(di[a] != dj[a] for a in set(di) & set(dj)):
+                    bad = True
+        if bad:
+            verdict = "DIVERGED"
+        elif compared:
+            verdict = "ok"
+        elif any(v[3] for v in vectors):
+            verdict = "lagging" if len(vectors) > 1 else "single"
+        verdicts.append([str(rid), str(len(replicas)), verdict])
+    out = [
+        _render_table(
+            ["REGION", "STORE", "APPLIED", "ARTIFACT", "COUNT", "DIGEST",
+             "STATUS"],
+            rows,
+        ),
+        "",
+        _render_table(["REGION", "REPLICAS", "VERDICT"], verdicts),
     ]
     return "\n".join(out)
 
@@ -310,6 +402,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="limit to one store id")
     top.add_argument("--region", type=int, default=0,
                      help="limit the region table to one region id")
+    consistency = cluster.add_parser("consistency")
+    consistency.add_argument("--region", type=int, default=0,
+                             help="limit to one region id")
     jobs = cluster.add_parser("jobs")
     jobs.add_argument("--include-done", action="store_true")
     detail = cluster.add_parser("region-detail")
@@ -617,6 +712,12 @@ def run_command(client: DingoClient, args) -> int:
             pb.GetStoreMetricsRequest(store_id=args.target_store)
         )
         print(format_cluster_top(r, region_id=args.region))
+    elif g == "cluster" and c == "consistency":
+        stub = client.coordinator_service("ClusterStatService")
+        r = stub.GetRegionMetrics(
+            pb.GetRegionMetricsRequest(region_id=args.region)
+        )
+        print(format_cluster_consistency(r, region_id=args.region))
     elif g == "cluster" and c == "jobs":
         stub = client.coordinator_service("JobService")
         r = stub.ListJobs(pb.ListJobsRequest(include_done=args.include_done))

@@ -354,6 +354,27 @@ FLAGS.define("qos_tenant_queue_rows", 0, mutable=True,
 FLAGS.define("qos_shed_interval_s", 2.0, mutable=True,
              help_="period of the qos_shed crontab driving the graduated "
                    "degrade ladder (one level per tick each way)")
+FLAGS.define("integrity_enabled", True, mutable=True,
+             help_="maintain incremental per-artifact state digests "
+                   "(obs/integrity.py): every index write folds its batch "
+                   "into an order-invariant set digest per artifact (rows, "
+                   "sq8 codes, blocked mirror, HNSW adjacency, IVF bucket "
+                   "assignment) with O(batch) host work; digests ride "
+                   "heartbeats for replica divergence detection and gate "
+                   "snapshot restores. Off = no ledgers, no scrub, no "
+                   "restore verification")
+FLAGS.define("integrity_scrub_interval_s", 60.0, mutable=True,
+             help_="period of the consistency_scrub crontab: recompute "
+                   "full digests from device state (chunked under "
+                   "store.device_lock) and check them against the "
+                   "incremental ledger — catches silent HBM/restore "
+                   "corruption AND ledger bookkeeping bugs")
+FLAGS.define("integrity_flight_on_divergence", True, mutable=True,
+             help_="capture a flight-recorder bundle (rate-limited per "
+                   "reason) when the scrub finds a corrupted artifact or "
+                   "the coordinator sees replicas diverge at equal "
+                   "applied indices; the bundle carries the digest "
+                   "vectors of both sides")
 FLAGS.define("vector_blocked_layout", "auto", mutable=True,
              help_="maintain a dimension-blocked ([n_blocks, capacity, "
                    "block_d]) scan mirror + per-block norms in float/sq8 "

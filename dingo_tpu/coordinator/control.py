@@ -123,6 +123,10 @@ class CoordinatorControl:
         #: re-reported every beat, persisting it would only replay stale
         #: figures after a restart
         self.store_metrics: Dict[str, Tuple[object, int]] = {}
+        #: regions whose replica state digests diverge at EQUAL applied
+        #: indices (state-integrity plane): region_id -> evidence dict.
+        #: In-memory like store_metrics — re-derived from every beat
+        self.integrity_diverged: Dict[int, Dict] = {}
         self.jobs: List[RegionCmd] = []
         self._next_region_id = 1000
         self._next_cmd_id = 1
@@ -276,7 +280,12 @@ class CoordinatorControl:
                 c.status = "sent"
             if pending or done_cmd_ids or failed_cmd_ids or stalled_cmd_ids:
                 self._persist_ops()
-            return pending
+        # replica digest comparison OUTSIDE the lock: it parses digest
+        # vectors and (on a fresh divergence) captures a flight bundle —
+        # neither belongs under the coordinator's global lock
+        if metrics is not None:
+            self._check_integrity(store_id, metrics)
+        return pending
 
     def reset_sent_cmds(self) -> int:
         """Mark every 'sent' command deliverable again. A command is 'sent'
@@ -322,6 +331,113 @@ class CoordinatorControl:
                 s for s in self.stores.values()
                 if s.state is StoreState.NORMAL
             ]
+
+    # ---------------- state-integrity comparison ----------------------------
+    def _check_integrity(self, store_id: str, metrics) -> None:
+        """Compare the arriving store's per-region digest vectors against
+        every other store's cached snapshot AT EQUAL APPLIED INDICES
+        (state-integrity plane, obs/integrity.py). Replicas that applied
+        the same raft prefix hold the same logical data by contract, so
+        differing digests mean one of them silently corrupted — raise the
+        consistency.* family, flag the region DIVERGED, and capture a
+        rate-limited flight bundle carrying BOTH digest vectors. A clean
+        agreement at equal applied indices clears the flag. Runs OUTSIDE
+        the coordinator lock (takes it briefly to snapshot/update state);
+        never raises (heartbeats must not die on telemetry)."""
+        try:
+            self._check_integrity_inner(store_id, metrics)
+        except Exception:  # noqa: BLE001 — observability must not re-raise
+            _log.exception("integrity comparison failed")
+
+    def _check_integrity_inner(self, store_id: str, metrics) -> None:
+        from dingo_tpu.common.metrics import METRICS
+        from dingo_tpu.obs.integrity import diverged_artifacts
+
+        regions = getattr(metrics, "regions", None) or []
+        with self._lock:
+            peers = {
+                sid: snap for sid, (snap, _at) in self.store_metrics.items()
+                if sid != store_id
+            }
+        for rm in regions:
+            digests = getattr(rm, "integrity_digests", "")
+            if not digests:
+                continue
+            rid = rm.region_id
+            applied = int(getattr(rm, "integrity_applied_index", 0))
+            diverging = []
+            agreeing = 0
+            for sid, snap in peers.items():
+                other = next(
+                    (r for r in getattr(snap, "regions", [])
+                     if r.region_id == rid), None,
+                )
+                if other is None:
+                    continue
+                o_digests = getattr(other, "integrity_digests", "")
+                o_applied = int(
+                    getattr(other, "integrity_applied_index", 0)
+                )
+                if not o_digests or o_applied != applied:
+                    continue          # unequal applied = lag, not damage
+                if o_digests == digests:
+                    # canonical JSON (sorted keys, fixed separators):
+                    # string equality IS vector equality — the common
+                    # healthy path never pays a parse
+                    agreeing += 1
+                    continue
+                arts = diverged_artifacts(digests, o_digests)
+                if arts:
+                    diverging.append(
+                        {"store": sid, "artifacts": arts,
+                         "digests": o_digests}
+                    )
+                else:
+                    agreeing += 1
+            if diverging:
+                evidence = {
+                    "applied_index": applied,
+                    "store": store_id,
+                    "digests": digests,
+                    "peers": diverging,
+                    "detected_ms": int(time.time() * 1000),
+                }
+                with self._lock:
+                    newly = rid not in self.integrity_diverged
+                    self.integrity_diverged[rid] = evidence
+                if newly:
+                    METRICS.counter(
+                        "consistency.divergence", region_id=rid
+                    ).add(1)
+                    region_log(_log, rid).error(
+                        "replica state DIVERGED at applied index %d: "
+                        "%s vs %s", applied, store_id,
+                        [d["store"] for d in diverging])
+                    from dingo_tpu.common.config import FLAGS
+                    if bool(FLAGS.get("integrity_flight_on_divergence")):
+                        from dingo_tpu.obs.flight import FLIGHT
+
+                        FLIGHT.trigger(
+                            "divergence",
+                            name=f"region_{rid}",
+                            region_id=rid,
+                            extra=evidence,
+                        )
+            elif agreeing:
+                with self._lock:
+                    was = self.integrity_diverged.pop(rid, None)
+                if was is not None:
+                    # replicas re-converged (rebuild/restore healed the
+                    # bad copy): clear the flag
+                    region_log(_log, rid).info(
+                        "replica state digests re-converged")
+        with self._lock:
+            n = len(self.integrity_diverged)
+        METRICS.gauge("consistency.diverged_regions").set(float(n))
+
+    def diverged_regions(self) -> List[int]:
+        with self._lock:
+            return sorted(self.integrity_diverged)
 
     # ---------------- metrics aggregation -----------------------------------
     def get_store_metrics(self, store_id: str = "", *,

@@ -61,6 +61,15 @@ class InvalidParameter(VectorIndexError):
     """EILLEGAL_PARAMTETERS [sic — reference spells it this way]."""
 
 
+class SnapshotCorruption(VectorIndexError):
+    """A restored snapshot's recomputed state digests diverge from the
+    digest vector persisted in its meta.json (obs/integrity.py): the
+    files were corrupted at rest or the restore itself mangled data.
+    load() raises it BEFORE the index can serve; the manager's
+    load-or-build path treats any load failure as 'rebuild from the
+    engine', which is exactly the right recovery."""
+
+
 @dataclasses.dataclass(frozen=True)
 class IndexParameter:
     """Union of pb::common::VectorIndexParameter fields we support.

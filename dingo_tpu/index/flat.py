@@ -79,6 +79,26 @@ def _new_tier_store(precision: str, dim: int, parameter: IndexParameter,
     return SlotStore(dim, dtype, **kw)
 
 
+def integrity_mutation(fn):
+    """Bracket an index write path for the state-integrity plane: bumps
+    the ledger's pending/mutation counters BEFORE any device state can
+    mutate and releases the pending bracket when the method exits (even
+    on error). While the bracket is open a concurrent scrub classifies
+    as raced (device may be ahead of the ledger) and the heartbeat
+    withholds the digest vector (the applied-index tag may be pending).
+    No-op while the index is untracked."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(self, *args, **kwargs):
+        self._integrity_begin()
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            self._integrity_end()
+    return wrapped
+
+
 def _pad_batch(q: np.ndarray) -> np.ndarray:
     b = q.shape[0]
     bb = _next_pow2(max(1, b))
@@ -163,6 +183,65 @@ class _SlotStoreIndex(VectorIndex):
             metric=self.metric,
         )
 
+    # -- state-integrity ledger hooks (obs/integrity.py) -------------------
+    def _integrity_begin(self) -> None:
+        """Called BEFORE any device state mutates in a write path (the
+        integrity_mutation decorator): bumps the ledger's pending +
+        mutation counters so a scrub overlapping the device-written-but-
+        not-yet-folded window classifies as raced instead of phantom
+        corruption. No-op while untracked."""
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        INTEGRITY.note_mutation_begin(self)
+
+    def _integrity_end(self) -> None:
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        INTEGRITY.note_mutation_end(self)
+
+    def _integrity_write(self, ids: np.ndarray, vectors: np.ndarray) -> None:
+        """Fold a write batch into the region's incremental state digests:
+        'rows' always (canonical stored bytes — codes for sq8), 'blocked'
+        when the store maintains the dimension-blocked mirror. O(batch)
+        host hashing; zero device work; no-op while the index is
+        untracked (integrity.enabled off AND no ledger — an existing
+        ledger keeps folding through a flag toggle)."""
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        if len(ids) == 0 or not INTEGRITY.tracking(self):
+            return
+        stored = self.store.canonical_rows(vectors)
+        ids = np.asarray(ids, np.int64)
+        INTEGRITY.note_write(self, "rows", ids, stored)
+        if getattr(self.store, "vecs_blk", None) is not None:
+            # the blocked mirror holds the same values per slot (the
+            # transform is a per-row reshape), digested under its own tag
+            # so the scrub can tell WHICH copy rotted
+            INTEGRITY.note_write(self, "blocked", ids, stored)
+
+    def _integrity_delete(self, ids: np.ndarray) -> None:
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        INTEGRITY.note_delete(self, np.asarray(ids, np.int64))
+
+    def _integrity_on_restore(self, meta: dict) -> None:
+        """Recompute digests from the restored state and verify them
+        against the snapshot's persisted vector (raises
+        SnapshotCorruption; the manager falls back to an engine rebuild).
+
+        A precision-tier flip across the snapshot (fp32 <-> bf16 share
+        the f32-on-disk row format and legitimately load across tiers,
+        incl. legacy pre-tier snapshots with no precision key) re-casts
+        every stored byte, so digest comparison is undefined — the
+        ledger still rebuilds from the restored state, verification is
+        skipped, and the next scrub covers it from there."""
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        integ = meta.get("integrity")
+        if meta.get("precision") != self._precision:
+            integ = None
+        INTEGRITY.verify_restore(self, integ)
+
     def _count_search(self) -> None:
         from dingo_tpu.common.metrics import METRICS
 
@@ -209,6 +288,7 @@ class _SlotStoreIndex(VectorIndex):
             raise InvalidParameter(f"duplicate ids {dup[:5]} (use upsert)")
         self.upsert(ids, vectors)
 
+    @integrity_mutation
     def upsert(self, ids: np.ndarray, vectors: np.ndarray) -> None:
         vectors = self._prep_vectors(vectors)
         if len(ids) != len(vectors):
@@ -218,14 +298,17 @@ class _SlotStoreIndex(VectorIndex):
         # quality plane: quantized tiers keep an fp32 ground-truth mirror
         # fed the PRE-quantization rows (no-op while sampling is off)
         QUALITY.observe_write(self, np.asarray(ids, np.int64), vectors)
+        self._integrity_write(ids, vectors)
         self.write_count_since_save += len(ids)
 
+    @integrity_mutation
     def delete(self, ids: np.ndarray) -> None:
         ids = np.asarray(ids, np.int64)
         slots = self.store.remove_slots(ids)
         removed = int((slots >= 0).sum())
         self._invalidate_rerank(slots)
         QUALITY.observe_delete(self, ids)
+        self._integrity_delete(ids)
         self.write_count_since_save += removed
 
     # -- search ------------------------------------------------------------
@@ -427,7 +510,9 @@ class _SlotStoreIndex(VectorIndex):
         return self.store.memory_size()
 
     def _save_meta(self) -> dict:
-        return {
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        meta = {
             "index_type": self.index_type.value,
             "dimension": self.dimension,
             "metric": self.metric.value,
@@ -443,6 +528,14 @@ class _SlotStoreIndex(VectorIndex):
             ),
             "dim_block": int(getattr(self.store, "dim_block", 0) or 0),
         }
+        # state-integrity digest vector (obs/integrity.py): restore
+        # recomputes from the loaded state and refuses to serve a
+        # mismatch. Only persistable artifacts ride (the blocked mirror
+        # is rebuilt from conf at load; the live scrub covers it)
+        integ = INTEGRITY.snapshot_artifacts(self)
+        if integ:
+            meta["integrity"] = integ
+        return meta
 
     def _check_meta(self, meta: dict) -> None:
         if meta["dimension"] != self.dimension:
@@ -580,6 +673,7 @@ class TpuFlat(_SlotStoreIndex):
                            data["vectors"])
         self.apply_log_id = meta["apply_log_id"]
         self.write_count_since_save = 0
+        self._integrity_on_restore(meta)
 
 
 class BinaryPm1Mixin:
@@ -657,6 +751,7 @@ class TpuBinaryFlat(BinaryPm1Mixin, _SlotStoreIndex):
             )
         self.apply_log_id = meta["apply_log_id"]
         self.write_count_since_save = 0
+        self._integrity_on_restore(meta)
 
 
 class TpuBruteforce(VectorIndex):

@@ -55,7 +55,12 @@ from dingo_tpu.index.base import (
     resolve_precision,
     strip_invalid,
 )
-from dingo_tpu.index.flat import _new_tier_store, _SlotStoreIndex, _pad_batch
+from dingo_tpu.index.flat import (
+    _new_tier_store,
+    _SlotStoreIndex,
+    _pad_batch,
+    integrity_mutation,
+)
 from dingo_tpu.ops.distance import Metric, np_normalize
 
 _LIB = None
@@ -139,6 +144,7 @@ class TpuHnsw(_SlotStoreIndex):
         if self._precision == "sq8" and vectors is not None:
             self.store.maybe_train(self._prep_vectors(vectors))
 
+    @integrity_mutation
     def upsert(self, ids: np.ndarray, vectors: np.ndarray) -> None:
         vectors = self._prep_vectors(vectors)
         ids = np.ascontiguousarray(ids, np.int64)
@@ -151,6 +157,7 @@ class TpuHnsw(_SlotStoreIndex):
         # quality plane: quantized tiers mirror the pre-quantization rows
         # for shadow ground truth (no-op while sampling is off)
         QUALITY.observe_write(self, ids, vectors)
+        self._integrity_write(ids, vectors)
         _lib().hnsw_add(
             self._graph,
             len(ids),
@@ -159,6 +166,7 @@ class TpuHnsw(_SlotStoreIndex):
         )
         self.write_count_since_save += len(ids)
 
+    @integrity_mutation
     def delete(self, ids: np.ndarray) -> None:
         ids = np.ascontiguousarray(ids, np.int64)
         slots = self.store.remove_slots(ids)
@@ -167,6 +175,7 @@ class TpuHnsw(_SlotStoreIndex):
         from dingo_tpu.obs.quality import QUALITY
 
         QUALITY.observe_delete(self, ids)
+        self._integrity_delete(ids)
         _lib().hnsw_delete(
             self._graph, len(ids),
             ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
@@ -181,7 +190,19 @@ class TpuHnsw(_SlotStoreIndex):
         Caller holds store.device_lock. Nodes whose label has no live slot
         (store-deleted tombstones) are dropped — their slot may already
         serve a different vector, so they cannot route device-side; the
-        need_to_rebuild() trigger bounds how degraded the graph can get."""
+        need_to_rebuild() trigger bounds how degraded the graph can get.
+
+        Integrity-bracketed like a write path: the install swaps the
+        mirror AND rebuilds the adjacency ledger mid-flight — a scrub
+        overlapping it must classify as raced, not corruption."""
+        self._integrity_begin()
+        try:
+            self._install_adjacency_inner(labels, adj_nodes, entry_label)
+        finally:
+            self._integrity_end()
+
+    def _install_adjacency_inner(self, labels, adj_nodes,
+                                 entry_label: int) -> None:
         store = self.store
         deg = self._graph_deg
         full = np.full((store.capacity, deg), -1, np.int32)
@@ -206,6 +227,21 @@ class TpuHnsw(_SlotStoreIndex):
                 entry = int(live_slots[0])
         self._entry_slot = entry
         METRICS.gauge("hnsw.graph_nodes", region_id=self.id).set(float(n))
+        # state-integrity: the adjacency artifact resets with every mirror
+        # swap (a full install, not an incremental write). Neighbor slots
+        # translate to EXTERNAL ids so the digest survives slot
+        # renumbering across snapshot load — the same canonical form the
+        # scrub recomputes from the device mirror.
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        if INTEGRITY.tracking(self):
+            INTEGRITY.reset_artifact(self, "adjacency")
+            live_slots = np.flatnonzero(store.ids_by_slot >= 0)
+            if len(live_slots):
+                INTEGRITY.note_write(
+                    self, "adjacency", store.ids_by_slot[live_slots],
+                    store.ids_of_slots(full[live_slots]),
+                )
 
     def _export_level0(self):
         """(labels [n], adjacency [n, deg]) snapshot of the native level-0
@@ -247,6 +283,18 @@ class TpuHnsw(_SlotStoreIndex):
         )
         self._graph_key = want
         METRICS.counter("hnsw.adjacency_rebuilds", region_id=self.id).add(1)
+
+    def adjacency_in_sync(self) -> bool:
+        """True while the device adjacency mirror matches the native graph
+        AND the store (the scrub only checks the adjacency artifact then —
+        a pending lazy re-export is staleness, not corruption)."""
+        return (
+            self.store.adj is not None
+            and self._graph_key == (
+                int(_lib().hnsw_graph_version(self._graph)),
+                self.store.mutation_version,
+            )
+        )
 
     # -- filter-mask cache ---------------------------------------------------
     def _prep_filter(self, filter_spec: Optional[FilterSpec]):
@@ -673,3 +721,4 @@ class TpuHnsw(_SlotStoreIndex):
                 )
         self.apply_log_id = meta["apply_log_id"]
         self.write_count_since_save = 0
+        self._integrity_on_restore(meta)

@@ -62,7 +62,12 @@ from dingo_tpu.index.base import (
 )
 from dingo_tpu.common.config import FLAGS
 from dingo_tpu.common.metrics import METRICS
-from dingo_tpu.index.flat import BinaryPm1Mixin, _SlotStoreIndex, _pad_batch
+from dingo_tpu.index.flat import (
+    BinaryPm1Mixin,
+    _SlotStoreIndex,
+    _pad_batch,
+    integrity_mutation,
+)
 from dingo_tpu.index.ivf_layout import (
     MutableIvfView,
     expand_probes,
@@ -384,6 +389,35 @@ class IvfViewMaintenance:
             out.update(self._view.stats())
         return out
 
+    # -- state-integrity: bucket-assignment artifact -----------------------
+    def _integrity_assign(self, ids: np.ndarray, assign: np.ndarray) -> None:
+        """Fold a write batch's coarse-list assignments into the
+        'ivf_buckets' digest (the ledger tracks the assignment TRUTH; the
+        scrub reads the device view's arrangement back and compares)."""
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        if len(ids) == 0 or not INTEGRITY.tracking(self):
+            return
+        ids = np.asarray(ids, np.int64)
+        assign = np.asarray(assign, np.int32)
+        placed = assign >= 0
+        if placed.any():
+            INTEGRITY.note_write(self, "ivf_buckets", ids[placed],
+                                 assign[placed])
+
+    def _integrity_reset_assign(self) -> None:
+        """Rebuild the assignment digest from _assign_h (train/load paths
+        reassign every stored row at once)."""
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        if not INTEGRITY.tracking(self):
+            return
+        INTEGRITY.reset_artifact(self, "ivf_buckets")
+        live = np.flatnonzero(self.store.ids_by_slot >= 0)
+        if len(live):
+            assign = self._assign_h[live].astype(np.int32)
+            self._integrity_assign(self.store.ids_by_slot[live], assign)
+
     # -- filter-mask cache -------------------------------------------------
     def _prep_filter_mask(self, filter_spec: Optional[FilterSpec]):
         """Host-side filter work done OUTSIDE the device lock: fingerprint
@@ -521,6 +555,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         return queries
 
     # -- mutation: track assignments ---------------------------------------
+    @integrity_mutation
     def upsert(self, ids: np.ndarray, vectors: np.ndarray) -> None:
         vectors = self._prep_vectors(vectors)
         if len(ids) != len(vectors):
@@ -532,6 +567,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         # quality plane: quantized tiers mirror the pre-quantization rows
         # for shadow ground truth (no-op while sampling is off)
         QUALITY.observe_write(self, np.asarray(ids, np.int64), vectors)
+        self._integrity_write(ids, vectors)
         if self._assign_h.shape[0] < self.store.capacity:
             grown = np.full((self.store.capacity,), -1, np.int32)
             grown[: self._assign_h.shape[0]] = self._assign_h
@@ -539,6 +575,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         if self.is_trained():
             assign = np.asarray(kmeans_assign(jnp.asarray(vectors), self.centroids))
             self._assign_h[slots] = assign
+            self._integrity_assign(ids, assign)
             if self._view is not None and not self._view_dirty:
                 # incremental append-in-place; the next search reuses the
                 # maintained view instead of rebuilding from scratch
@@ -549,6 +586,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
             self._view_dirty = True
         self.write_count_since_save += len(ids)
 
+    @integrity_mutation
     def delete(self, ids: np.ndarray) -> None:
         ids = np.asarray(ids, np.int64)
         slots = self.store.remove_slots(ids)
@@ -557,6 +595,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         from dingo_tpu.obs.quality import QUALITY
 
         QUALITY.observe_delete(self, ids)
+        self._integrity_delete(ids)
         if removed:
             if self._view is not None and not self._view_dirty:
                 self._view_apply_delete(slots[slots >= 0])
@@ -571,6 +610,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
     def is_trained(self) -> bool:
         return self.centroids is not None
 
+    @integrity_mutation
     def train(self, vectors: Optional[np.ndarray] = None) -> None:
         """Train the coarse quantizer. With no explicit train set, samples
         the stored vectors (VectorIndexManager::TrainForBuild samples the
@@ -606,6 +646,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
             _, vecs = self.store.gather(self.store.ids_by_slot[live])
             assign = np.asarray(kmeans_assign(jnp.asarray(vecs), self.centroids))
             self._assign_h[live] = assign
+        self._integrity_reset_assign()
         self._invalidate_view()
 
     # -- bucketed view (IvfViewMaintenance data hooks) -----------------------
@@ -947,11 +988,12 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
                 np.asarray(data["codes"], np.uint8),
             ) if len(data["ids"]) else np.empty(0, np.int64)
         elif len(data["ids"]):
-            # bypass upsert's assignment (we restore it directly)
-            vecs = data["vectors"]
-            if self.metric is Metric.COSINE:
-                vecs = np.asarray(normalize(jnp.asarray(vecs)))
-            slots = self.store.put(np.asarray(data["ids"], np.int64), vecs)
+            # bypass upsert's assignment (we restore it directly). Rows on
+            # disk came from the store, so cosine rows are ALREADY
+            # normalized — re-normalizing drifts low-order bits and would
+            # break the snapshot's bit-exact restore-digest verification
+            slots = self.store.put(np.asarray(data["ids"], np.int64),
+                                   data["vectors"])
         else:
             slots = np.empty(0, np.int64)
         if self._assign_h.shape[0] < self.store.capacity:
@@ -967,6 +1009,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         self._view_dirty = True
         self._filter_cache.clear()
         self.write_count_since_save = 0
+        self._integrity_on_restore(meta)
 
 
 class TpuBinaryIvfFlat(BinaryPm1Mixin, TpuIvfFlat):
@@ -1066,3 +1109,4 @@ class TpuBinaryIvfFlat(BinaryPm1Mixin, TpuIvfFlat):
         self._view_dirty = True
         self._filter_cache.clear()
         self.write_count_since_save = 0
+        self._integrity_on_restore(meta)

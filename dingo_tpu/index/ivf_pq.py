@@ -42,7 +42,12 @@ from dingo_tpu.index.base import (
     VectorIndex,
     strip_invalid,
 )
-from dingo_tpu.index.flat import _SlotStoreIndex, _flat_search_kernel, _pad_batch
+from dingo_tpu.index.flat import (
+    _SlotStoreIndex,
+    _flat_search_kernel,
+    _pad_batch,
+    integrity_mutation,
+)
 from dingo_tpu.index.ivf_flat import IvfViewMaintenance, _probe_lists
 from dingo_tpu.index.ivf_layout import MutableIvfView, expand_probes_ranked
 from dingo_tpu.index.slot_store import HostSlotStore, SlotStore, _next_pow2
@@ -319,7 +324,12 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
             raise InvalidParameter(
                 f"vector dim {vectors.shape} != {self.dimension}"
             )
-        if self.metric is Metric.COSINE:
+        if self.metric is Metric.COSINE and not getattr(
+                self, "_rows_prenormalized", False):
+            # load() re-ingests rows the store already normalized once;
+            # normalizing again drifts low-order bits (||x|| lands NEAR 1,
+            # not exactly) and would break the snapshot's bit-exact
+            # restore-digest verification
             vectors = np.asarray(normalize(jnp.asarray(vectors)))
         return vectors
 
@@ -348,6 +358,7 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
                 [self._codes, jnp.zeros((pad, self.m), jnp.uint8)]
             )
 
+    @integrity_mutation
     def upsert(self, ids: np.ndarray, vectors: np.ndarray) -> None:
         vectors = self._prep_vectors(vectors)
         if len(ids) != len(vectors):
@@ -359,6 +370,7 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
         # quality plane: the fp32 store/host rows ARE the shadow ground
         # truth for IVF_PQ, so this only syncs mirror-mode oracles
         QUALITY.observe_write(self, np.asarray(ids, np.int64), vectors)
+        self._integrity_write(ids, vectors)
         if self.is_trained():
             dv = jnp.asarray(vectors)
             assign = kmeans_assign(dv, self.centroids)
@@ -366,6 +378,8 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
             assign_h = np.asarray(assign)
             self._assign_h[slots] = assign_h
             self._codes = self._codes.at[jnp.asarray(slots, jnp.int32)].set(codes)
+            self._integrity_assign(ids, assign_h)
+            self._integrity_codes(ids, codes)
             if self._view is not None and not self._view_dirty:
                 # incremental: scatter the fresh codes into the bucketed
                 # view instead of invalidating it (rows = device codes)
@@ -376,6 +390,7 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
             self._view_dirty = True
         self.write_count_since_save += len(ids)
 
+    @integrity_mutation
     def delete(self, ids: np.ndarray) -> None:
         ids = np.asarray(ids, np.int64)
         slots = self.store.remove_slots(ids)
@@ -383,6 +398,7 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
         from dingo_tpu.obs.quality import QUALITY
 
         QUALITY.observe_delete(self, ids)
+        self._integrity_delete(ids)
         if removed:
             if self._view is not None and not self._view_dirty:
                 self._view_apply_delete(slots[slots >= 0])
@@ -409,7 +425,11 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
                 np.float32,
             )
 
+    @integrity_mutation
     def train(self, vectors: Optional[np.ndarray] = None) -> None:
+        # re-encodes every stored row into _codes chunk by chunk — a
+        # scrub overlapping that must classify as raced, not corruption
+        # (the decorator's bracket covers the whole method)
         cap = MAX_POINTS_PER_CENTROID * self.nlist
         rng = np.random.default_rng(self.id)
         if vectors is None:
@@ -452,7 +472,34 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
             codes = _encode_residual(dvv, a, self.centroids, self.codebooks)
             self._assign_h[sl] = np.asarray(a)
             self._codes = self._codes.at[jnp.asarray(sl, jnp.int32)].set(codes)
+        # training reassigned + re-encoded every row: rebuild both digests
+        self._integrity_reset_assign()
+        self._integrity_reset_codes()
         self._invalidate_view()
+
+    # -- state-integrity: PQ code artifact -----------------------------------
+    def _integrity_codes(self, ids: np.ndarray, codes) -> None:
+        """Fold freshly-encoded device codes into the 'pq_codes' digest
+        (one bounded D2H of the batch's codes; off the search path and
+        gated on integrity.enabled)."""
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        if len(ids) == 0 or not INTEGRITY.tracking(self):
+            return
+        INTEGRITY.note_write(self, "pq_codes", np.asarray(ids, np.int64),
+                             np.asarray(codes, np.uint8))
+
+    def _integrity_reset_codes(self) -> None:
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        if self._codes is None or not INTEGRITY.tracking(self):
+            return
+        INTEGRITY.reset_artifact(self, "pq_codes")
+        live = np.flatnonzero(self.store.ids_by_slot >= 0)
+        if len(live):
+            codes_h = np.asarray(self._codes)
+            self._integrity_codes(self.store.ids_by_slot[live],
+                                  codes_h[live])
 
     # -- bucketed view (IvfViewMaintenance data hooks) -----------------------
     def _materialize_view_data(self, view: MutableIvfView) -> None:
@@ -719,7 +766,14 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
         self._view_dirty = True
         self._filter_cache.clear()
         if len(data["ids"]):
-            self.upsert(data["ids"], data["vectors"])
+            # rows on disk are already store-normalized (cosine): skip the
+            # re-normalize so the restored bytes match the saved digests
+            self._rows_prenormalized = True
+            try:
+                self.upsert(data["ids"], data["vectors"])
+            finally:
+                self._rows_prenormalized = False
         self.apply_log_id = meta["apply_log_id"]
         self._view_dirty = True
         self.write_count_since_save = 0
+        self._integrity_on_restore(meta)

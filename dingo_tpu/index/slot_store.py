@@ -225,6 +225,14 @@ class SlotStore:
             self._dmask = jnp.asarray(self.valid_h)
         return self._dmask
 
+    def canonical_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Stored-form payload of prepped input rows — the exact bytes the
+        device arrays hold after a put() of `rows` (the state-integrity
+        ledger digests these so an incremental digest and a device-state
+        readback agree bit-for-bit). Float stores cast to the storage
+        dtype; SqSlotStore overrides to encode."""
+        return np.asarray(rows).astype(np.dtype(self.dtype), copy=False)
+
     def memory_size(self) -> int:
         itemsize = jnp.zeros((), self.dtype).dtype.itemsize
         size = self.capacity * (self.dim * itemsize + 8 + 4 + 1)
@@ -561,6 +569,9 @@ class SqSlotStore(SlotStore):
         self.sq_params = None            # ops.sq.SqParams (host)
         self._sq_vmin_d = None           # lazy device copies
         self._sq_scale_d = None
+        #: (id(float rows), n, codes) of the latest put() — canonical_rows
+        #: reuses it so the integrity ledger never re-encodes the batch
+        self._canonical_memo = None
 
     # -- codec lifecycle ---------------------------------------------------
     def set_params(self, params) -> None:
@@ -604,13 +615,34 @@ class SqSlotStore(SlotStore):
     # -- float-facing mutation/read paths ----------------------------------
     def put(self, ids: np.ndarray, vectors: np.ndarray) -> np.ndarray:
         self.maybe_train(vectors)
-        return super().put(ids, self.encode(np.asarray(vectors, np.float32)))
+        codes = self.encode(np.asarray(vectors, np.float32))
+        # memo for canonical_rows: the integrity ledger digests the SAME
+        # batch right after put() with the SAME float array object —
+        # re-encoding it would double the write path's quantization cost
+        # for bytes that are identical by construction
+        self._canonical_memo = (id(vectors), len(codes), codes)
+        return super().put(ids, codes)
 
     def put_codes(self, ids: np.ndarray, codes: np.ndarray) -> np.ndarray:
         """Raw-code ingest (snapshot load): bypasses encode so a saved
         code array round-trips bit-exactly."""
         assert self.sq_params is not None, "set_params before put_codes"
         return super().put(ids, np.asarray(codes, np.uint8))
+
+    def canonical_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Stored payload = the CODES (what the device actually holds and
+        the scan kernels decode); the integrity ledger's 'rows' artifact
+        for an sq8 store therefore digests codes — a single flipped code
+        byte is a rows-artifact mismatch. Reuses the codes the
+        immediately-preceding put() of the SAME array object produced
+        (memo consumed on use; put() always refreshes it first, so a
+        recycled object id can never pair with stale codes)."""
+        memo = getattr(self, "_canonical_memo", None)
+        if memo is not None and memo[0] == id(rows) \
+                and memo[1] == len(rows):
+            self._canonical_memo = None
+            return memo[2]
+        return self.encode(np.asarray(rows, np.float32))
 
     def _blocked_dtype_ok(self) -> bool:
         # codes mirror blocks fine: the pruned kernel decodes per tile

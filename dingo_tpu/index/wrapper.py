@@ -118,20 +118,59 @@ class VectorIndexWrapper:
                 return
             if log_id != 0 and log_id <= self.apply_log_id:
                 return  # already materialized (snapshot load or replay)
-            if is_upsert:
-                idx.upsert(ids, vectors)
-            else:
-                idx.add(ids, vectors)
-            # post-merge: purge absorbed-range versions from the sibling so
-            # search's sibling merge can't resurrect stale vectors
-            sib = self.sibling_index.active() if self.sibling_index else None
-            if sib is not None and sib is not idx:
-                sib.delete(ids)
-            if log_id:
-                self.apply_log_id = log_id
-                if idx is self.own_index:
-                    idx.apply_log_id = log_id
+            with self._integrity_bracket(idx):
+                if is_upsert:
+                    idx.upsert(ids, vectors)
+                else:
+                    idx.add(ids, vectors)
+                # post-merge: purge absorbed-range versions from the
+                # sibling so search's sibling merge can't resurrect stale
+                # vectors
+                sib = (self.sibling_index.active()
+                       if self.sibling_index else None)
+                if sib is not None and sib is not idx:
+                    sib.delete(ids)
+                if log_id:
+                    self.apply_log_id = log_id
+                    if idx is self.own_index:
+                        idx.apply_log_id = log_id
+                        self._tag_integrity(idx, log_id)
             self.write_count += len(ids)
+
+    def _integrity_bracket(self, idx):
+        """Pending-write bracket spanning the index mutation AND its
+        applied-index tag: between the ledger fold (inside idx.upsert)
+        and tag_applied the (digest, applied) pair is torn, and a
+        heartbeat collected in that window would read a healthy replica
+        as DIVERGED — while the bracket is open the ledger withholds its
+        digest vector instead (obs/integrity.py heartbeat_view)."""
+        import contextlib
+
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        if idx is not self.own_index:
+            return contextlib.nullcontext()
+
+        @contextlib.contextmanager
+        def bracket():
+            INTEGRITY.note_mutation_begin(idx)
+            try:
+                yield
+            finally:
+                INTEGRITY.note_mutation_end(idx)
+
+        return bracket()
+
+    @staticmethod
+    def _tag_integrity(idx, log_id: int) -> None:
+        """Stamp the state-integrity ledger with the raft applied index
+        this write advanced to — still inside the wrapper lock AND the
+        pending bracket, so the (digest, applied_index) pair a heartbeat
+        reads is always consistent and the coordinator can compare
+        replicas at EQUAL applied indices."""
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        INTEGRITY.tag_applied(idx, log_id)
 
     def delete(self, ids: np.ndarray, log_id: int) -> None:
         with self._lock:
@@ -142,14 +181,17 @@ class VectorIndexWrapper:
                 return
             if log_id != 0 and log_id <= self.apply_log_id:
                 return
-            idx.delete(ids)
-            sib = self.sibling_index.active() if self.sibling_index else None
-            if sib is not None and sib is not idx:
-                sib.delete(ids)
-            if log_id:
-                self.apply_log_id = log_id
-                if idx is self.own_index:
-                    idx.apply_log_id = log_id
+            with self._integrity_bracket(idx):
+                idx.delete(ids)
+                sib = (self.sibling_index.active()
+                       if self.sibling_index else None)
+                if sib is not None and sib is not idx:
+                    sib.delete(ids)
+                if log_id:
+                    self.apply_log_id = log_id
+                    if idx is self.own_index:
+                        idx.apply_log_id = log_id
+                        self._tag_integrity(idx, log_id)
             self.write_count += len(ids)
 
     # -- reads ---------------------------------------------------------------

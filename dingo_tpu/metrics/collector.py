@@ -186,6 +186,22 @@ class StoreMetricsCollector:
         rm.qos_shed_total = int(qs["shed_total"])
         rm.qos_degrade_level = int(self.registry.gauge(
             "qos.degrade_level", region.id).get())
+        # state-integrity digest vector (obs/integrity.py), tagged with
+        # the raft applied index it corresponds to — the coordinator
+        # compares replicas at equal applied indices
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        own = wrapper.own_index if wrapper is not None else None
+        applied, digests, mismatch = INTEGRITY.region_report(
+            own, region_id=region.id
+        )
+        rm.integrity_applied_index = applied
+        rm.integrity_digests = digests
+        rm.integrity_mismatch = mismatch
+        last = INTEGRITY.last_verified_ms(region.id)
+        self.registry.gauge(
+            "consistency.digest_age_s", region.id
+        ).set((time.time() * 1000 - last) / 1000.0 if last else -1.0)
         return rm
 
     def _approximate_bytes(self, start: bytes, end, key_count: int) -> int:
@@ -217,9 +233,11 @@ class StoreMetricsCollector:
             self.registry.drop_region(rid)
             HBM.forget_region(rid)
             QUALITY.forget_region(rid)
+            from dingo_tpu.obs.integrity import INTEGRITY
             from dingo_tpu.obs.pressure import PRESSURE
 
             PRESSURE.forget_region(rid)
+            INTEGRITY.forget_region(rid)
         self._published_regions = current
         g = self.registry.gauge
         g("store.device.bytes_in_use").set(snap.device_bytes_in_use)

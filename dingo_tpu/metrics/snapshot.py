@@ -55,6 +55,14 @@ class RegionMetricsSnapshot:
     qos_queue_wait_ms: float = 0.0
     qos_shed_total: int = 0
     qos_degrade_level: int = 0
+    #: state-integrity plane (obs/integrity.py): the raft applied index
+    #: the digest vector corresponds to, the compact JSON
+    #: {artifact: digest} vector ("" = plane off / unprimed), and the
+    #: store-local scrub verdict. The coordinator compares replicas'
+    #: digests at EQUAL applied indices and flags divergence
+    integrity_applied_index: int = 0
+    integrity_digests: str = ""
+    integrity_mismatch: bool = False
 
 
 @persist.register

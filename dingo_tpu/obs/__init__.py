@@ -29,6 +29,11 @@ observability"):
 
 from dingo_tpu.obs.flight import FLIGHT, FlightRecorder  # noqa: F401
 from dingo_tpu.obs.hbm import HBM, HbmLedger, looks_like_oom  # noqa: F401
+from dingo_tpu.obs.integrity import (  # noqa: F401
+    INTEGRITY,
+    IntegrityPlane,
+    IntegrityScrubRunner,
+)
 from dingo_tpu.obs.pressure import (  # noqa: F401
     PRESSURE,
     Budget,
@@ -55,6 +60,9 @@ __all__ = [
     "FlightRecorder",
     "HBM",
     "HbmLedger",
+    "INTEGRITY",
+    "IntegrityPlane",
+    "IntegrityScrubRunner",
     "PRESSURE",
     "PressurePlane",
     "QUALITY",

@@ -134,6 +134,18 @@ class FlightRecorder:
         admission already given up on?)."""
         return {k: v for k, v in now_flat.items() if k.startswith(prefix)}
 
+    @staticmethod
+    def _integrity_state() -> Dict[str, Any]:
+        """Per-region digest vectors + scrub verdicts at capture time
+        (obs/integrity.py): a divergence/corruption bundle must carry the
+        actual digest vectors of both sides, not only the counters."""
+        try:
+            from dingo_tpu.obs.integrity import INTEGRITY
+
+            return INTEGRITY.state()
+        except Exception:  # noqa: BLE001 — black box must never raise
+            return {}
+
     # ---- triggers ----------------------------------------------------------
     def on_slow_query(self, rec: Dict[str, Any]) -> str:
         """Tracer hook: `rec` is the slow-log record (sampled span or the
@@ -279,6 +291,8 @@ class FlightRecorder:
             "hnsw": self._family_state(now_flat, "hnsw."),
             "quality": self._family_state(now_flat, "quality."),
             "qos": self._family_state(now_flat, "qos."),
+            "consistency": self._family_state(now_flat, "consistency."),
+            "integrity": self._integrity_state(),
             "config": config,
         }
         blob = zlib.compress(

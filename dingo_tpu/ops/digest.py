@@ -1,0 +1,195 @@
+"""Order-invariant incremental set digests for device-state integrity.
+
+The state-integrity plane (obs/integrity.py) needs to answer "do two
+replicas of a region — or a snapshot and its restore, or the incremental
+ledger and the actual device arrays — hold the same data" without ever
+hashing the whole index on the hot path. The construction here makes the
+digest *maintainable*: a write batch folds in O(batch) host work, and the
+digest of the full set is always available in O(1).
+
+Per-row fingerprint (uint64):
+
+    proj  = sum_i bytes[i] * coeff[i]    (mod 2^64; coeff = fixed seeded
+                                          odd uint64 stream)
+    fp    = splitmix64(proj ^ splitmix64(id) ^ tag_seed)
+
+- coeff[i] is ODD, so a single flipped byte (delta in [-255, 255], != 0)
+  always changes proj — no power of two <= 2^8 divides 2^64/coeff[i].
+- the id mixes NONLINEARLY (through splitmix64), so swapping two rows'
+  payloads changes both fingerprints: a linear id term would cancel in
+  the aggregate sum.
+- tag_seed separates artifacts: the same bytes digested as "rows" and as
+  "blocked" produce unrelated fingerprints.
+
+Aggregate (SetDigest): component-wise modular sums of (fp,
+splitmix64(fp ^ LANE2)) plus the element count. Sums are add/remove-
+homomorphic — put adds a term, tombstone subtracts it — and order-
+invariant, so replicas that applied the same writes in different slot
+orders agree, and an incrementally-maintained ledger can be checked
+against a from-scratch recompute (the corruption scrub).
+
+Collision notes: this is an integrity check against silent corruption
+and bookkeeping bugs, not an adversarial MAC. A single-element change is
+ALWAYS detected (the per-fp guarantees above); multi-element collisions
+require two independent 64-bit lanes to cancel simultaneously.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Optional
+
+import numpy as np
+
+_U64 = np.uint64
+_GOLDEN = _U64(0x9E3779B97F4A7C15)
+_MIX1 = _U64(0xBF58476D1CE4E5B9)
+_MIX2 = _U64(0x94D049BB133111EB)
+_LANE2 = _U64(0xD6E8FEB86659FD93)
+
+#: projection coefficients are generated lazily per payload width and
+#: cached (a fixed seed, so every process derives the same stream)
+_COEFF_SEED = 0xD1E657
+_coeff_cache: Dict[int, np.ndarray] = {}
+
+
+def _coeffs(nbytes: int) -> np.ndarray:
+    """[nbytes] uint64 odd projection coefficients (fixed seeded stream)."""
+    have = _coeff_cache.get(0)
+    if have is None or len(have) < nbytes:
+        n = max(4096, 1 << int(nbytes - 1).bit_length() if nbytes else 4096)
+        rng = np.random.default_rng(_COEFF_SEED)
+        have = rng.integers(0, 1 << 63, size=n, dtype=np.uint64)
+        have = (have << _U64(1)) | _U64(1)   # force odd
+        _coeff_cache[0] = have
+    return have[:nbytes]
+
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over uint64 (wraps mod 2^64)."""
+    z = x.astype(np.uint64, copy=True)
+    z += _GOLDEN
+    z = (z ^ (z >> _U64(30))) * _MIX1
+    z = (z ^ (z >> _U64(27))) * _MIX2
+    return z ^ (z >> _U64(31))
+
+
+def tag_seed(tag: str) -> np.uint64:
+    """Stable per-artifact domain-separation seed."""
+    h = hashlib.blake2b(tag.encode("utf-8"), digest_size=8).digest()
+    return _U64(int.from_bytes(h, "little"))
+
+
+def _payload_bytes(payload: np.ndarray) -> np.ndarray:
+    """[n, ...] fixed-width payload -> [n, L] uint8 canonical bytes."""
+    arr = np.ascontiguousarray(payload)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    elif arr.ndim > 2:
+        arr = arr.reshape(arr.shape[0], -1)
+    return arr.view(np.uint8).reshape(arr.shape[0], -1)
+
+
+def row_fingerprints(tag: str, ids: np.ndarray,
+                     payload: np.ndarray) -> np.ndarray:
+    """[n] uint64 fingerprints binding (id, payload row) under `tag`.
+
+    `payload` is any fixed-width array [n, ...]; rows are digested over
+    their canonical C-order bytes, so the same VALUES in the same dtype
+    always fingerprint identically regardless of the device layout they
+    were read back from."""
+    ids = np.asarray(ids)
+    if len(ids) == 0:
+        return np.empty(0, np.uint64)
+    raw = _payload_bytes(payload)
+    if len(raw) != len(ids):
+        raise ValueError(f"ids/payload length mismatch "
+                         f"({len(ids)} vs {len(raw)})")
+    proj = _project(raw)
+    h_id = splitmix64(ids.astype(np.int64).view(np.uint64))
+    return splitmix64(proj ^ h_id ^ tag_seed(tag))
+
+
+def _project(raw: np.ndarray) -> np.ndarray:
+    """[n, L] uint8 -> [n] uint64 coefficient projection, accumulated
+    over column blocks so the uint64 widening temporary stays a few MB
+    instead of 8x the whole payload (a 64K-slot scrub chunk at d=512
+    would otherwise allocate ~2 GB transiently on the serving host)."""
+    n, L = raw.shape
+    coeff = _coeffs(L)
+    # bound the widened temporary to ~32 MB: block_cols * n * 8 bytes
+    block = max(16, (1 << 22) // max(1, n))
+    proj = np.zeros(n, np.uint64)
+    for j in range(0, L, block):
+        blk = raw[:, j:j + block].astype(np.uint64)
+        proj += (blk * coeff[j:j + block][None, :]).sum(
+            axis=1, dtype=np.uint64
+        )
+    return proj
+
+
+class SetDigest:
+    """Order-invariant multiset digest: element count + two modular-sum
+    lanes over row fingerprints. add/remove are exact inverses."""
+
+    __slots__ = ("count", "s0", "s1")
+
+    def __init__(self, count: int = 0,
+                 s0: np.uint64 = _U64(0), s1: np.uint64 = _U64(0)):
+        self.count = int(count)
+        self.s0 = _U64(s0)
+        self.s1 = _U64(s1)
+
+    def add(self, fps: np.ndarray) -> None:
+        self._fold(fps, +1)
+
+    def remove(self, fps: np.ndarray) -> None:
+        self._fold(fps, -1)
+
+    def _fold(self, fps: np.ndarray, sign: int) -> None:
+        """Modular sums in Python ints — numpy warns on SCALAR uint64
+        wraparound even though wraparound is exactly the semantics here."""
+        if len(fps):
+            mask = (1 << 64) - 1
+            self.count += sign * len(fps)
+            self.s0 = _U64(
+                (int(self.s0) + sign * int(fps.sum(dtype=np.uint64)))
+                & mask
+            )
+            lane2 = int(splitmix64(fps ^ _LANE2).sum(dtype=np.uint64))
+            self.s1 = _U64((int(self.s1) + sign * lane2) & mask)
+
+    @classmethod
+    def of(cls, fps: np.ndarray) -> "SetDigest":
+        d = cls()
+        d.add(np.asarray(fps, np.uint64))
+        return d
+
+    def copy(self) -> "SetDigest":
+        return SetDigest(self.count, self.s0, self.s1)
+
+    def hex(self) -> str:
+        """Stable wire form `count-s0-s1` (rides heartbeats / meta.json)."""
+        return f"{self.count:x}-{int(self.s0):016x}-{int(self.s1):016x}"
+
+    @classmethod
+    def from_hex(cls, text: str) -> Optional["SetDigest"]:
+        try:
+            c, s0, s1 = text.split("-")
+            return cls(int(c, 16), _U64(int(s0, 16)), _U64(int(s1, 16)))
+        except (ValueError, AttributeError):
+            return None
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, SetDigest)
+            and self.count == other.count
+            and self.s0 == other.s0
+            and self.s1 == other.s1
+        )
+
+    def __hash__(self):  # noqa: D105 — dict/set member in tests
+        return hash((self.count, int(self.s0), int(self.s1)))
+
+    def __repr__(self):  # noqa: D105
+        return f"SetDigest({self.hex()})"

@@ -82,6 +82,27 @@ def _default_member_builder(index_id: int, parameter: IndexParameter,
     )
 
 
+#: full resident-id-set digest comparison runs every Nth write fan-out
+#: (the O(1) count comparison runs on EVERY fan-out and forces a full
+#: check on disagreement) — bounds the O(live ids) scan off the per-write
+#: path at scale while keeping detection latency a handful of batches
+REPLICA_CHECK_EVERY = 16
+
+
+def _member_live_ids(member) -> Optional[np.ndarray]:
+    """Resident external ids of one replica member (mesh-sharded indexes
+    keep ids_by_gslot; slot-store indexes keep store.ids_by_slot); None
+    for members with no inspectable id surface."""
+    ids = getattr(member, "ids_by_gslot", None)
+    if ids is None:
+        store = getattr(member, "store", None)
+        ids = getattr(store, "ids_by_slot", None)
+    if ids is None:
+        return None
+    ids = np.asarray(ids, np.int64)
+    return ids[ids >= 0]
+
+
 class ReplicaGroup(VectorIndex):
     """R replicas of one region's index; reads route, writes fan out."""
 
@@ -110,6 +131,7 @@ class ReplicaGroup(VectorIndex):
         self._rr = 0
         self._inflight = [0] * replicas
         self._lock = threading.Lock()
+        self._writes_since_check = 0
         from dingo_tpu.common.metrics import METRICS
 
         METRICS.gauge("mesh.replicas", region_id=index_id).set(
@@ -194,13 +216,71 @@ class ReplicaGroup(VectorIndex):
     def add(self, ids, vectors) -> None:
         for m in self.members:
             m.add(ids, vectors)
+        self.verify_fanout()
 
     def upsert(self, ids, vectors) -> None:
         for m in self.members:
             m.upsert(ids, vectors)
+        self.verify_fanout()
 
     def delete(self, ids):
-        return [m.delete(ids) for m in self.members][0]
+        out = [m.delete(ids) for m in self.members][0]
+        self.verify_fanout()
+        return out
+
+    # -- post-fanout bit-identity monitor (state-integrity plane) ------------
+    def verify_fanout(self, force: bool = False) -> bool:
+        """The write fan-out's replicas-stay-identical claim, MONITORED:
+        compare member counts after every fan-out (O(1)) and the full
+        resident-id-set digests every REPLICA_CHECK_EVERY batches (or on
+        any count disagreement / force). A mismatch raises
+        consistency.replica_mismatch and captures a flight bundle with
+        every member's digest — a member that dropped a write (partial
+        failure, a donation bug) surfaces within a handful of batches
+        instead of as silently route-dependent results."""
+        from dingo_tpu.obs.integrity import INTEGRITY
+
+        if len(self.members) < 2 or not INTEGRITY.enabled():
+            return True
+        counts = [m.get_count() for m in self.members]
+        count_mismatch = len(set(counts)) > 1
+        with self._lock:
+            self._writes_since_check += 1
+            due = (force or count_mismatch
+                   or self._writes_since_check >= REPLICA_CHECK_EVERY)
+            if due:
+                self._writes_since_check = 0
+        if not due:
+            return True
+        from dingo_tpu.ops.digest import SetDigest, row_fingerprints
+
+        digs = []
+        for m in self.members:
+            ids = _member_live_ids(m)
+            if ids is None:
+                return True       # opaque member: nothing comparable
+            digs.append(
+                SetDigest.of(
+                    row_fingerprints("replica_ids", ids, ids)
+                ).hex()
+            )
+        if len(set(digs)) <= 1 and not count_mismatch:
+            return True
+        from dingo_tpu.common.metrics import METRICS
+
+        METRICS.counter(
+            "consistency.replica_mismatch", region_id=self.id
+        ).add(1)
+        from dingo_tpu.obs.flight import FLIGHT
+
+        FLIGHT.trigger(
+            "divergence",
+            name=f"replica_group_{self.id}",
+            region_id=self.id,
+            extra={"counts": counts,
+                   "digests": {str(r): d for r, d in enumerate(digs)}},
+        )
+        return False
 
     def need_train(self) -> bool:
         return self.members[0].need_train()

@@ -259,6 +259,9 @@ _REGION_METRIC_FIELDS = (
     # queue-wait watermark / cumulative shed+expired / degrade level
     "qos_queue_depth", "qos_queue_wait_ms", "qos_shed_total",
     "qos_degrade_level",
+    # state-integrity plane (obs/integrity.py): applied-index-tagged
+    # per-artifact digest vector + store-local scrub verdict
+    "integrity_applied_index", "integrity_digests", "integrity_mismatch",
 )
 
 _STORE_METRIC_FIELDS = (

@@ -292,6 +292,19 @@ def serve_store(args) -> None:
         float(FLAGS.get("qos_shed_interval_s")),
         ShedController(node, crontab=crontab).tick,
     )
+    # state-integrity corruption scrub (obs/integrity.py): recompute full
+    # per-artifact digests from device state (chunked under the store
+    # device lock) and check them against the incremental write-path
+    # ledger. Hot-gated on integrity.enabled per tick; runs on its own
+    # worker (the scrub_vector_index pattern) so a long chunked pass
+    # never stalls the shared crontab thread
+    from dingo_tpu.obs import IntegrityScrubRunner
+
+    crontab.add(
+        "consistency_scrub",
+        float(FLAGS.get("integrity_scrub_interval_s")),
+        IntegrityScrubRunner(node, crontab=crontab).tick,
+    )
     # device-runtime observability: process HBM watermark poll (per-region
     # owner ledgers refresh with each store_metrics pass) + region/index
     # config snapshots for flight-recorder bundles

@@ -1958,6 +1958,7 @@ class ClusterStatService:
             entry.last_update_ms = at_ms
             entry.stale = stale
             convert.store_metrics_to_pb(snap, entry.metrics)
+        resp.diverged_region_ids.extend(self.control.diverged_regions())
         return resp
 
     def GetRegionMetrics(self, req: pb.GetRegionMetricsRequest):
@@ -1969,6 +1970,7 @@ class ClusterStatService:
             entry.store_id = sid
             entry.stale = stale
             convert.region_metrics_to_pb(rm, entry.metrics)
+        resp.diverged_region_ids.extend(self.control.diverged_regions())
         return resp
 
 

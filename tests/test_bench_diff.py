@@ -195,3 +195,32 @@ def test_overload_goodput_drop_is_a_regression(tmp_path):
     result = bench_diff.compare(old, new)
     assert [r["path"] for r in result["regressions"]] == [
         "overload.qos_on.goodput_qps"]
+
+
+def test_integrity_overhead_classification():
+    """ISSUE 11: the integrity_scrub scenario's p99_overhead_pct is an
+    instrumentation-cost figure — a percentage compared in absolute
+    points, not a latency magnitude; the raw per-arm p99_ms stays
+    unclassified (CPU latency noise must not gate rounds)."""
+    assert bench_diff.classify(
+        "integrity_scrub.p99_overhead_pct") == "overhead"
+    assert bench_diff.classify(
+        "integrity_scrub.steady_state_recompiles_on") == "recompiles"
+    assert bench_diff.classify("integrity_scrub.p99_ms_on") is None
+    assert bench_diff.classify("integrity_scrub.p99_ms_off") is None
+    assert bench_diff.classify("integrity_scrub.scrub_passes") is None
+
+
+def test_integrity_overhead_growth_is_a_regression():
+    old = {"integrity_scrub": {
+        "p99_overhead_pct": 1.5, "p99_ms_on": 10.0, "p99_ms_off": 9.9,
+        "steady_state_recompiles_on": 0,
+    }}
+    new = copy.deepcopy(old)
+    new["integrity_scrub"]["p99_overhead_pct"] = 3.0   # +1.5pt: in band
+    result = bench_diff.compare(old, new)
+    assert result["regressions"] == []
+    new["integrity_scrub"]["p99_overhead_pct"] = 9.0   # +7.5pt: regression
+    result = bench_diff.compare(old, new)
+    assert [r["path"] for r in result["regressions"]] == [
+        "integrity_scrub.p99_overhead_pct"]

@@ -101,6 +101,23 @@ def test_checker_curates_qos_family(tmp_path):
     assert "qos" in problems[0][1]
 
 
+def test_checker_curates_consistency_family(tmp_path):
+    """The state-integrity plane's consistency.* series are curated:
+    declared names pass, additions must be explicit in FAMILY_NAMES."""
+    f = tmp_path / "consist.py"
+    f.write_text(
+        "from dingo_tpu.common.metrics import METRICS\n"
+        "METRICS.counter('consistency.scrub_runs').add(1)\n"       # declared
+        "METRICS.counter('consistency.divergence').add(1)\n"       # declared
+        "METRICS.gauge('consistency.digest_age_s').set(3)\n"       # declared
+        "METRICS.latency('consistency.scrub_ms')\n"                # declared
+        "METRICS.counter('consistency.rogue_series').add(1)\n"     # undeclared
+    )
+    problems = checker.check_file(str(f))
+    assert [p[0] for p in problems] == [6], problems
+    assert "consistency" in problems[0][1]
+
+
 def test_registry_name_rule_matches_lint():
     from dingo_tpu.common.metrics import valid_metric_name
 

@@ -177,7 +177,21 @@ def test_metrics_flow_two_store_cluster(two_store_cluster):
         np.random.default_rng(0).standard_normal((n_vec, 8))
         .astype(np.float32),
     )
-    force_fresh_beats(nodes)
+    # propose() only blocks until the LEADER applied; the follower applies
+    # asynchronously — wait for both replicas to converge before snapshot
+    # assertions (the race only lost when warm earlier tests made the
+    # beat path fast enough to collect before the follower's apply)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        force_fresh_beats(nodes)
+        rows = coord.get_store_metrics()
+        if len(rows) == 2 and all(
+            any(r.region_id == rid and r.key_count == n_vec
+                for r in snap.regions)
+            for _sid, snap, _at, _stale in rows
+        ):
+            break
+        time.sleep(0.05)
 
     # --- coordinator holds both stores' snapshots, fresh
     rows = coord.get_store_metrics()

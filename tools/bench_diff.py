@@ -86,6 +86,11 @@ def classify(path: str, summary: Optional[dict] = None) -> Optional[str]:
         return "qps"
     if low == "qps" or low.endswith("_qps") or low.startswith("qps_"):
         return "qps"
+    if low.endswith("overhead_pct"):
+        # instrumentation-overhead ratios (e.g. integrity_scrub's mixed
+        # p99 with the digest ledger + scrub on vs off): already a
+        # percentage, so the threshold is absolute points, not relative
+        return "overhead"
     if "recall" in low:
         # deltas/differences around recall are signed diagnostics, not
         # magnitudes to threshold
@@ -130,6 +135,12 @@ def compare(old: dict, new: dict, qps_drop: float = 0.15,
             row["change"] = round(nv - ov, 4)
             # the steady-state invariant: any growth is a regression
             bad = nv > ov
+        elif kind == "overhead":
+            # overhead percentages regress when they grow by more than
+            # 5 points (the integrity_scrub acceptance bound); shrinking
+            # or noise inside the band is fine
+            row["change"] = round(nv - ov, 4)
+            bad = (nv - ov) > 5.0
         row["regression"] = bad
         rows.append(row)
         if bad:

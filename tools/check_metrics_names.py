@@ -16,10 +16,10 @@ Also linted:
   lowercase and stay inside the identifier-plus-dots alphabet.
 - curated metric families: literal registrations under the `xla.` /
   `hbm.` / `flight.` / `ivf.` / `mesh.` / `hnsw.` / `quality.` / `qos.`
-  prefixes (the device-runtime observability, mesh serving, device
-  graph, quality, and serving-pressure planes) must name a series
-  declared in FAMILY_NAMES below — dashboards key on these exact names,
-  so additions are explicit, not incidental.
+  / `consistency.` prefixes (the device-runtime observability, mesh
+  serving, device graph, quality, serving-pressure, and state-integrity
+  planes) must name a series declared in FAMILY_NAMES below — dashboards
+  key on these exact names, so additions are explicit, not incidental.
 
 Wired as a tier-1 test (tests/test_metrics_names.py) so a bad name fails
 CI, not the scrape.
@@ -138,6 +138,27 @@ FAMILY_NAMES = {
         "qos.degrade_level",        # current level per region (0-3)
         "qos.degrade_steps",        # ladder moves, by {direction}
         "qos.precision_advisory",   # level-3 sq8 advisory flag per region
+    },
+    "consistency": {
+        # state-integrity plane (obs/integrity.py + coordinator compare):
+        # incremental digest maintenance, the corruption scrub, restore
+        # verification, and replica divergence
+        "consistency.digest_updates",    # write batches folded into a
+                                         # ledger (counter, per region)
+        "consistency.scrub_runs",        # full-state recompute passes
+        "consistency.scrub_slots",       # slots read back and verified
+        "consistency.scrub_ms",          # scrub pass latency recorder
+        "consistency.scrub_ok",          # per-region verdict gauge (1 ok)
+        "consistency.scrub_mismatches",  # device state != ledger, by
+                                         # {artifact}
+        "consistency.restore_mismatches",  # snapshot load digest veto
+        "consistency.divergence",        # coordinator: replicas disagree
+                                         # at equal applied indices
+        "consistency.diverged_regions",  # currently-flagged region count
+        "consistency.replica_mismatch",  # ReplicaGroup post-fanout
+                                         # member comparison failed
+        "consistency.digest_age_s",      # seconds since the last clean
+                                         # full-state verification
     },
     "quality": {
         # live recall observability (obs/quality.py): windowed shadow-

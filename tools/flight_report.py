@@ -277,6 +277,57 @@ def _qos_section(qos: Dict[str, Any]) -> List[str]:
     return out
 
 
+def _consistency_section(consistency: Dict[str, Any],
+                         integrity: Dict[str, Any]) -> List[str]:
+    """State-integrity view at capture time: the consistency.* counters
+    (scrub verdicts, divergence, replica mismatches) plus each region's
+    per-artifact digest vector — a divergence bundle shows BOTH replicas'
+    vectors side by side in the raw JSON; this table shows the local
+    ledger's."""
+    per: Dict[str, Dict[str, float]] = {}
+    for key, val in consistency.items():
+        name, labels = _series_labels(key)
+        if not name.startswith("consistency."):
+            continue
+        field = name[len("consistency."):]
+        agg = per.setdefault(labels.get("region", "-"), {})
+        agg[field] = agg.get(field, 0.0) + val
+    out = [f"-- state integrity ({len(consistency)} series)"]
+    rows = []
+    for region in sorted(per):
+        st = per[region]
+        rows.append([
+            region,
+            f"{st.get('scrub_runs', 0):.0f}",
+            f"{st.get('scrub_mismatches', 0):.0f}",
+            f"{st.get('divergence', 0):.0f}",
+            f"{st.get('replica_mismatch', 0):.0f}",
+            ("ok" if st.get("scrub_ok", 1.0) else "MISMATCH"),
+            f"{st.get('digest_age_s', -1):.0f}s",
+        ])
+    if rows:
+        out.extend(_table(
+            ["REGION", "SCRUBS", "MISMATCH", "DIVERGED", "REPL_MM",
+             "VERDICT", "AGE"], rows
+        ))
+    else:
+        out.append("  (no consistency series)")
+    regions = (integrity or {}).get("regions") or {}
+    drows = []
+    for rid, rep in sorted(regions.items(), key=lambda kv: str(kv[0])):
+        for artifact, digest in sorted(
+                (rep.get("artifacts") or {}).items()):
+            drows.append([
+                str(rid), str(rep.get("applied_index", 0)), artifact,
+                str(digest),
+            ])
+    if drows:
+        out.append("")
+        out.extend(_table(["REGION", "APPLIED", "ARTIFACT", "DIGEST"],
+                          drows))
+    return out
+
+
 def render(bundle: Dict[str, Any]) -> str:
     out: List[str] = []
     created = bundle.get("created_ms", 0) / 1000.0
@@ -391,6 +442,12 @@ def render(bundle: Dict[str, Any]) -> str:
     if qos:
         out.append("")
         out.extend(_qos_section(qos))
+
+    consistency = bundle.get("consistency") or {}
+    integrity = bundle.get("integrity") or {}
+    if consistency or (integrity.get("regions") if integrity else None):
+        out.append("")
+        out.extend(_consistency_section(consistency, integrity))
 
     slow = bundle.get("slow_queries") or []
     if slow:

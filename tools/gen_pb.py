@@ -70,6 +70,13 @@ NEW_MESSAGES = {
         ("qos_queue_wait_ms", 22, T.TYPE_DOUBLE, None, False),
         ("qos_shed_total", 23, T.TYPE_INT64, None, False),
         ("qos_degrade_level", 24, T.TYPE_INT64, None, False),
+        # state-integrity plane (obs/integrity.py, PR 11): the raft
+        # applied index the digest vector corresponds to, the compact
+        # JSON {artifact: digest} vector, and the store-local scrub
+        # verdict (a full-state recompute disagreed with the ledger)
+        ("integrity_applied_index", 25, T.TYPE_INT64, None, False),
+        ("integrity_digests", 26, T.TYPE_STRING, None, False),
+        ("integrity_mismatch", 27, T.TYPE_BOOL, None, False),
     ],
     # whole-store snapshot (process device gauges + per-region list)
     "StoreMetrics": [
@@ -95,6 +102,9 @@ NEW_MESSAGES = {
         ("info", 1, T.TYPE_MESSAGE, ".dingo_tpu.ResponseInfo", False),
         ("error", 2, T.TYPE_MESSAGE, ".dingo_tpu.Error", False),
         ("stores", 3, T.TYPE_MESSAGE, ".dingo_tpu.StoreMetricsEntry", True),
+        # regions the coordinator's replica-digest comparison currently
+        # flags as DIVERGED (state-integrity plane; cluster top renders)
+        ("diverged_region_ids", 4, T.TYPE_INT64, None, True),
     ],
     "GetRegionMetricsRequest": [
         ("info", 1, T.TYPE_MESSAGE, ".dingo_tpu.RequestInfo", False),
@@ -109,6 +119,7 @@ NEW_MESSAGES = {
         ("info", 1, T.TYPE_MESSAGE, ".dingo_tpu.ResponseInfo", False),
         ("error", 2, T.TYPE_MESSAGE, ".dingo_tpu.Error", False),
         ("regions", 3, T.TYPE_MESSAGE, ".dingo_tpu.RegionMetricsEntry", True),
+        ("diverged_region_ids", 4, T.TYPE_INT64, None, True),
     ],
     # flight-recorder bundle export (device-runtime observability, PR 5)
     "FlightBundleMeta": [
