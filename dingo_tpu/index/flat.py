@@ -31,7 +31,7 @@ from dingo_tpu.index.base import (
 )
 from dingo_tpu.index.rerank_cache import DeviceRerankCache
 from dingo_tpu.index.slot_store import SlotStore, SqSlotStore, _next_pow2
-from dingo_tpu.ops.distance import Metric, normalize, score_matrix, scores_to_distances
+from dingo_tpu.ops.distance import Metric, np_normalize, score_matrix, scores_to_distances
 from dingo_tpu.ops.topk import topk_scores
 from dingo_tpu.obs.quality import QUALITY
 from dingo_tpu.obs.sentinel import sentinel_jit
@@ -604,7 +604,9 @@ class TpuFlat(_SlotStoreIndex):
         if self.metric is Metric.COSINE:
             # Store normalized; search then runs plain IP on the MXU
             # (reference normalizes for cosine, vector_index_utils.h:183).
-            vectors = np.asarray(normalize(jnp.asarray(vectors)))
+            # Host-side normalize: the jnp round-trip here synchronized
+            # the device on every write batch (dingolint host-sync).
+            vectors = np_normalize(vectors)
         return vectors
 
     def _prep_queries(self, queries: np.ndarray) -> np.ndarray:

@@ -77,7 +77,7 @@ from dingo_tpu.index.slot_store import SlotStore, _next_pow2
 from dingo_tpu.trace import TRACER
 from dingo_tpu.ops.distance import (
     Metric,
-    normalize,
+    np_normalize,
     score_matrix,
     scores_to_distances,
     squared_norms,
@@ -539,7 +539,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
                 f"vector dim {vectors.shape} != {self.dimension}"
             )
         if self.metric is Metric.COSINE:
-            vectors = np.asarray(normalize(jnp.asarray(vectors)))
+            vectors = np_normalize(vectors)
         return vectors
 
     def _prep_queries(self, queries: np.ndarray) -> np.ndarray:
@@ -551,7 +551,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
                 f"query dim {queries.shape[1]} != {self.dimension}"
             )
         if self.metric is Metric.COSINE:
-            queries = np.asarray(normalize(jnp.asarray(queries)))
+            queries = np_normalize(queries)
         return queries
 
     # -- mutation: track assignments ---------------------------------------
@@ -629,7 +629,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
                 f"need >= {self.nlist} train vectors, have {len(vectors)}"
             )
         if self.metric is Metric.COSINE:
-            vectors = np.asarray(normalize(jnp.asarray(vectors)))
+            vectors = np_normalize(vectors)
         cap = MAX_POINTS_PER_CENTROID * self.nlist
         if len(vectors) > cap:
             sel = np.random.default_rng(self.id).choice(

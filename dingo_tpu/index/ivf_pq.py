@@ -51,7 +51,13 @@ from dingo_tpu.index.flat import (
 from dingo_tpu.index.ivf_flat import IvfViewMaintenance, _probe_lists
 from dingo_tpu.index.ivf_layout import MutableIvfView, expand_probes_ranked
 from dingo_tpu.index.slot_store import HostSlotStore, SlotStore, _next_pow2
-from dingo_tpu.ops.distance import Metric, normalize, pairwise_l2sqr, squared_norms
+from dingo_tpu.ops.distance import (
+    Metric,
+    normalize,
+    np_normalize,
+    pairwise_l2sqr,
+    squared_norms,
+)
 from dingo_tpu.ops.kmeans import (
     MAX_POINTS_PER_CENTROID,
     kmeans_assign,
@@ -330,7 +336,7 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
             # normalizing again drifts low-order bits (||x|| lands NEAR 1,
             # not exactly) and would break the snapshot's bit-exact
             # restore-digest verification
-            vectors = np.asarray(normalize(jnp.asarray(vectors)))
+            vectors = np_normalize(vectors)
         return vectors
 
     def _prep_queries(self, queries: np.ndarray) -> np.ndarray:
@@ -342,7 +348,7 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
                 f"query dim {queries.shape[1]} != {self.dimension}"
             )
         if self.metric is Metric.COSINE:
-            queries = np.asarray(normalize(jnp.asarray(queries)))
+            queries = np_normalize(queries)
         return queries
 
     # -- mutation ------------------------------------------------------------
@@ -448,7 +454,7 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
                 f"need >= {min_train} train vectors, have {len(vectors)}"
             )
         if self.metric is Metric.COSINE:
-            vectors = np.asarray(normalize(jnp.asarray(vectors)))
+            vectors = np_normalize(vectors)
         if len(vectors) > cap:
             vectors = vectors[rng.choice(len(vectors), cap, replace=False)]
         dv = jnp.asarray(vectors)

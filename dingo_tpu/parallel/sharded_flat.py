@@ -165,15 +165,21 @@ class TpuShardedFlat(VectorIndex):
             # growth cannot donate: the output is LARGER than the input,
             # so XLA can never alias the buffers (donating only produced
             # "donated buffers were not usable" warnings); the old arrays
-            # free when the references drop below
-            self._store.vecs = jax.jit(
-                grow2d, out_shardings=sharding2d
+            # free when the references drop below. Growth compiles per
+            # (old_cap, cap) pair by construction — sentinel_jit keeps
+            # those traces in the xla.recompiles accounting (bare-jit
+            # lint) instead of invisible.
+            self._store.vecs = sentinel_jit(
+                "parallel.flat.grow_vecs", grow2d,
+                out_shardings=sharding2d,
             )(self._store.vecs)  # under _device_lock via callers
-            self._store.sqnorm = jax.jit(
+            self._store.sqnorm = sentinel_jit(
+                "parallel.flat.grow_sqnorm",
                 functools.partial(grow1d, fill=0.0),
                 out_shardings=sharding1d,
             )(self._store.sqnorm)
-            self._store.valid = jax.jit(
+            self._store.valid = sentinel_jit(
+                "parallel.flat.grow_valid",
                 functools.partial(grow1d, fill=False),
                 out_shardings=sharding1d,
             )(self._store.valid)

@@ -115,6 +115,11 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
         self.codebooks: Optional[jax.Array] = None     # [m, ksub, dsub]
         self._codes: Optional[jax.Array] = None        # [S*cap, m] uint8
         self._pq_view: Optional[_PqShardedView] = None
+        #: cached per-instance programs (built lazily: their out_shardings
+        #: capture self.mesh) — a fresh jax.jit per call would re-trace
+        #: every invocation and hide the compiles from the sentinel
+        self._code_update_jit = None
+        self._gather_rows_jit = None
         super().__init__(index_id, parameter, mesh)
         self._build_pq_programs()
 
@@ -133,9 +138,10 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
             c = jnp.pad(c, ((0, 0), (0, pad), (0, 0)))
             return c.reshape(S * cap, m)
 
-        # growth cannot donate (output larger than input — no aliasing)
-        self._codes = jax.jit(
-            grow, out_shardings=sh
+        # growth cannot donate (output larger than input — no aliasing);
+        # sentinel-wrapped so the per-(old_cap, cap) compile is accounted
+        self._codes = sentinel_jit(
+            "parallel.pq.grow_codes", grow, out_shardings=sh
         )(self._codes)
 
     # -- programs ------------------------------------------------------------
@@ -262,11 +268,15 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
     def _rows_at_gslots(self, gslots: np.ndarray) -> np.ndarray:
         """Bounded replicated gather of sample rows from the sharded store
         (XLA inserts the cross-shard collective)."""
-        with self._device_lock:
-            out = jax.jit(
+        if self._gather_rows_jit is None:
+            self._gather_rows_jit = sentinel_jit(
+                "parallel.pq.gather_rows",
                 lambda v, i: jnp.take(v, i, axis=0),
                 out_shardings=NamedSharding(self.mesh, P(None, None)),
-            )(self._store.vecs, jnp.asarray(gslots, jnp.int32))
+            )
+        with self._device_lock:
+            out = self._gather_rows_jit(
+                self._store.vecs, jnp.asarray(gslots, jnp.int32))
         return np.asarray(jax.device_get(out), np.float32)
 
     def train(self, vectors: Optional[np.ndarray] = None) -> None:
@@ -341,12 +351,21 @@ class TpuShardedIvfPq(TpuShardedIvfFlat):
             codes = _encode_codes(
                 dv, assign, self.centroids, self.codebooks, self.m
             )
-            sh = NamedSharding(self.mesh, P("data", None))
-            with self._device_lock:
-                self._codes = jax.jit(
+            if self._code_update_jit is None:
+                # cached per-instance: the old inline jax.jit(lambda...)
+                # minted a FRESH wrapper per upsert, re-tracing the code
+                # scatter on every trained write batch — invisibly,
+                # because nothing sentinel-counted it (bare-jit lint)
+                self._code_update_jit = sentinel_jit(
+                    "parallel.pq.code_update",
                     lambda c, s, v: c.at[s].set(v),
-                    out_shardings=sh, donate_argnums=0,
-                )(self._codes, jnp.asarray(slots, jnp.int32), codes)
+                    out_shardings=NamedSharding(self.mesh,
+                                                P("data", None)),
+                    donate_argnums=0,
+                )
+            with self._device_lock:
+                self._codes = self._code_update_jit(
+                    self._codes, jnp.asarray(slots, jnp.int32), codes)
         self._view_dirty = True
 
     # -- bucketed view -------------------------------------------------------

@@ -1,0 +1,40 @@
+"""dingolint — repo-native static invariant analyzer.
+
+Eleven PRs accreted load-bearing conventions: every persistent jit goes
+through ``sentinel_jit`` (PR 5), device mutations happen under
+``store.device_lock`` (PR 3), static shapes come off the pow2 ladders so
+steady state never recompiles (PR 3/6), and trace + budget contextvars
+must be captured across thread handoffs (PR 1/10). Each was enforced
+only by convention plus a handful of runtime tests — which means a new
+call site that syncs the host mid-resolve or mints an off-ladder shape
+compiles, passes unit tests, and silently kills the serving properties
+(sustained QPS needs a stall-free kernel path; a single retrace is a
+100ms-40s p99 outlier) until the bench regresses.
+
+dingolint encodes those invariants as static checkers over the package
+AST plus a module-level call graph:
+
+- per-file checkers get each parsed module (``check_module``);
+- inter-procedural checkers additionally get the whole repo and a call
+  graph (``check_repo``) for reachability questions ("is this host sync
+  reachable from a search dispatch path?") and lock-acquisition nesting.
+
+Adjudicated pre-existing findings live in ``baseline.json`` next to this
+package — every entry carries a one-line rationale, and the lint fails
+if one doesn't. New code suppresses a deliberate exception inline with
+``# dingolint: ok[<checker>] <reason>``.
+
+Entry point: ``tools/lint.py`` (wired into tier-1 via
+tests/test_dingolint.py — a violation fails CI, not the bench).
+"""
+
+from tools.dingolint.core import (  # noqa: F401
+    Checker,
+    Finding,
+    Module,
+    Repo,
+    lint_paths,
+    lint_repo,
+    load_repo,
+)
+from tools.dingolint.checkers import all_checkers  # noqa: F401

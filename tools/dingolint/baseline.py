@@ -1,0 +1,91 @@
+"""Baseline: adjudicated pre-existing findings, each with a rationale.
+
+The baseline is the lint's memory of human judgment: a finding whose
+fingerprint appears here is reported as *baselined* and does not fail
+the run — but only if its entry carries a non-placeholder rationale.
+An entry without a real rationale fails the lint: the file exists to
+record WHY each exception is safe, not to be a mute allowlist that
+violations quietly accumulate in.
+
+``tools/lint.py --baseline-update`` rewrites the file from the current
+findings, preserving rationales for fingerprints that persist and
+stamping ``TODO: adjudicate`` on new ones (which then fail until a human
+replaces the placeholder). Stale entries (fingerprint no longer found —
+the code was fixed or deleted) are dropped on update and reported as
+warnings on normal runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from tools.dingolint.core import Finding
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+TODO_RATIONALE = "TODO: adjudicate"
+
+
+def load(path: str = BASELINE_PATH) -> Dict[str, dict]:
+    """fingerprint -> entry dict. Missing file = empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    return {e["fingerprint"]: e for e in data.get("entries", [])}
+
+
+def save(entries: Sequence[dict], path: str = BASELINE_PATH) -> None:
+    entries = sorted(entries, key=lambda e: (e["checker"], e["location"]))
+    with open(path, "w") as f:
+        json.dump({"version": 1, "entries": list(entries)}, f, indent=2)
+        f.write("\n")
+
+
+def split(findings: Sequence[Finding], baseline: Dict[str, dict]
+          ) -> Tuple[List[Finding], List[Finding], List[dict], List[dict]]:
+    """Partition into (new, baselined, unrationalized entries, stale
+    entries). A baseline entry may match several findings (same checker +
+    symbol + message at multiple call sites collapses to one judgment)."""
+    new: List[Finding] = []
+    matched: List[Finding] = []
+    hit: set = set()
+    for f in findings:
+        entry = baseline.get(f.fingerprint)
+        if entry is None:
+            new.append(f)
+        else:
+            matched.append(f)
+            hit.add(f.fingerprint)
+    unrationalized = [
+        e for fp, e in baseline.items()
+        if fp in hit and not _has_rationale(e)
+    ]
+    stale = [e for fp, e in baseline.items() if fp not in hit]
+    return new, matched, unrationalized, stale
+
+
+def _has_rationale(entry: dict) -> bool:
+    r = (entry.get("rationale") or "").strip()
+    return bool(r) and not r.startswith("TODO")
+
+
+def updated_entries(findings: Sequence[Finding],
+                    baseline: Dict[str, dict]) -> List[dict]:
+    """Entries for --baseline-update: one per distinct fingerprint among
+    the current findings, rationale carried over when known."""
+    out: Dict[str, dict] = {}
+    for f in findings:
+        if f.fingerprint in out:
+            continue
+        old = baseline.get(f.fingerprint)
+        out[f.fingerprint] = {
+            "fingerprint": f.fingerprint,
+            "checker": f.checker,
+            "location": f"{f.path}:{f.symbol or '<module>'}",
+            "message": f.message,
+            "rationale": (old or {}).get("rationale", TODO_RATIONALE),
+        }
+    return list(out.values())

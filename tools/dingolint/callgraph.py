@@ -1,0 +1,326 @@
+"""Approximate module-level call graph for inter-procedural checks.
+
+Python's dynamism makes a sound call graph impossible statically; the
+checkers need a *useful* one. Resolution is three-tiered, from precise to
+fuzzy, and every edge remembers which tier produced it so checkers can
+choose their own precision/recall point:
+
+1. **exact** — module-local names (``_pad_batch(...)``), ``self.method``
+   calls resolved through the enclosing class (and single inheritance
+   within the repo), and names imported via ``from m import f`` /
+   ``import m`` followed by ``m.f(...)``.
+2. **fuzzy** — a method call on an unknown receiver (``store.put(...)``)
+   resolves to every def in the repo whose final name matches, capped at
+   ``MAX_FANOUT`` candidates: a name shared by more defs than that (e.g.
+   ``get``) carries no signal and would only manufacture reachability.
+
+Nested defs own their body's calls (a call inside the ``resolve()``
+closure belongs to ``search_async.resolve``, not ``search_async``) —
+that's load-bearing for the host-sync checker, whose whole point is that
+the closure IS the designated sync point.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.dingolint.core import Module, Repo
+
+#: a basename matching more defs than this resolves to nothing — it's
+#: noise, not an edge (``put``/``search`` stay useful, ``get`` drops out)
+MAX_FANOUT = 12
+
+#: method names that collide with builtin container/file/lock methods:
+#: an attribute call on an unknown receiver with one of these names is
+#: overwhelmingly a list/dict/set/file/Lock operation, and resolving it
+#: to a same-named repo def welds unrelated subsystems together (a
+#: ``candidates.append(...)`` inside a search once resolved to
+#: ``RaftLog.append`` and dragged the whole write path into the "hot"
+#: reachability set). Exact (self./imported) resolution is unaffected.
+FUZZY_STOPLIST = frozenset({
+    "append", "extend", "insert", "remove", "pop", "clear", "copy",
+    "count", "index", "sort", "reverse", "add", "discard", "update",
+    "get", "keys", "values", "items", "setdefault",
+    "read", "write", "close", "flush", "seek", "tell",
+    "split", "strip", "join", "encode", "decode", "format",
+    "acquire", "release", "wait", "notify", "set", "start", "stop",
+})
+
+
+def dotted_name(expr: ast.AST) -> Optional[List[str]]:
+    """``a.b.c`` -> ['a','b','c']; None for non-trivial expressions."""
+    parts: List[str] = []
+    cur = expr
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+class FuncInfo:
+    __slots__ = ("qual", "module", "node", "cls",
+                 "exact_calls", "fuzzy_calls")
+
+    def __init__(self, qual: str, module: Module, node: ast.AST,
+                 cls: Optional[str]):
+        self.qual = qual            #: global qualname (module + local)
+        self.module = module
+        self.node = node
+        self.cls = cls              #: enclosing class local qualname
+        self.exact_calls: Set[str] = set()
+        self.fuzzy_calls: Set[str] = set()
+
+
+class CallGraph:
+    def __init__(self, repo: Repo):
+        self.repo = repo
+        #: global qualname -> FuncInfo
+        self.funcs: Dict[str, FuncInfo] = {}
+        #: basename -> [global qualnames]
+        self.by_basename: Dict[str, List[str]] = {}
+        #: module name -> {local alias -> imported dotted target}
+        self._imports: Dict[str, Dict[str, str]] = {}
+        #: (module, class local qual) -> [base class dotted names]
+        self._bases: Dict[Tuple[str, str], List[str]] = {}
+        #: top-level packages the repo owns — calls rooted at an import
+        #: of anything else (jax, numpy, grpc, ...) never fuzzy-resolve:
+        #: ``lax.scan`` must not alias a repo method named ``scan``
+        self._repo_tops = {m.name.split(".", 1)[0] for m in repo.modules}
+        #: (module, class) -> {attr -> (module, class)} from annotated
+        #: ctor params: ``def __init__(self, engine: RawEngine)`` +
+        #: ``self.engine = engine`` types ``self.engine.X`` calls
+        self._attr_types: Dict[Tuple[str, str],
+                               Dict[str, Tuple[str, str]]] = {}
+        for module in repo.modules:
+            self._index_module(module)
+        for module in repo.modules:
+            self._resolve_module(module)
+
+    # -- indexing ----------------------------------------------------------
+    def _index_module(self, module: Module) -> None:
+        imports: Dict[str, str] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    imports[alias.asname or alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    imports[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+            elif isinstance(node, ast.ClassDef):
+                bases = []
+                for b in node.bases:
+                    d = dotted_name(b)
+                    if d:
+                        bases.append(".".join(d))
+                self._bases[(module.name,
+                             getattr(node, "_dl_qual", node.name))] = bases
+        self._imports[module.name] = imports
+        self._index_attr_types(module, imports)
+        for local_qual, fnode in module.funcs.items():
+            qual = f"{module.name}.{local_qual}"
+            cls = None
+            cnode = module.enclosing_class(fnode)
+            if cnode is not None:
+                cls = getattr(cnode, "_dl_qual", cnode.name)
+            info = FuncInfo(qual, module, fnode, cls)
+            self.funcs[qual] = info
+            self.by_basename.setdefault(
+                local_qual.rsplit(".", 1)[-1], []
+            ).append(qual)
+
+    def _index_attr_types(self, module: Module,
+                          imports: Dict[str, str]) -> None:
+        """``self.attr = param`` where the param carries a class
+        annotation resolvable inside the repo types the attribute."""
+        for cnode in ast.walk(module.tree):
+            if not isinstance(cnode, ast.ClassDef):
+                continue
+            ckey = (module.name, getattr(cnode, "_dl_qual", cnode.name))
+            for fnode in ast.walk(cnode):
+                if not isinstance(fnode, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                    continue
+                ann: Dict[str, Tuple[str, str]] = {}
+                for a in fnode.args.args:
+                    if a.annotation is None:
+                        continue
+                    d = dotted_name(a.annotation)
+                    if not d:
+                        continue
+                    name = ".".join(d)
+                    target = imports.get(d[0])
+                    if target and len(d) == 1:
+                        full = target
+                    elif target:
+                        full = f"{target}.{'.'.join(d[1:])}"
+                    elif f"{module.name}.{name}" in {
+                        f"{module.name}."
+                        + getattr(n, "_dl_qual", "")
+                        for n in ast.walk(module.tree)
+                        if isinstance(n, ast.ClassDef)
+                    }:
+                        full = f"{module.name}.{name}"
+                    else:
+                        continue
+                    mod, _, c = full.rpartition(".")
+                    if mod in self.repo.by_name:
+                        ann[a.arg] = (mod, c)
+                for node in ast.walk(fnode):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    if not isinstance(node.value, ast.Name):
+                        continue
+                    ptype = ann.get(node.value.id)
+                    if ptype is None:
+                        continue
+                    for tgt in node.targets:
+                        t = dotted_name(tgt)
+                        if t and len(t) == 2 and t[0] == "self":
+                            self._attr_types.setdefault(
+                                ckey, {})[t[1]] = ptype
+
+    # -- resolution --------------------------------------------------------
+    def resolve_call(self, module: Module, call: ast.Call,
+                     cls: Optional[str] = None
+                     ) -> Tuple[Set[str], Set[str]]:
+        """(exact targets, fuzzy targets) for one call site."""
+        exact: Set[str] = set()
+        fuzzy: Set[str] = set()
+        parts = dotted_name(call.func)
+        imports = self._imports.get(module.name, {})
+        if parts is None:
+            return exact, fuzzy
+        if len(parts) == 1:
+            name = parts[0]
+            if f"{module.name}.{name}" in self.funcs:
+                exact.add(f"{module.name}.{name}")
+            elif name in imports and imports[name] in self.funcs:
+                exact.add(imports[name])
+            return exact, fuzzy
+        base = parts[-1]
+        if parts[0] == "self" and cls is not None and len(parts) == 2:
+            hit = self._method_on(module, cls, base)
+            if hit:
+                exact.add(hit)
+                return exact, fuzzy
+        if parts[0] == "self" and cls is not None and len(parts) == 3:
+            # self.attr.method — typed when the ctor annotated the attr
+            ptype = self._attr_types.get(
+                (module.name, cls), {}).get(parts[1])
+            if ptype is not None:
+                pmod = self.repo.by_name.get(ptype[0])
+                if pmod is not None:
+                    hit = self._method_on(pmod, ptype[1], base)
+                    if hit:
+                        exact.add(hit)
+                        return exact, fuzzy
+        if parts[0] in imports:
+            target = f"{imports[parts[0]]}.{'.'.join(parts[1:])}"
+            if target in self.funcs:
+                exact.add(target)
+                return exact, fuzzy
+            if imports[parts[0]].split(".", 1)[0] not in self._repo_tops:
+                # rooted at an external module (jax.lax.scan, np.put, ...):
+                # a repo def sharing the basename is a coincidence
+                return exact, fuzzy
+        if base not in FUZZY_STOPLIST:
+            candidates = self.by_basename.get(base, [])
+            # locality: for a bare-name receiver, a same-module def wins
+            # over global basename matches (``kv.put`` next to ``class
+            # SortedKv`` is SortedKv's put, not every put in the repo).
+            # NOT applied to self.attr receivers — ``self.engine.delete``
+            # points at another object, and localizing it once resolved a
+            # class's untyped engine call to the class's own method
+            if parts[0] != "self":
+                local = [c for c in candidates
+                         if c.startswith(module.name + ".")]
+                if local:
+                    candidates = local
+            if 0 < len(candidates) <= MAX_FANOUT:
+                fuzzy.update(candidates)
+        return exact, fuzzy
+
+    def _method_on(self, module: Module, cls: str, name: str
+                   ) -> Optional[str]:
+        """Resolve ``self.name`` through the class then its repo-local
+        bases (single-level walk per base, enough for the index MRO)."""
+        seen: Set[Tuple[str, str]] = set()
+        stack = [(module.name, cls)]
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            mod_name, c = key
+            qual = f"{mod_name}.{c}.{name}"
+            if qual in self.funcs:
+                return qual
+            for b in self._bases.get((mod_name, c), []):
+                mod = self.repo.by_name.get(mod_name)
+                imports = self._imports.get(mod_name, {})
+                head = b.split(".")[0]
+                if b in (mod.funcs if mod else {}):
+                    continue
+                # base in the same module
+                if mod is not None and any(
+                    isinstance(n, ast.ClassDef)
+                    and getattr(n, "_dl_qual", None) == b
+                    for n in ast.walk(mod.tree)
+                ):
+                    stack.append((mod_name, b))
+                elif head in imports:
+                    target = imports[head]
+                    tail = b.split(".", 1)[1] if "." in b else ""
+                    full = f"{target}.{tail}".rstrip(".")
+                    # from m import Base -> target is m.Base already
+                    if "." in full:
+                        bmod, bcls = full.rsplit(".", 1)
+                        if bmod in self.repo.by_name:
+                            stack.append((bmod, bcls))
+        return None
+
+    def _resolve_module(self, module: Module) -> None:
+        for local_qual, fnode in module.funcs.items():
+            info = self.funcs[f"{module.name}.{local_qual}"]
+            for node in ast.walk(fnode):
+                if not isinstance(node, ast.Call):
+                    continue
+                # a call inside a nested def belongs to that def
+                if module.qualname_of(node) != local_qual:
+                    continue
+                exact, fuzzy = self.resolve_call(module, node, info.cls)
+                info.exact_calls |= exact
+                info.fuzzy_calls |= fuzzy
+
+    # -- queries -----------------------------------------------------------
+    def callees(self, qual: str, fuzzy: bool = False) -> Set[str]:
+        info = self.funcs.get(qual)
+        if info is None:
+            return set()
+        out = set(info.exact_calls)
+        if fuzzy:
+            out |= info.fuzzy_calls
+        return out
+
+    def reachable(self, roots: Iterable[str], fuzzy: bool = False,
+                  skip=None) -> Set[str]:
+        """Transitive closure from `roots`. `skip(qual)` prunes traversal
+        INTO a function (it is neither visited nor expanded)."""
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.funcs]
+        while stack:
+            qual = stack.pop()
+            if qual in seen or (skip is not None and skip(qual)):
+                continue
+            seen.add(qual)
+            for callee in self.callees(qual, fuzzy=fuzzy):
+                if callee not in seen:
+                    stack.append(callee)
+        return seen

@@ -1,0 +1,37 @@
+"""Checker registry. Order is report order, not priority."""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.dingolint.core import Checker
+
+
+def all_checkers() -> List[Checker]:
+    from tools.dingolint.checkers.bare_jit import BareJitChecker
+    from tools.dingolint.checkers.context_handoff import (
+        ContextHandoffChecker,
+    )
+    from tools.dingolint.checkers.host_sync import HostSyncChecker
+    from tools.dingolint.checkers.ladder_shape import LadderShapeChecker
+    from tools.dingolint.checkers.lock_order import LockOrderChecker
+    from tools.dingolint.checkers.metric_names import MetricNamesChecker
+
+    return [
+        LockOrderChecker(),
+        HostSyncChecker(),
+        BareJitChecker(),
+        LadderShapeChecker(),
+        ContextHandoffChecker(),
+        MetricNamesChecker(),
+    ]
+
+
+def by_name(names) -> List[Checker]:
+    wanted = set(names)
+    out = [c for c in all_checkers() if c.name in wanted]
+    missing = wanted - {c.name for c in out}
+    if missing:
+        raise SystemExit(f"unknown checker(s): {sorted(missing)} "
+                         f"(have: {[c.name for c in all_checkers()]})")
+    return out

@@ -1,0 +1,141 @@
+"""bare-jit: every persistent jit goes through ``sentinel_jit``.
+
+PR 3 built the shape-bucketing ladders so steady-state serving never
+recompiles; PR 5 made that a *monitored* invariant by wrapping every
+persistent jitted entry point in ``sentinel_jit`` (obs/sentinel.py),
+which counts calls / cache hits / traces and attributes each compile
+stall to a kernel name and argument signature. A ``jax.jit`` or
+``pallas_call`` that bypasses the sentinel is a blind spot: its
+recompiles don't move ``xla.recompiles``, don't parent ``xla.compile``
+spans into the victim's trace, and don't fail the steady-state-recompile
+bench gates — the exact failure mode the sentinel exists to catch. Worse,
+the bypass pattern that keeps appearing (``jax.jit(lambda ...)(args)``
+inline) mints a FRESH jit wrapper per call, so it re-traces every time
+and nothing ever reports it.
+
+Rule: any ``jax.jit(...)`` call outside obs/sentinel.py is flagged. A
+``pallas_call`` is fine when its enclosing function is sentinel-wrapped
+(decorator form, or passed to a ``sentinel_jit(name, fn, ...)`` call in
+the same module) — the sentinel then owns the whole traced body.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from tools.dingolint.callgraph import dotted_name
+from tools.dingolint.core import Checker, Finding, Module, Repo
+
+#: the sentinel's own module (the one place jax.jit is allowed)
+_EXEMPT_MODULES = {"dingo_tpu.obs.sentinel"}
+
+
+def _sentinel_wrapped_names(module: Module) -> Set[str]:
+    """Local function names owned by a sentinel: decorated with
+    ``@sentinel_jit(...)`` or passed (possibly via an inner ``shard_map``
+    call) to a ``sentinel_jit(name, fn, ...)`` call form. Only DIRECT
+    positional args (and the positional args of one nested call, the
+    shard_map idiom) count — harvesting every Name in the expression
+    would mark sharding constructors and kwarg values as "wrapped" and
+    quietly exempt same-named functions from the rule."""
+    wrapped: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                parts = dotted_name(target)
+                if parts and parts[-1] == "sentinel_jit":
+                    wrapped.add(node.name)
+        elif isinstance(node, ast.Call):
+            parts = dotted_name(node.func)
+            if not parts or parts[-1] != "sentinel_jit":
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    wrapped.add(arg.id)
+                elif isinstance(arg, ast.Call):
+                    for inner in arg.args:
+                        if isinstance(inner, ast.Name):
+                            wrapped.add(inner.id)
+    return wrapped
+
+
+def _jit_names(module: Module) -> Set[str]:
+    """Local names that ARE jax.jit: ``from jax import jit [as j]``."""
+    names: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "jit":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+class BareJitChecker(Checker):
+    name = "bare-jit"
+    description = ("jax.jit / pallas_call outside sentinel_jit escapes "
+                   "recompile accounting")
+
+    def check_module(self, module: Module, repo: Repo) -> List[Finding]:
+        if module.name in _EXEMPT_MODULES:
+            return []
+        wrapped = _sentinel_wrapped_names(module)
+        jit_aliases = _jit_names(module)
+        out: List[Finding] = []
+        jit_msg = (
+            "bare jax.jit — wrap it in sentinel_jit "
+            "(obs/sentinel.py) so its traces land in the "
+            "xla.recompiles accounting; an inline "
+            "jax.jit(fn)(args) additionally re-traces on every "
+            "call (fresh wrapper, cold cache)"
+        )
+        # decorator form: @jax.jit / @jit / @jax.jit(...) on a def
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                parts = dotted_name(target)
+                if not parts:
+                    continue
+                is_jit = (parts[-1] == "jit"
+                          and ((len(parts) >= 2 and parts[-2] == "jax")
+                               or (len(parts) == 1
+                                   and parts[0] in jit_aliases)))
+                if is_jit:
+                    f = module.finding(self.name, dec, jit_msg)
+                    if f:
+                        out.append(f)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            parent = module.parent(node)
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node in parent.decorator_list:
+                continue  # call-form decorator: handled above
+            parts = dotted_name(node.func)
+            if not parts:
+                continue
+            tail = parts[-1]
+            if tail == "jit" and (
+                    (len(parts) >= 2 and parts[-2] == "jax")
+                    or (len(parts) == 1 and parts[0] in jit_aliases)):
+                f = module.finding(self.name, node, jit_msg)
+                if f:
+                    out.append(f)
+            elif tail == "pallas_call":
+                fn = module.enclosing_function(node)
+                if fn is not None and fn.name in wrapped:
+                    continue
+                f = module.finding(
+                    self.name, node,
+                    "pallas_call outside a sentinel_jit-wrapped function "
+                    "— the kernel's compiles escape the recompile "
+                    "sentinel; decorate the enclosing function with "
+                    "@sentinel_jit(...)",
+                )
+                if f:
+                    out.append(f)
+        return out

@@ -1,0 +1,155 @@
+"""context-handoff: thread handoffs must carry trace + budget context.
+
+Trace context (PR 1) and the request budget (PR 10) both live in
+contextvars, and contextvars do not cross threads. The repo's handoff
+discipline: capture at the submission site (the coalescer opens the
+``coalesce.wait`` span and reads ``current_budget()`` at submit, storing
+both ON the entry), re-attach on the worker (``run_span.attach()``, the
+flush thread consults ``entry.budget``). A ``threading.Thread`` or
+``executor.submit`` that skips this silently orphans everything
+downstream: device spans mint root traces instead of nesting under the
+request, deadline checks read "no budget" and admit doomed work, and the
+qos per-stage accounting loses the request it was accounting.
+
+A handoff site passes when evidence of the discipline is visible to
+static analysis — the spawned target (resolved through the call graph)
+or the enclosing function references the capture/attach surface
+(``current_span`` / ``attach`` / ``current_budget`` / ``wait_span`` /
+``budget`` / ``copy_context``). Background loops that never carry a
+request (crontab scheduler, metrics HTTP sidecar, heartbeat/raft
+tickers) legitimately fail this test; they are adjudicated in the
+baseline, each with its rationale, so a NEW thread spawn starts life
+flagged and somebody has to say why it's exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from tools.dingolint.callgraph import dotted_name
+from tools.dingolint.core import Checker, Finding, Module, Repo
+
+#: evidence that trace/budget context is being captured or re-attached
+_EVIDENCE_RE = re.compile(
+    r"\b(current_span|start_span|attach|attach_budget|current_budget|"
+    r"copy_context|wait_span|budget)\b"
+)
+
+
+def _has_evidence(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    try:
+        return bool(_EVIDENCE_RE.search(ast.unparse(node)))
+    except Exception:  # pragma: no cover — unparse is total on parsed asts
+        return False
+
+
+class ContextHandoffChecker(Checker):
+    name = "context-handoff"
+    description = ("threading.Thread / executor submits must capture "
+                   "trace + budget context (or be baselined as "
+                   "context-free background loops)")
+
+    def check_module(self, module: Module, repo: Repo) -> List[Finding]:
+        cg = repo.callgraph()
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            parts = dotted_name(node.func)
+            if not parts:
+                continue
+            kind = None
+            if parts[-1] == "Thread" and (len(parts) == 1
+                                          or parts[-2] == "threading"):
+                if any(kw.arg == "target" for kw in node.keywords):
+                    kind = "threading.Thread"
+            elif parts[-1] == "submit" and len(parts) >= 2:
+                kind = "submit"
+            if kind is None:
+                continue
+            if self._handoff_ok(module, cg, node, kind):
+                continue
+            f = module.finding(
+                self.name, node,
+                f"{kind} handoff without visible trace/budget capture — "
+                f"contextvars do not cross threads; capture "
+                f"current_span()/current_budget() at the submit site and "
+                f"re-attach on the worker (the PR 1/PR 10 coalescer "
+                f"discipline), or baseline this site as a context-free "
+                f"background loop",
+            )
+            if f:
+                out.append(f)
+        return out
+
+    def _handoff_ok(self, module: Module, cg, node: ast.Call,
+                    kind: str) -> bool:
+        # the enclosing function already shows capture/attach work
+        fn = module.enclosing_function(node)
+        if _has_evidence(fn):
+            return True
+        # resolve the spawned target and inspect its body
+        targets: List[ast.AST] = []
+        if kind == "threading.Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    targets.append(kw.value)
+        else:
+            # receiver.submit(fn, ...) — the receiver's submit() AND the
+            # submitted callable both count (the coalescer captures
+            # inside submit(); a raw executor relies on the callable)
+            exact, fuzzy = cg.resolve_call(module, node, None)
+            for qual in sorted(exact | fuzzy):
+                info = cg.funcs.get(qual)
+                if info is not None and _has_evidence(info.node):
+                    return True
+            if node.args:
+                targets.append(node.args[0])
+        for tgt in targets:
+            tparts = dotted_name(tgt)
+            if tparts is None:
+                # lambda / partial: inspect the expression itself
+                if _has_evidence(tgt):
+                    return True
+                continue
+            qual = self._resolve_target(module, cg, tgt, tparts)
+            info = cg.funcs.get(qual) if qual else None
+            if info is None:
+                continue
+            if _has_evidence(info.node):
+                return True
+            # one delegation hop: a dispatcher loop (the coalescer's
+            # timer thread) may hand each batch to the function that
+            # actually re-attaches context
+            for callee in sorted(cg.callees(qual, fuzzy=False)):
+                ci = cg.funcs.get(callee)
+                if ci is not None and _has_evidence(ci.node):
+                    return True
+        return False
+
+    @staticmethod
+    def _resolve_target(module: Module, cg, tgt: ast.AST,
+                        parts: List[str]) -> Optional[str]:
+        # self.method / local function / imported function
+        fake_call = ast.Call(func=tgt, args=[], keywords=[])
+        ast.copy_location(fake_call, tgt)
+        fake_call._dl_parent = getattr(  # type: ignore[attr-defined]
+            tgt, "_dl_parent", None)
+        cls = None
+        cnode = module.enclosing_class(tgt)
+        if cnode is not None:
+            cls = getattr(cnode, "_dl_qual", cnode.name)
+        exact, fuzzy = cg.resolve_call(module, fake_call, cls)
+        for qual in sorted(exact) + sorted(fuzzy):
+            if qual in cg.funcs:
+                return qual
+        # local nested def (target=work)
+        if len(parts) == 1:
+            for q in module.funcs:
+                if q.rsplit(".", 1)[-1] == parts[0]:
+                    return f"{module.name}.{q}"
+        return None

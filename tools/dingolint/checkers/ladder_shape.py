@@ -1,0 +1,258 @@
+"""ladder-shape: static args of sentinel kernels come off the ladders.
+
+The "steady state never recompiles" invariant (PR 3, monitored since
+PR 5) holds because every integer that becomes a jitted program's static
+argument or padded dimension is drawn from a small closed set: the pow2
+ladders (``_next_pow2`` / ``_pad_batch`` / ``pad_query_batch`` and the
+{1,1.25,1.5,1.75}x bucket-alloc ladder), conf-pinned constants, and
+tuner knobs that only ever take ladder values. Mint one static arg
+directly from data (``k=len(queries)``, ``bucket=rows.shape[0]``) and
+every novel workload size compiles a novel program: the jit cache grows
+without bound and each growth step is a 100ms-40s serving stall that no
+unit test sees, because unit tests run one shape.
+
+The checker finds every sentinel-wrapped kernel in the repo (decorator
+form ``@sentinel_jit(name, static_argnames=...)`` and call form
+``x = sentinel_jit(name, fn, static_argnames=...)``), maps its static
+argnames through the wrapped function's signature, and at every call
+site checks the expression feeding each static arg: an expression that
+visibly derives from data size — contains ``len(...)`` or a ``.shape``
+access — must also contain a ladder call. One hop of local dataflow is
+followed (``n = len(q); kernel(..., k=n)`` is still flagged). Params,
+attributes, literals, and conf reads pass: their mint sites are checked
+where they mint.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.dingolint.callgraph import dotted_name
+from tools.dingolint.core import Checker, Finding, Module, Repo
+
+#: the sanctioned shape-ladder helpers (grep-verified defs): an
+#: expression containing a call to one of these is ladder-derived by
+#: construction. Extend the set when a new ladder helper lands — that's
+#: explicit on purpose, like FAMILY_NAMES in metric-names.
+LADDER_FUNCS = {
+    "_next_pow2",       # index/slot_store.py, ops/scatter.py
+    "_prev_pow2",       # common/coalescer.py (flush threshold)
+    "_pad_batch",       # index/flat.py (pow2 batch pad)
+    "pad_query_batch",  # parallel/sharded_store.py (batch-axis ladder)
+    "shape_bucket",     # index/ivf_layout.py ({1,1.25,1.5,1.75}x-pow2)
+    "_shape_buckets",   # index/ivf_flat.py ((topk, nprobe) bucketing)
+    "_beam_width",      # index/hnsw.py (ef -> beam {1,1.5}x-pow2)
+    "resolve_dim_block",  # ops/blocked.py (conf-pinned dim tiling)
+    "ladder_values",    # obs/tuner.py (warm knob ladder)
+    "ladder_step",
+}
+
+
+class _KernelSig:
+    __slots__ = ("kernel", "static", "params", "posmap", "module")
+
+    def __init__(self, kernel: str, static: Set[str],
+                 params: List[str], module: str):
+        self.kernel = kernel          #: sentinel name, for messages
+        self.static = static          #: static_argnames
+        self.params = params          #: positional parameter names
+        self.posmap = {i: p for i, p in enumerate(params)}
+        self.module = module          #: defining module (disambiguation)
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names: Set[str] = set()
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str):
+                    names.add(sub.value)
+            return names
+    return set()
+
+
+def _kernel_name(call: ast.Call) -> str:
+    if call.args and isinstance(call.args[0], ast.Constant):
+        return str(call.args[0].value)
+    return "?"
+
+
+def collect_kernels(repo: Repo) -> Dict[str, List[_KernelSig]]:
+    """callable-basename -> signatures, for every sentinel wrapper with
+    static argnames. Call-form wrappers assigned to ``self._x_jit`` are
+    keyed by the attribute basename. A basename may map to SEVERAL sigs
+    (same-named wrappers in different modules) — the call-site check
+    disambiguates by defining module and skips when it can't, rather
+    than checking against the wrong posmap."""
+    out: Dict[str, List[_KernelSig]] = {}
+    for module in repo.modules:
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    parts = dotted_name(dec.func)
+                    if not parts or parts[-1] != "sentinel_jit":
+                        continue
+                    static = _static_argnames(dec)
+                    if static:
+                        params = [a.arg for a in node.args.args]
+                        out.setdefault(node.name, []).append(_KernelSig(
+                            _kernel_name(dec), static, params,
+                            module.name))
+            elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                parts = dotted_name(node.value.func)
+                if not parts or parts[-1] != "sentinel_jit":
+                    continue
+                static = _static_argnames(node.value)
+                if not static:
+                    continue
+                # resolve the wrapped fn's params when it is a local name
+                params: List[str] = []
+                if len(node.value.args) >= 2 and isinstance(
+                        node.value.args[1], ast.Name):
+                    fnode = module.funcs.get(node.value.args[1].id) or \
+                        next((n for q, n in module.funcs.items()
+                              if q.rsplit(".", 1)[-1]
+                              == node.value.args[1].id), None)
+                    if fnode is not None:
+                        params = [a.arg for a in fnode.args.args]
+                for tgt in node.targets:
+                    tparts = dotted_name(tgt)
+                    if tparts:
+                        out.setdefault(tparts[-1], []).append(_KernelSig(
+                            _kernel_name(node.value), static, params,
+                            module.name))
+    return out
+
+
+def _pick_sig(sigs: List[_KernelSig], module: Module,
+              repo: Repo, call: ast.Call) -> Optional[_KernelSig]:
+    """Disambiguate same-basename wrappers: unique sig wins; otherwise
+    prefer the one whose defining module the call resolves into (exact
+    call-graph edge), then the caller's own module; ambiguous -> None."""
+    if len(sigs) == 1:
+        return sigs[0]
+    cg = repo.callgraph()
+    cnode = module.enclosing_class(call)
+    cls = getattr(cnode, "_dl_qual", cnode.name) if cnode else None
+    exact, _fuzzy = cg.resolve_call(module, call, cls)
+    mods = {q.rsplit(".", 1)[0] for q in exact}
+    hits = [s for s in sigs if s.module in mods]
+    if len(hits) == 1:
+        return hits[0]
+    local = [s for s in sigs if s.module == module.name]
+    if len(local) == 1:
+        return local[0]
+    return None
+
+
+def _contains_ladder(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            parts = dotted_name(sub.func)
+            if parts and parts[-1] in LADDER_FUNCS:
+                return True
+    return False
+
+
+def _derives_from_data(expr: ast.AST) -> bool:
+    """True when the expression visibly mints a value from data size:
+    a len() call or a .shape access anywhere inside it."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id == "len":
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+            return True
+    return False
+
+
+def _local_assignment(module: Module, fn: ast.AST, qual: str,
+                      name: str) -> Optional[ast.AST]:
+    """The value expression of the (last) simple local assignment to
+    `name` inside `fn` — one dataflow hop."""
+    found: Optional[ast.AST] = None
+    for node in ast.walk(fn):
+        if module.qualname_of(node) != qual:
+            continue
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    found = node.value
+        elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name) and node.target.id == name:
+            found = node
+    return found
+
+
+class LadderShapeChecker(Checker):
+    name = "ladder-shape"
+    description = ("static args of sentinel kernels must not mint "
+                   "data-derived shapes without a ladder helper")
+
+    def check_repo(self, repo: Repo) -> List[Finding]:
+        kernels = collect_kernels(repo)
+        out: List[Finding] = []
+        for module in repo.modules:
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                parts = dotted_name(node.func)
+                if not parts:
+                    continue
+                sigs = kernels.get(parts[-1])
+                if not sigs:
+                    continue
+                sig = _pick_sig(sigs, module, repo, node)
+                if sig is None:
+                    continue
+                fn = module.enclosing_function(node)
+                qual = module.qualname_of(node)
+                for pname, expr in self._static_args(node, sig):
+                    bad = self._off_ladder(module, fn, qual, expr)
+                    if bad is None:
+                        continue
+                    f = module.finding(
+                        self.name, node,
+                        f"static arg {pname!r} of sentinel kernel "
+                        f"{sig.kernel!r} is minted from data size "
+                        f"({bad}) without a ladder helper — every novel "
+                        f"workload size will compile a novel program; "
+                        f"route it through _next_pow2/_pad_batch or a "
+                        f"declared ladder",
+                    )
+                    if f:
+                        out.append(f)
+        return out
+
+    @staticmethod
+    def _static_args(call: ast.Call, sig: _KernelSig
+                     ) -> List[Tuple[str, ast.AST]]:
+        pairs: List[Tuple[str, ast.AST]] = []
+        for kw in call.keywords:
+            if kw.arg in sig.static:
+                pairs.append((kw.arg, kw.value))
+        for i, arg in enumerate(call.args):
+            pname = sig.posmap.get(i)
+            if pname in sig.static:
+                pairs.append((pname, arg))
+        return pairs
+
+    def _off_ladder(self, module: Module, fn: Optional[ast.AST],
+                    qual: str, expr: ast.AST) -> Optional[str]:
+        """Why the expression is off-ladder, or None when it's fine."""
+        if _contains_ladder(expr):
+            return None
+        if _derives_from_data(expr):
+            return ast.unparse(expr)
+        # one hop: a bare local name assigned from a data-derived expr
+        if isinstance(expr, ast.Name) and fn is not None:
+            src = _local_assignment(module, fn, qual, expr.id)
+            if src is not None and not _contains_ladder(src) \
+                    and _derives_from_data(src):
+                return f"{expr.id} = {ast.unparse(src)}"
+        return None

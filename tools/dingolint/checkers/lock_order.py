@@ -1,0 +1,335 @@
+"""lock-order: extract lock acquisitions into a graph; flag cycles and
+declared-order reversals.
+
+The repo's locking discipline grew by accretion: ``store.device_lock``
+(PR 3) serializes every device mutation against donated-buffer searches;
+the wrapper lock (index/wrapper.py) serializes raft apply against
+rebuild swaps and is held AROUND device work (add -> store.put ->
+device_lock is the canonical nesting); the obs planes (hbm / flight /
+pressure / quality / integrity) each have a plane lock that must stay
+subordinate to the serving locks it observes (the integrity scrub and
+the quality shadow lane both take ``store.device_lock`` — if they did so
+while holding their plane lock, AND a serving path ever called into the
+plane while holding the device lock, two threads would deadlock in a way
+no unit test reproduces); the coalescer queue lock brackets admission
+accounting. None of this was written down as an order, so nothing
+stopped a new call site from inverting it.
+
+This checker derives the order instead of trusting convention: every
+``with <lock>`` region is classified into a lock *category* (static
+analysis can't see instances, but the categories — device lock, one per
+(class, attr) plane/queue/wrapper lock — are exactly the deadlock-
+relevant equivalence classes), nested acquisitions (lexical nesting plus
+calls whose transitive callees acquire) become edges, and the checker
+flags (a) any cycle among distinct categories, (b) a self-edge on a
+category backed by a non-reentrant ``threading.Lock`` (an RLock
+re-entering itself is legal; a plain Lock doing so is a guaranteed
+single-thread deadlock), and (c) reversals of the declared known-order
+pairs below.
+
+Resolution notes: receivers the analysis can't root (``e.lock`` on a
+loop variable) are skipped rather than guessed — a false alias would
+manufacture cycles. Transitive acquisition propagates over exact call
+edges PLUS capped fuzzy basename edges: cross-object lock nesting
+(``wrapper.add -> store.put -> device_lock``) is invisible to exact
+resolution, and an exact-only graph came back empty on the very repo
+whose discipline it exists to check. The callgraph's FUZZY_STOPLIST
+keeps builtin-collision names (``append``/``get``/...) from welding
+unrelated subsystems together; on the current tree the fuzzy graph has
+~54 edges and is verifiably acyclic.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.dingolint.callgraph import dotted_name
+from tools.dingolint.core import Checker, Finding, Module, Repo
+
+#: declared partial order: (outer, inner) pairs that are the sanctioned
+#: nesting — the REVERSED edge is a violation even without a full cycle
+#: (the cycle only materializes once both paths run concurrently, which
+#: is exactly too late). Derived from the PR 3 discipline: the wrapper
+#: lock wraps device work, never the other way; the coalescer queue lock
+#: and the obs plane locks are leaves with respect to the device lock.
+KNOWN_ORDER: List[Tuple[str, str]] = [
+    ("wrapper.VectorIndexWrapper._lock", "store.device_lock"),
+    ("integrity.IntegrityPlane._lock", "integrity.ArtifactLedger.lock"),
+]
+
+#: attrs that denote a lock when they terminate a with-item expression
+_LOCK_ATTRS = {"_lock", "lock", "_mu", "device_lock", "_device_lock"}
+
+
+def classify_lock(module: Module, node: ast.AST,
+                  cls: Optional[str]) -> Optional[str]:
+    """Map a with-item context expression to a lock category, or None
+    when it isn't a lock / can't be rooted confidently."""
+    parts = dotted_name(node)
+    if parts is None or len(parts) < 2:
+        return None
+    attr = parts[-1]
+    if attr not in _LOCK_ATTRS:
+        return None
+    if attr in ("device_lock", "_device_lock"):
+        # every SlotStore-family device lock shares one discipline (the
+        # sharded tier's _device_lock plays the same donation-safety role)
+        return "store.device_lock"
+    if parts[0] == "self" and len(parts) == 2 and cls is not None:
+        short = module.name.rsplit(".", 1)[-1]
+        return f"{short}.{cls}.{attr}"
+    # a known lock attr on a non-self receiver: root it only when the
+    # receiver is a module-level singleton name (METRICS, PRESSURE, ...)
+    if len(parts) == 2 and parts[0].isupper():
+        return f"{parts[0]}.{attr}"
+    return None
+
+
+class LockOrderChecker(Checker):
+    name = "lock-order"
+    description = ("lock-acquisition graph must stay acyclic and respect "
+                   "the declared nesting order")
+
+    def __init__(self):
+        #: (outer, inner) -> list of witness strings "path:line via ..."
+        self.edges: Dict[Tuple[str, str], List[str]] = {}
+        self._direct: Dict[str, Set[str]] = {}
+        self._reentrant: Set[str] = set()
+
+    # -- per-function direct acquisitions ---------------------------------
+    def _locks_in(self, module: Module, fn: ast.AST, qual: str
+                  ) -> List[Tuple[ast.With, str]]:
+        cg = self.repo.callgraph()
+        info = cg.funcs.get(f"{module.name}.{qual}")
+        cls = info.cls if info else None
+        out = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.With):
+                continue
+            if module.qualname_of(node) != qual:
+                continue  # belongs to a nested def
+            for item in node.items:
+                cat = classify_lock(module, item.context_expr, cls)
+                if cat:
+                    out.append((node, cat))
+        return out
+
+    def _collect_direct(self) -> None:
+        """Per-function directly-acquired categories + RLock census."""
+        cg = self.repo.callgraph()
+        for gqual, info in cg.funcs.items():
+            local = gqual[len(info.module.name) + 1:]
+            cats = {c for _, c in self._locks_in(info.module, info.node,
+                                                 local)}
+            if cats:
+                self._direct[gqual] = cats
+        # reentrancy census: self.<attr> = threading.RLock()
+        for module in self.repo.modules:
+            for node in ast.walk(module.tree):
+                if not (isinstance(node, ast.Assign) and len(node.targets)
+                        == 1 and isinstance(node.value, ast.Call)):
+                    continue
+                vparts = dotted_name(node.value.func)
+                if not vparts or vparts[-1] != "RLock":
+                    continue
+                tparts = dotted_name(node.targets[0])
+                if not tparts or tparts[0] != "self":
+                    continue
+                cnode = module.enclosing_class(node)
+                if cnode is None:
+                    continue
+                cat = classify_lock(module, node.targets[0],
+                                    getattr(cnode, "_dl_qual", cnode.name))
+                if cat:
+                    self._reentrant.add(cat)
+
+    def _transitive_closure(self) -> Dict[str, Set[str]]:
+        """Fixed-point transitive acquire sets. Kleene iteration rather
+        than recursive memoization: a recursive memo caches INCOMPLETE
+        closures for members of call-graph cycles (the cycle guard
+        returns an empty set mid-expansion, which then gets memoized),
+        silently dropping lock edges exactly where mutual recursion makes
+        the graph interesting. Iteration converges in a few passes (the
+        lock-set lattice is tiny) and is order-insensitive."""
+        cg = self.repo.callgraph()
+        acq: Dict[str, Set[str]] = {
+            q: set(s) for q, s in self._direct.items()
+        }
+        callees = {q: cg.callees(q, fuzzy=True) for q in cg.funcs}
+        changed = True
+        while changed:
+            changed = False
+            for q, cs in callees.items():
+                cur = acq.get(q)
+                for c in cs:
+                    extra = acq.get(c)
+                    if not extra:
+                        continue
+                    if cur is None:
+                        cur = acq[q] = set()
+                    before = len(cur)
+                    cur |= extra
+                    if len(cur) != before:
+                        changed = True
+        return acq
+
+    # -- edge extraction ---------------------------------------------------
+    def _add_edge(self, outer: str, inner: str, witness: str) -> None:
+        if outer == inner and outer in self._reentrant:
+            return
+        self.edges.setdefault((outer, inner), []).append(witness)
+
+    def _scan_function(self, module: Module, qual: str, fn: ast.AST,
+                       acq: Dict[str, Set[str]]) -> None:
+        cg = self.repo.callgraph()
+        gqual = f"{module.name}.{qual}"
+        info = cg.funcs.get(gqual)
+        cls = info.cls if info else None
+        withs = self._locks_in(module, fn, qual)
+        for wnode, outer in withs:
+            # multi-item `with a, b:` — later items acquire under earlier
+            cats = [classify_lock(module, i.context_expr, cls)
+                    for i in wnode.items]
+            cats = [c for c in cats if c]
+            for i, a in enumerate(cats):
+                for b in cats[i + 1:]:
+                    self._add_edge(a, b, f"{module.rel}:{wnode.lineno}")
+            for node in ast.walk(wnode):
+                if node is wnode:
+                    continue
+                if module.qualname_of(node) != qual:
+                    continue  # nested def body: defined, not run, here
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        inner = classify_lock(module, item.context_expr,
+                                              cls)
+                        if inner:
+                            self._add_edge(
+                                outer, inner,
+                                f"{module.rel}:{node.lineno}")
+                elif isinstance(node, ast.Call):
+                    exact, fuzzy = cg.resolve_call(module, node, cls)
+                    for callee in exact | fuzzy:
+                        for inner in acq.get(callee, ()):
+                            self._add_edge(
+                                outer, inner,
+                                f"{module.rel}:{node.lineno} via "
+                                f"{callee}")
+
+    # -- verdicts ----------------------------------------------------------
+    def check_repo(self, repo: Repo) -> List[Finding]:
+        self.repo = repo
+        self.edges.clear()
+        self._direct.clear()
+        self._reentrant.clear()
+        self._collect_direct()
+        acq = self._transitive_closure()
+        cg = repo.callgraph()
+        for gqual, info in cg.funcs.items():
+            local = gqual[len(info.module.name) + 1:]
+            self._scan_function(info.module, local, info.node, acq)
+
+        findings: List[Finding] = []
+        # (a) self-deadlock on a non-reentrant Lock
+        for (a, b), wits in sorted(self.edges.items()):
+            if a == b and a not in self._reentrant:
+                findings.append(Finding(
+                    self.name, wits[0].split(":")[0],
+                    int(wits[0].split(":")[1].split(" ")[0]), "",
+                    f"lock {a!r} re-acquired while held — it is a plain "
+                    f"threading.Lock (not RLock); this deadlocks the "
+                    f"holding thread",
+                ))
+        # (b) declared-order reversals
+        for outer, inner in KNOWN_ORDER:
+            wits = self.edges.get((inner, outer))
+            if wits:
+                findings.append(Finding(
+                    self.name, wits[0].split(":")[0],
+                    int(wits[0].split(":")[1].split(" ")[0]), "",
+                    f"lock order reversal: {inner!r} is held while "
+                    f"acquiring {outer!r}, but the sanctioned nesting is "
+                    f"{outer!r} -> {inner!r} (see KNOWN_ORDER in "
+                    f"tools/dingolint/checkers/lock_order.py)",
+                ))
+        # (c) cycles among distinct categories
+        findings.extend(self._cycle_findings())
+        # inline suppressions: the witness line owns the edge
+        kept = []
+        for f in findings:
+            mod = next((m for m in repo.modules if m.rel == f.path), None)
+            if mod is not None and mod.suppressed(f.lineno, self.name):
+                continue
+            kept.append(f)
+        return kept
+
+    def _cycle_findings(self) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+        # Tarjan SCC, iterative
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            work = [(v, iter(sorted(graph.get(v, ()))))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(sorted(graph.get(w, ())))))
+                        advanced = True
+                        break
+                    elif w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    low[work[-1][0]] = min(low[work[-1][0]], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(sorted(scc))
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        out: List[Finding] = []
+        for scc in sccs:
+            members = set(scc)
+            wits = [
+                w for (a, b), ws in sorted(self.edges.items())
+                if a in members and b in members and a != b for w in ws[:1]
+            ]
+            loc = wits[0] if wits else "dingo_tpu:0"
+            out.append(Finding(
+                self.name, loc.split(":")[0],
+                int(loc.split(":")[1].split(" ")[0]), "",
+                f"lock-order cycle among {scc}: these locks are acquired "
+                f"in both nesting orders — a deadlock needs only two "
+                f"concurrent threads (re-run with --json for every "
+                f"witness edge)",
+            ))
+        return out

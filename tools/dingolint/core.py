@@ -1,0 +1,280 @@
+"""Framework core: parsed modules, findings, suppressions, the runner.
+
+Design constraints that shaped this:
+
+- **One parse per file.** Every checker sees the same ``Module`` objects
+  (ast tree + source lines + scope index), so a full-repo run is
+  O(files) parses + O(checkers x nodes) walks — the whole tree lints in
+  low single-digit seconds, which is what keeps it tier-1-viable.
+- **Stable fingerprints.** Baseline entries must survive unrelated edits,
+  so a finding's identity is (checker, file, enclosing def qualname,
+  message) — never a line number. Line numbers are for humans reading
+  the report; moving a function 40 lines does not invalidate its
+  adjudication, editing its body in a way that changes the finding does.
+- **Suppression where the code is.** ``# dingolint: ok[checker] reason``
+  on the flagged line (or the line above, for long statements) marks a
+  deliberate exception next to the code it excuses; the baseline file is
+  for *pre-existing adjudicated* findings only, so new code either
+  complies or carries its reason inline in review.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+#: the linted source tree (tests/bench are runtime-gated, not invariants)
+SRC_DIRS = ("dingo_tpu",)
+
+#: inline suppression: ``# dingolint: ok`` (any checker) or
+#: ``# dingolint: ok[lock-order]`` / ``ok[host-sync,bare-jit]``, with an
+#: optional free-text reason after it
+_SUPPRESS_RE = re.compile(
+    r"#\s*dingolint:\s*ok(?:\[(?P<names>[a-z0-9_,\- ]+)\])?"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one site."""
+
+    checker: str
+    path: str          #: repo-relative path
+    lineno: int
+    symbol: str        #: enclosing def qualname ('' at module scope)
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        h = hashlib.sha1(
+            f"{self.checker}|{self.path}|{self.symbol}|{self.message}"
+            .encode()
+        ).hexdigest()
+        return h[:12]
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return (f"{self.path}:{self.lineno}: [{self.checker}]{sym} "
+                f"{self.message} ({self.fingerprint})")
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "checker": self.checker,
+            "path": self.path,
+            "line": self.lineno,
+            "symbol": self.symbol,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+
+class Module:
+    """One parsed source file plus the derived indexes checkers share."""
+
+    def __init__(self, path: str, rel: str, name: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.name = name            #: dotted module name
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        #: lineno -> suppressed checker names ('*' = all)
+        self._suppress: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                names = m.group("names")
+                self._suppress[i] = (
+                    {n.strip() for n in names.split(",")} if names else {"*"}
+                )
+        self._index_scopes()
+
+    # -- scope / qualname indexing ----------------------------------------
+    def _index_scopes(self) -> None:
+        """Annotate every node with its parent and every def/class with a
+        module-relative qualname (``Class.method``, ``fn.inner``)."""
+        self.funcs: Dict[str, ast.AST] = {}
+
+        def visit(node: ast.AST, qual: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                child._dl_parent = node  # type: ignore[attr-defined]
+                cq = qual
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    cq = f"{qual}.{child.name}" if qual else child.name
+                    child._dl_qual = cq  # type: ignore[attr-defined]
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        self.funcs[cq] = child
+                visit(child, cq)
+
+        self.tree._dl_parent = None  # type: ignore[attr-defined]
+        visit(self.tree, "")
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_dl_parent", None)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parent(cur)
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self.parent(cur)
+        return None
+
+    def qualname_of(self, node: ast.AST) -> str:
+        """Qualname of the def enclosing `node` ('' at module scope)."""
+        fn = node if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) else self.enclosing_function(node)
+        if fn is None:
+            return ""
+        return getattr(fn, "_dl_qual", fn.name)
+
+    def suppressed(self, lineno: int, checker: str) -> bool:
+        for ln in (lineno, lineno - 1):
+            names = self._suppress.get(ln)
+            if names and ("*" in names or checker in names):
+                return True
+        return False
+
+    def finding(self, checker: str, node: ast.AST, message: str
+                ) -> Optional[Finding]:
+        """Mint a finding at `node` unless inline-suppressed."""
+        lineno = getattr(node, "lineno", 0)
+        if self.suppressed(lineno, checker):
+            return None
+        return Finding(checker, self.rel, lineno,
+                       self.qualname_of(node), message)
+
+
+@dataclass
+class Repo:
+    """The full parsed source set, shared by every checker."""
+
+    root: str
+    modules: List[Module] = field(default_factory=list)
+
+    def __post_init__(self):
+        self.by_name: Dict[str, Module] = {}
+        self._callgraph = None
+
+    def add(self, module: Module) -> None:
+        self.modules.append(module)
+        self.by_name[module.name] = module
+
+    def callgraph(self):
+        """Lazily-built shared call graph (tools.dingolint.callgraph)."""
+        if self._callgraph is None:
+            from tools.dingolint.callgraph import CallGraph
+
+            self._callgraph = CallGraph(self)
+        return self._callgraph
+
+
+class Checker:
+    """Base checker. Subclasses set ``name``/``description`` and override
+    ``check_module`` (per-file) and/or ``check_repo`` (inter-procedural;
+    runs once after every module has been parsed)."""
+
+    name: str = "checker"
+    description: str = ""
+
+    def check_module(self, module: Module, repo: Repo) -> List[Finding]:
+        return []
+
+    def check_repo(self, repo: Repo) -> List[Finding]:
+        return []
+
+
+# -- loading ---------------------------------------------------------------
+
+def _module_name(root: str, path: str) -> str:
+    rel = os.path.relpath(path, root)
+    return rel[:-3].replace(os.sep, ".")
+
+
+def load_repo(root: str = REPO_ROOT,
+              src_dirs: Sequence[str] = SRC_DIRS) -> Repo:
+    repo = Repo(root)
+    for src in src_dirs:
+        base = os.path.join(root, src)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                with open(path) as f:
+                    source = f.read()
+                try:
+                    repo.add(Module(path, os.path.relpath(path, root),
+                                    _module_name(root, path), source))
+                except SyntaxError:
+                    # un-parseable files fail tier-1 imports long before
+                    # the lint would — skip rather than crash the run
+                    continue
+    return repo
+
+
+def load_paths(paths: Iterable[str], root: Optional[str] = None) -> Repo:
+    """Build a Repo from explicit files (fixture tests, --paths runs)."""
+    paths = list(paths)
+    root = root or (os.path.dirname(os.path.abspath(paths[0]))
+                    if paths else REPO_ROOT)
+    repo = Repo(root)
+    for path in paths:
+        path = os.path.abspath(path)
+        with open(path) as f:
+            source = f.read()
+        rel = os.path.relpath(path, root)
+        repo.add(Module(path, rel, _module_name(root, path), source))
+    return repo
+
+
+# -- running ---------------------------------------------------------------
+
+def run_checkers(repo: Repo, checkers: Sequence[Checker]
+                 ) -> List[Finding]:
+    findings: List[Finding] = []
+    for checker in checkers:
+        for module in repo.modules:
+            findings.extend(checker.check_module(module, repo))
+        findings.extend(checker.check_repo(repo))
+    findings.sort(key=lambda f: (f.path, f.lineno, f.checker))
+    return findings
+
+
+def lint_repo(root: str = REPO_ROOT,
+              checkers: Optional[Sequence[Checker]] = None
+              ) -> Tuple[Repo, List[Finding]]:
+    from tools.dingolint.checkers import all_checkers
+
+    repo = load_repo(root)
+    cs = list(checkers) if checkers is not None else all_checkers()
+    return repo, run_checkers(repo, cs)
+
+
+def lint_paths(paths: Iterable[str],
+               checkers: Optional[Sequence[Checker]] = None
+               ) -> List[Finding]:
+    from tools.dingolint.checkers import all_checkers
+
+    repo = load_paths(paths)
+    cs = list(checkers) if checkers is not None else all_checkers()
+    return run_checkers(repo, cs)

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""dingolint entry point: run the repo-native invariant checkers.
+
+Usage:
+    python tools/lint.py                  # human-readable report, exit 1
+                                          # on unbaselined findings
+    python tools/lint.py --json           # machine-readable (CI / diffing)
+    python tools/lint.py --baseline-update  # rewrite baseline.json from
+                                          # the current findings (existing
+                                          # rationales preserved; new
+                                          # entries get a TODO that fails
+                                          # the lint until adjudicated)
+    python tools/lint.py --checker bare-jit --checker host-sync
+
+Exit status 0 iff: no unbaselined findings, no baseline entry without a
+rationale. Stale baseline entries (their code was fixed) are warnings.
+Wall time is always reported — the full-repo pass must stay under ~30s
+to remain tier-1-viable (tests/test_dingolint.py asserts it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from tools.dingolint import baseline as bl  # noqa: E402
+from tools.dingolint import checkers as reg  # noqa: E402
+from tools.dingolint.core import REPO_ROOT, lint_repo  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="rewrite baseline.json from current findings")
+    ap.add_argument("--checker", action="append", default=None,
+                    help="run only the named checker(s)")
+    ap.add_argument("--baseline", default=bl.BASELINE_PATH,
+                    help="baseline file (default: %(default)s)")
+    ap.add_argument("--root", default=REPO_ROOT, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    t0 = time.monotonic()
+    checkers = reg.by_name(args.checker) if args.checker else None
+    repo, findings = lint_repo(args.root, checkers)
+    base = bl.load(args.baseline)
+    new, matched, unrationalized, stale = bl.split(findings, base)
+    wall_s = time.monotonic() - t0
+
+    if args.baseline_update:
+        entries = bl.updated_entries(findings, base)
+        if args.checker:
+            # partial run: entries owned by checkers that did NOT run
+            # carry over untouched — updating one checker's baseline must
+            # never delete another's adjudications
+            ran = {c.name for c in checkers}
+            have = {e["fingerprint"] for e in entries}
+            entries += [e for fp, e in base.items()
+                        if e.get("checker") not in ran and fp not in have]
+        bl.save(entries, args.baseline)
+        todo = sum(1 for e in entries
+                   if e["rationale"].startswith("TODO"))
+        print(f"baseline updated: {len(entries)} entr"
+              f"{'y' if len(entries) == 1 else 'ies'}, {todo} TODO "
+              f"rationale(s) to adjudicate, {len(stale)} stale dropped "
+              f"({wall_s:.1f}s)")
+        return 0
+
+    ok = not new and not unrationalized
+    if args.as_json:
+        print(json.dumps({
+            "ok": ok,
+            "files": len(repo.modules),
+            "checkers": [c.name for c in (checkers
+                                          or reg.all_checkers())],
+            "wall_s": round(wall_s, 2),
+            "findings": [f.to_json() for f in new],
+            "baselined": [f.to_json() for f in matched],
+            "unrationalized_baseline": unrationalized,
+            "stale_baseline": stale,
+        }, indent=2))
+        return 0 if ok else 1
+
+    for f in new:
+        print(f.render(), file=sys.stderr)
+    for e in unrationalized:
+        print(f"baseline entry {e['fingerprint']} ({e['location']}) has "
+              f"no rationale — adjudicate it or fix the code",
+              file=sys.stderr)
+    for e in stale:
+        print(f"note: stale baseline entry {e['fingerprint']} "
+              f"({e['location']}) no longer matches — run "
+              f"--baseline-update to drop it")
+    status = "OK" if ok else f"{len(new) + len(unrationalized)} problem(s)"
+    print(f"dingolint: {status} — {len(repo.modules)} files, "
+          f"{len(findings)} finding(s) ({len(matched)} baselined), "
+          f"{wall_s:.1f}s wall")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
