@@ -854,6 +854,40 @@ def integrity_scrub(platform):
     return out
 
 
+def chaos(platform):
+    """ISSUE 14 bench arm: the deterministic chaos suite (tools/chaos.py)
+    as a gated scenario — kill/restart, leader failover, partition+heal,
+    device-OOM storm, flipped byte. The pass/fail verdict is the product;
+    max_recovery_ms and min_goodput are the bench_diff-gated aggregates."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.chaos import run_scenarios
+
+    out = run_scenarios(seed=0)
+    log(
+        f"chaos: {'PASS' if out['passed'] else 'FAIL'} "
+        f"max_recovery={out['max_recovery_ms']:.0f}ms "
+        f"min_goodput={out['min_goodput']:.3f} "
+        f"({len(out['scenarios'])} scenarios)"
+    )
+    # bench-schema surface: one row per scenario with the gated figures;
+    # the full per-gate detail rides in tools/chaos.py --json runs
+    return {
+        "passed": out["passed"],
+        "max_recovery_ms": out["max_recovery_ms"],
+        "min_goodput": out["min_goodput"],
+        "scenarios": {
+            r["name"]: {
+                "passed": r["passed"],
+                "recovery_ms": r.get("recovery_ms", 0.0),
+                **({"goodput": r["goodput"]} if "goodput" in r else {}),
+                **({"steady_recompiles": r["steady_recompiles"]}
+                   if "steady_recompiles" in r else {}),
+            }
+            for r in out["scenarios"]
+        },
+    }
 
 
 def _mesh_corpus(n, d, seed=5):
@@ -1533,6 +1567,9 @@ def main():
     #     (ISSUE 11) ---
     integ = integrity_scrub(platform)
 
+    # --- chaos: deterministic fault scenarios with gates (ISSUE 14) ---
+    cha = chaos(platform)
+
     # --- CPU baseline: numpy/OpenBLAS IVF-flat with same layout ---
     centroids = np.asarray(idx.centroids)
     assign = idx._assign_h[np.asarray(idx.store.slots_of(ids))]
@@ -1643,6 +1680,11 @@ def main():
         # injected-corruption detection arm (scrub catches a single
         # flipped byte, counter + flight bundle)
         "integrity_scrub": integ,
+        # chaos suite (ISSUE 14): kill/restart, leader failover,
+        # partition+heal, OOM storm, flipped byte — every scenario gated
+        # on zero acked-write loss (digest-verified), bounded recovery,
+        # the goodput floor, and zero steady-state recompiles
+        "chaos": cha,
     }
     if platform == "tpu":
         result["measured_at"] = time.time()
@@ -1668,6 +1710,15 @@ if __name__ == "__main__":
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps({"integrity_scrub": integrity_scrub("cpu")}))
         sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] in ("chaos", "--chaos"):
+        # standalone: the chaos suite (acceptance smoke); exits non-zero
+        # when any scenario gate is violated
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        out = chaos("cpu")
+        print(json.dumps({"chaos": out}))
+        sys.exit(0 if out["passed"] else 1)
     if len(sys.argv) >= 2 and sys.argv[1] == "--overload":
         # standalone: just the QoS overload arms (acceptance smoke)
         import jax

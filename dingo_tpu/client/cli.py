@@ -119,6 +119,10 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
                 # this replica's own scrub caught its device state
                 # disagreeing with the incremental ledger
                 flags.append("CORRUPT")
+            if getattr(r, "device_degraded", False):
+                # device index lost to OOM: serving host-exact until the
+                # background re-materialization lands (index/recovery.py)
+                flags.append("DEV-DEGRADED")
             region_rows.append([
                 str(r.region_id),
                 entry.store_id,

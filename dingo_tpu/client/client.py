@@ -11,12 +11,12 @@ SURVEY.md §5).
 from __future__ import annotations
 
 import threading
-import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import grpc
 import numpy as np
 
+from dingo_tpu.client import retry as retry_mod
 from dingo_tpu.common.coord_channel import RotatingCoordinatorChannel
 from dingo_tpu.index import codec as vcodec
 from dingo_tpu.server import pb
@@ -27,6 +27,11 @@ from dingo_tpu.raft import wire
 
 class ClientError(RuntimeError):
     pass
+
+
+class _HedgeMiss(ClientError):
+    """Internal: the hedged fast path didn't settle the call (stale
+    leader hint, follower rejected) — fall back to the rotation loop."""
 
 
 class _CoordServiceFacade:
@@ -56,6 +61,7 @@ class DingoClient:
             self._coord_channel, "VersionService")
         self.meta = _CoordServiceFacade(self._coord_channel, "MetaService")
         self._store_addrs = dict(store_addrs)
+        self._retry = retry_mod.RetryPolicy.from_flags(rounds=4)
         self._channels: Dict[str, grpc.Channel] = {}
         self._regions: List = []           # RegionDefinition list
         self._leader_hint: Dict[int, str] = {}
@@ -98,34 +104,70 @@ class DingoClient:
                 return d
         raise ClientError(f"no region covers vector id {vector_id}")
 
-    def _call_leader(self, definition, service: str, method: str, req,
-                     retries: int = 4):
-        """Leader routing with NotLeader retry (SDK behavior)."""
+    def _leader_order(self, definition) -> List[str]:
         order = [self._leader_hint.get(definition.region_id)] if \
             self._leader_hint.get(definition.region_id) else []
         order += [p for p in definition.peers if p not in order]
-        last_err = None
-        for _ in range(retries):
-            for store_id in order:
-                stub = self._stub(store_id, service)
-                resp = getattr(stub, method)(req)
-                code = resp.error.errcode
-                if code == 0:
-                    self._leader_hint[definition.region_id] = store_id
-                    return resp
-                last_err = resp.error.errmsg
-                if code == 20001 and ":" in resp.error.errmsg:
-                    hint = resp.error.errmsg.split(":")[-1].strip()
-                    if "/" in hint:
-                        self._leader_hint[definition.region_id] = \
-                            hint.split("/")[0]
-                if code not in (20001, 10001):
-                    # application error from the node that actually served
-                    # the request (lock conflict, validation, ...): rotating
-                    # peers can't change the answer — fail fast
-                    raise ClientError(f"{method}: {resp.error.errmsg}")
-            time.sleep(0.1)
-        raise ClientError(f"no leader accepted {method}: {last_err}")
+        return order
+
+    def _call_leader(self, definition, service: str, method: str, req,
+                     retries: int = 4, hedge: bool = False):
+        """Leader routing with NotLeader retry (SDK behavior), through the
+        shared RetryPolicy: grpc never-served failures rotate with
+        equal-jitter backoff + per-store circuit breaker, in-band NotLeader
+        (20001, updating the leader hint from the errmsg) and region-busy
+        (10001) rotate, any other application error fails fast — the node
+        that actually served the request answered (lock conflict,
+        validation, ...) and rotating peers can't change the answer.
+
+        ``hedge=True`` (idempotent reads only) additionally races a
+        second attempt at the next peer after a p99-derived delay when
+        retry.hedge_enabled — falling back to the plain rotation loop if
+        the hedged pair can't settle it (stale hint, follower rejects)."""
+        order = self._leader_order(definition)
+        last_store = {}
+
+        def _attempt(store_id, attempt):
+            last_store["id"] = store_id
+            stub = self._stub(store_id, service)
+            return getattr(stub, method)(
+                req, metadata=retry_mod.attempt_metadata(attempt))
+
+        def _classify(resp):
+            code = resp.error.errcode
+            if code == 0:
+                self._leader_hint[definition.region_id] = \
+                    last_store.get("id")
+                return retry_mod.OK
+            if code == 20001 and ":" in resp.error.errmsg:
+                hint = resp.error.errmsg.split(":")[-1].strip()
+                if "/" in hint:
+                    self._leader_hint[definition.region_id] = \
+                        hint.split("/")[0]
+            if code in (20001, 10001):
+                return (retry_mod.ROTATE, resp.error.errmsg)
+            return (retry_mod.FATAL, resp.error.errmsg)
+
+        if hedge and len(order) >= 2 and self._hedge_enabled():
+            try:
+                return self._retry.call_hedged(
+                    order, _attempt, classify=_classify, op=method,
+                    error_cls=_HedgeMiss)
+            except _HedgeMiss:
+                pass   # stale hint / slow pair: the rotation loop decides
+        # NotLeader rotation waits on raft elections (O(100ms)), not on
+        # transport blips — scale the round gap to the election, matching
+        # the reference SDK's fixed 100ms inter-round sleep
+        return self._retry.call(
+            order, _attempt, classify=_classify, op=method,
+            error_cls=ClientError, idempotent=True, rounds=retries,
+            base_backoff_ms=100.0)
+
+    @staticmethod
+    def _hedge_enabled() -> bool:
+        from dingo_tpu.common.config import FLAGS
+
+        return bool(FLAGS.get("retry_hedge_enabled"))
 
     # ---------------- admin ----------------
     def create_index_region(self, partition_id: int, id_lo: int, id_hi: int,
@@ -530,7 +572,8 @@ class DingoClient:
                 req.parameter.filter_type = params["filter_type"]
             if "coprocessor" in params:   # pb.Coprocessor (TABLE filter)
                 req.parameter.coprocessor.CopyFrom(params["coprocessor"])
-            resp = self._call_leader(d, "IndexService", "VectorSearch", req)
+            resp = self._call_leader(d, "IndexService", "VectorSearch", req,
+                                     hedge=True)
             for qi, row in enumerate(resp.batch_results):
                 for item in row.results:
                     merged[qi].append((item.vector.id, item.distance))

@@ -375,6 +375,48 @@ FLAGS.define("integrity_flight_on_divergence", True, mutable=True,
                    "the coordinator sees replicas diverge at equal "
                    "applied indices; the bundle carries the digest "
                    "vectors of both sides")
+FLAGS.define("retry_rounds", 3, mutable=True,
+             help_="full target-rotation rounds the client RetryPolicy "
+                   "makes before giving up (each round tries every "
+                   "non-breaker-open target once)")
+FLAGS.define("retry_base_backoff_ms", 25.0, mutable=True,
+             help_="base of the equal-jitter backoff between rotation "
+                   "rounds: sleep ~ d/2 + U(0, d/2) where "
+                   "d = min(cap, base*2^round) — the d/2 floor guarantees "
+                   "an election-scale wait actually happens while the "
+                   "jitter half spreads the herd; always clamped to the "
+                   "request's remaining deadline budget")
+FLAGS.define("retry_max_backoff_ms", 1000.0, mutable=True,
+             help_="cap of the equal-jitter backoff between rounds")
+FLAGS.define("retry_breaker_threshold", 5, mutable=True,
+             help_="consecutive connection-level failures that open a "
+                   "target's circuit breaker (in-band responses — even "
+                   "NotLeader — count as success: the endpoint is alive)")
+FLAGS.define("retry_breaker_cooldown_s", 5.0, mutable=True,
+             help_="how long an open breaker skips its target before "
+                   "admitting one half-open probe")
+FLAGS.define("retry_hedge_enabled", False, mutable=True,
+             help_="hedged reads: fire a second VectorSearch attempt at "
+                   "the next replica when the primary hasn't answered "
+                   "within its p99-derived delay; first success wins. "
+                   "Idempotent reads only, budget-gated, attempts "
+                   "stamped with x-dingo-attempt")
+FLAGS.define("retry_hedge_min_delay_ms", 5.0, mutable=True,
+             help_="floor of the hedge delay (covers the cold start "
+                   "before enough latency samples exist for a p99)")
+FLAGS.define("device_recovery_enabled", True, mutable=True,
+             help_="graduated HBM OOM recovery ladder (index/recovery.py): "
+                   "on an OOM during device write/search, drop rerank "
+                   "caches, evict blocked/adjacency mirrors, retry once; "
+                   "if still OOM, mark the region device-degraded (served "
+                   "by the host exact path) and schedule background "
+                   "re-materialization at lower precision. Off = OOMs "
+                   "propagate raw")
+FLAGS.define("device_recovery_remat_precision", "sq8", mutable=True,
+             help_="precision tier the background re-materialization "
+                   "rebuilds a device-degraded region at (advisory-lower "
+                   "than the configured tier; the region definition keeps "
+                   "its declared precision)")
 FLAGS.define("vector_blocked_layout", "auto", mutable=True,
              help_="maintain a dimension-blocked ([n_blocks, capacity, "
                    "block_d]) scan mirror + per-block norms in float/sq8 "

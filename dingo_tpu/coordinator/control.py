@@ -266,6 +266,26 @@ class CoordinatorControl:
                             region_log(_log, j.region_id).warning(
                                 "cmd %d type=%s dropped after %d failures",
                                 j.cmd_id, j.cmd_type.value, 5)
+                            # a dropped command is a silent topology-change
+                            # failure (split/merge/peer move never happens)
+                            # — make it loud: counter + flight bundle
+                            from dingo_tpu.common.metrics import METRICS
+                            from dingo_tpu.obs.flight import FLIGHT
+
+                            METRICS.counter(
+                                "fault.cmd_retry_exhausted",
+                                region_id=j.region_id,
+                            ).add(1)
+                            FLIGHT.trigger(
+                                "cmd_retry_exhausted",
+                                name=f"cmd_{j.cmd_id}_"
+                                     f"{j.cmd_type.value}",
+                                region_id=j.region_id,
+                                extra={"cmd_id": j.cmd_id,
+                                       "cmd_type": str(j.cmd_type.value),
+                                       "store_id": store_id,
+                                       "retries": 5},
+                            )
             # stalled: delivery landed somewhere that cannot act YET (e.g.
             # region mid-election, requeue RPC failed) — re-arm without
             # charging the poison budget; leadership churn is not a

@@ -59,12 +59,20 @@ class VectorIndexManager:
 
     # ---------------- build ----------------
     def build_index(self, region: Region,
-                    raft_log: Optional[RaftLog] = None) -> VectorIndex:
+                    raft_log: Optional[RaftLog] = None,
+                    param_override: Optional[IndexParameter] = None
+                    ) -> VectorIndex:
         """BuildVectorIndex (vector_index_manager.cc:864): full scan of the
-        region data CF -> fresh index (+train for IVF types)."""
+        region data CF -> fresh index (+train for IVF types).
+
+        `param_override` builds with a modified parameter (the device-
+        recovery re-materialization narrows precision this way) WITHOUT
+        touching the region definition — the declared parameter stays the
+        target the next ordinary rebuild returns to."""
         wrapper = region.vector_index_wrapper
         assert wrapper is not None
-        param = region.definition.index_parameter
+        param = param_override if param_override is not None \
+            else region.definition.index_parameter
         index = new_index(region.id, param)
         reader = self._reader(region)
 
@@ -125,7 +133,8 @@ class VectorIndexManager:
                 wrapper.is_switching = False
 
     def rebuild(self, region: Region,
-                raft_log: Optional[RaftLog] = None) -> bool:
+                raft_log: Optional[RaftLog] = None,
+                param_override: Optional[IndexParameter] = None) -> bool:
         """LaunchRebuildVectorIndex -> RebuildVectorIndex (:1062): build +
         multi-round WAL catch-up + atomic switch (:1149). Returns False
         when a rebuild of THIS region is already in flight (atomic
@@ -149,7 +158,8 @@ class VectorIndexManager:
                 # no write lands between the scan and the switch (otherwise
                 # the fresh index would silently miss it forever).
                 with wrapper._lock:
-                    index = self.build_index(region, raft_log)
+                    index = self.build_index(region, raft_log,
+                                             param_override=param_override)
                     index.apply_log_id = wrapper.apply_log_id
                     wrapper.own_index = index
                     wrapper.ready = True
@@ -157,7 +167,8 @@ class VectorIndexManager:
                     wrapper.share_index = None
                 return True
             start_log_id = wrapper.apply_log_id
-            index = self.build_index(region, raft_log)
+            index = self.build_index(region, raft_log,
+                                     param_override=param_override)
             index.apply_log_id = start_log_id
             self._catch_up_and_install(wrapper, index, region, raft_log)
             return True

@@ -389,14 +389,120 @@ class VectorReader:
         self, queries: np.ndarray, topk: int, spec: FilterSpec, **kw
     ) -> List[SearchResult]:
         """SearchAndRangeSearchWrapper (:1781): index search when the wrapper
-        is ready and supports it, else brute-force scan (:1873)."""
+        is ready and supports it, else brute-force scan (:1873). A
+        device-degraded region (index/recovery.py) serves the exact host
+        path instead; a device OOM mid-search walks the recovery ladder
+        and falls back to the host path if the region degrades."""
+        from dingo_tpu.index.recovery import RECOVERY, DeviceDegraded
+
         wrapper = self.ctx.index_wrapper
+        if wrapper is not None and RECOVERY.is_degraded(self.ctx.region_id):
+            return self._host_exact_search(queries, topk, spec)
         if wrapper is not None and wrapper.is_ready():
             try:
                 return wrapper.search(queries, topk, spec, **kw)
             except (NotSupported, NotTrained):
                 pass  # EVECTOR_NOT_SUPPORT contract -> brute force
+            except Exception as e:  # noqa: BLE001 — OOM-classified below
+                from dingo_tpu.obs.hbm import looks_like_oom
+
+                if not (looks_like_oom(e) and RECOVERY.enabled()):
+                    raise
+                try:
+                    return RECOVERY.attempt(
+                        wrapper, self.ctx.region_id,
+                        lambda: wrapper.search(queries, topk, spec, **kw),
+                        kind="search", cause=e)
+                except DeviceDegraded:
+                    return self._host_exact_search(queries, topk, spec)
         return self._brute_force_search(queries, topk, spec)
+
+    def _host_exact_search(
+        self, queries: np.ndarray, topk: int, spec: FilterSpec
+    ) -> List[SearchResult]:
+        """Degraded-mode serving: exact scan over ENGINE rows in pure
+        numpy — no device arrays at all (the brute-force path builds a
+        temp DEVICE flat index, which is exactly what just OOMed). Slower,
+        but full search parity: the engine is the source of truth and
+        holds every acknowledged write, including those applied while the
+        device index was degraded."""
+        from dingo_tpu.ops.distance import Metric, metric_ascending
+
+        param = self.ctx.parameter
+        if param is None:
+            raise VectorIndexError("host exact search needs index parameter")
+        with TRACER.start_span("index.host_exact") as span:
+            span.set_attr("region_id", self.ctx.region_id)
+            lo, hi = self.ctx.id_window()
+            ids_l: List[int] = []
+            rows: List[np.ndarray] = []
+            for vid, blob in self._scan_data(lo, hi):
+                ids_l.append(vid)
+                rows.append(self._deser(blob))
+            span.set_attr("rows", len(ids_l))
+            nq = len(queries)
+            empty = SearchResult(np.empty(0, np.int64),
+                                 np.empty(0, np.float32))
+            if not ids_l:
+                return [empty for _ in range(nq)]
+            ids = np.asarray(ids_l, np.int64)
+            valid = self._spec_mask(ids, spec)
+            metric = param.metric
+            if self._binary:
+                db = np.unpackbits(np.stack(rows).astype(np.uint8), axis=1)
+                qb = np.unpackbits(
+                    np.asarray(queries, np.uint8).reshape(nq, -1), axis=1)
+                # hamming distance via dot products over {0,1} planes
+                scores = -(
+                    qb @ (1 - db).T.astype(np.float32)
+                    + (1 - qb) @ db.T.astype(np.float32)
+                )
+            else:
+                vecs = np.stack(rows).astype(np.float32)
+                q = np.asarray(queries, np.float32)
+                if metric is Metric.L2:
+                    scores = -(
+                        (q ** 2).sum(1)[:, None]
+                        - 2.0 * q @ vecs.T
+                        + (vecs ** 2).sum(1)[None, :]
+                    )
+                else:
+                    # COSINE rows are stored normalized (write-side prep):
+                    # inner product IS the cosine similarity
+                    scores = q @ vecs.T
+            scores = np.where(valid[None, :], scores, -np.inf)
+            kk = min(int(topk), scores.shape[1])
+            part = np.argpartition(-scores, kk - 1, axis=1)[:, :kk]
+            vals = np.take_along_axis(scores, part, axis=1)
+            order = np.argsort(-vals, axis=1)
+            part = np.take_along_axis(part, order, axis=1)
+            vals = np.take_along_axis(vals, order, axis=1)
+            out: List[SearchResult] = []
+            for qi in range(nq):
+                keep = ~np.isneginf(vals[qi])
+                d = vals[qi][keep]
+                d = -d if metric_ascending(metric) else d
+                out.append(SearchResult(ids[part[qi][keep]],
+                                        np.asarray(d, np.float32)))
+            return out
+
+    @staticmethod
+    def _spec_mask(ids: np.ndarray, spec: Optional[FilterSpec]) -> np.ndarray:
+        """FilterSpec evaluated against external ids (the host path has no
+        slot space)."""
+        mask = np.ones(len(ids), np.bool_)
+        if spec is None or spec.is_empty():
+            return mask
+        if spec.ranges:
+            rm = np.zeros(len(ids), np.bool_)
+            for lo, hi in spec.ranges:
+                rm |= (ids >= lo) & (ids < hi)
+            mask &= rm
+        if spec.include_ids is not None:
+            mask &= np.isin(ids, np.asarray(spec.include_ids, np.int64))
+        if spec.exclude_ids is not None:
+            mask &= ~np.isin(ids, np.asarray(spec.exclude_ids, np.int64))
+        return mask
 
     def _brute_force_search(
         self, queries: np.ndarray, topk: int, spec: FilterSpec

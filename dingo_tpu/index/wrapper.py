@@ -118,23 +118,42 @@ class VectorIndexWrapper:
                 return
             if log_id != 0 and log_id <= self.apply_log_id:
                 return  # already materialized (snapshot load or replay)
-            with self._integrity_bracket(idx):
-                if is_upsert:
-                    idx.upsert(ids, vectors)
-                else:
-                    idx.add(ids, vectors)
-                # post-merge: purge absorbed-range versions from the
-                # sibling so search's sibling merge can't resurrect stale
-                # vectors
-                sib = (self.sibling_index.active()
-                       if self.sibling_index else None)
-                if sib is not None and sib is not idx:
-                    sib.delete(ids)
-                if log_id:
-                    self.apply_log_id = log_id
-                    if idx is self.own_index:
-                        idx.apply_log_id = log_id
-                        self._tag_integrity(idx, log_id)
+            from dingo_tpu.index.recovery import RECOVERY, DeviceDegraded
+
+            if RECOVERY.is_degraded(self.id):
+                # engine (raft/WAL) holds the write; the device index is
+                # awaiting re-materialization. apply_log_id does NOT
+                # advance — replica digest comparisons happen at equal
+                # applied indices, and this index's state describes the
+                # LAST advanced log id, not this write.
+                return
+
+            def _mutate():
+                with self._integrity_bracket(idx):
+                    if is_upsert:
+                        idx.upsert(ids, vectors)
+                    else:
+                        idx.add(ids, vectors)
+                    # post-merge: purge absorbed-range versions from the
+                    # sibling so search's sibling merge can't resurrect
+                    # stale vectors
+                    sib = (self.sibling_index.active()
+                           if self.sibling_index else None)
+                    if sib is not None and sib is not idx:
+                        sib.delete(ids)
+                    if log_id:
+                        self.apply_log_id = log_id
+                        if idx is self.own_index:
+                            idx.apply_log_id = log_id
+                            self._tag_integrity(idx, log_id)
+
+            try:
+                # a device OOM walks the recovery ladder (drop rerank ->
+                # evict mirrors -> retry); mutations are upserts/deletes,
+                # idempotent, so the whole block re-applies safely
+                RECOVERY.attempt(self, self.id, _mutate, kind="write")
+            except DeviceDegraded:
+                return
             self.write_count += len(ids)
 
     def _integrity_bracket(self, idx):
@@ -181,17 +200,28 @@ class VectorIndexWrapper:
                 return
             if log_id != 0 and log_id <= self.apply_log_id:
                 return
-            with self._integrity_bracket(idx):
-                idx.delete(ids)
-                sib = (self.sibling_index.active()
-                       if self.sibling_index else None)
-                if sib is not None and sib is not idx:
-                    sib.delete(ids)
-                if log_id:
-                    self.apply_log_id = log_id
-                    if idx is self.own_index:
-                        idx.apply_log_id = log_id
-                        self._tag_integrity(idx, log_id)
+            from dingo_tpu.index.recovery import RECOVERY, DeviceDegraded
+
+            if RECOVERY.is_degraded(self.id):
+                return   # same contract as add(): engine keeps the delete
+
+            def _mutate():
+                with self._integrity_bracket(idx):
+                    idx.delete(ids)
+                    sib = (self.sibling_index.active()
+                           if self.sibling_index else None)
+                    if sib is not None and sib is not idx:
+                        sib.delete(ids)
+                    if log_id:
+                        self.apply_log_id = log_id
+                        if idx is self.own_index:
+                            idx.apply_log_id = log_id
+                            self._tag_integrity(idx, log_id)
+
+            try:
+                RECOVERY.attempt(self, self.id, _mutate, kind="write")
+            except DeviceDegraded:
+                return
             self.write_count += len(ids)
 
     # -- reads ---------------------------------------------------------------

@@ -198,6 +198,9 @@ class StoreMetricsCollector:
         rm.integrity_applied_index = applied
         rm.integrity_digests = digests
         rm.integrity_mismatch = mismatch
+        from dingo_tpu.index.recovery import RECOVERY
+
+        rm.device_degraded = RECOVERY.is_degraded(region.id)
         last = INTEGRITY.last_verified_ms(region.id)
         self.registry.gauge(
             "consistency.digest_age_s", region.id

@@ -63,6 +63,10 @@ class RegionMetricsSnapshot:
     integrity_applied_index: int = 0
     integrity_digests: str = ""
     integrity_mismatch: bool = False
+    #: device-recovery plane (index/recovery.py): the region's device
+    #: index OOMed past the ladder and serves host-exact until the
+    #: background re-materialization lands
+    device_degraded: bool = False
 
 
 @persist.register

@@ -748,6 +748,16 @@ class IntegrityScrubRunner:
         def work():
             INTEGRITY.scrub_node(self.node)
             self.sweeps += 1
+            # recovery actions ride the same maintenance lane: rebuild
+            # scrub-confirmed corrupt indexes from the engine, and
+            # re-materialize device-degraded regions at lower precision
+            # (index/recovery.py — fault-domain hardening)
+            from dingo_tpu.index.recovery import RECOVERY
+
+            try:
+                RECOVERY.run_rematerializations(self.node)
+            except Exception:  # noqa: BLE001 — next tick retries
+                _log.exception("device recovery sweep failed")
 
         t = threading.Thread(target=work, name="consistency_scrub",
                              daemon=True)

@@ -229,8 +229,11 @@ def sentinel_jit(kernel: str, fn=None, **jit_kwargs):
     entry = SENTINEL.entry(kernel)
     hits = METRICS.counter("xla.cache_hits", labels={"kernel": kernel})
 
+    from dingo_tpu.ops.devfault import DEVFAULT
+
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
+        DEVFAULT.maybe_fail(kernel)
         SENTINEL._push(kernel)
         t0 = time.perf_counter_ns()
         try:

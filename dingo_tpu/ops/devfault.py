@@ -1,0 +1,76 @@
+"""Device-fault shim: synthetic RESOURCE_EXHAUSTED on kernel dispatch.
+
+Real HBM OOMs surface as XlaRuntimeError("RESOURCE_EXHAUSTED: ...") at
+kernel dispatch time and are classified by ``obs/hbm.looks_like_oom``.
+They are also nearly impossible to produce on demand — on the CPU smoke
+arm there is no HBM at all. This shim injects an indistinguishable
+failure at the ONE chokepoint every persistent device dispatch already
+passes through (``sentinel_jit``, obs/sentinel.py), so the whole recovery
+ladder — drop caches, evict mirrors, retry, degrade to the host path —
+is exercised end-to-end by the chaos harness with real exceptions on the
+real code path, deterministically.
+
+Disarmed cost: one attribute read per dispatch (``_armed`` int check,
+no lock). Arm with ``DEVFAULT.arm(n)`` to fail the next n dispatches, or
+``DEVFAULT.arm(n, kernel_substr="flat")`` to fail only matching kernels.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class InjectedDeviceFault(RuntimeError):
+    """Synthetic device allocation failure. The message carries the
+    RESOURCE_EXHAUSTED marker so ``obs/hbm.looks_like_oom`` classifies it
+    exactly like a real XlaRuntimeError OOM — recovery code cannot (and
+    must not) tell them apart."""
+
+
+class DeviceFaultShim:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed = 0
+        self._kernel_substr: Optional[str] = None
+        self.fired = 0
+
+    def arm(self, n: int = 1, kernel_substr: Optional[str] = None) -> None:
+        """Fail the next `n` sentinel dispatches (optionally only kernels
+        whose name contains `kernel_substr`)."""
+        with self._lock:
+            self._armed = int(n)
+            self._kernel_substr = kernel_substr
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed = 0
+            self._kernel_substr = None
+
+    def armed(self) -> int:
+        return self._armed
+
+    def maybe_fail(self, kernel: str) -> None:
+        """Called by the sentinel_jit wrapper before dispatch."""
+        if not self._armed:           # disarmed fast path: no lock
+            return
+        with self._lock:
+            if not self._armed:
+                return
+            if self._kernel_substr is not None \
+                    and self._kernel_substr not in kernel:
+                return
+            self._armed -= 1
+            self.fired += 1
+        from dingo_tpu.common.metrics import METRICS
+
+        METRICS.counter("fault.injected",
+                        labels={"point": "device_dispatch"}).add(1)
+        raise InjectedDeviceFault(
+            f"RESOURCE_EXHAUSTED: injected device fault at {kernel} "
+            "(out of memory while trying to allocate — synthetic)"
+        )
+
+
+#: process-global shim (one device, one dispatch chokepoint)
+DEVFAULT = DeviceFaultShim()

@@ -12,12 +12,13 @@ from __future__ import annotations
 
 import hmac
 import threading
+import time
 from typing import Callable, Dict, Optional
 
 import grpc
 
 from dingo_tpu.raft import wire
-from dingo_tpu.raft.transport import Transport
+from dingo_tpu.raft.transport import Transport, TransportFaults
 from dingo_tpu.server import pb
 from dingo_tpu.server.rpc import ServiceStub
 
@@ -36,6 +37,10 @@ class GrpcRaftTransport(Transport):
         self._channels: Dict[str, grpc.Channel] = {}
         self._stubs: Dict[str, ServiceStub] = {}
         self._lock = threading.Lock()
+        #: injectable per-peer-pair faults (drop/delay/duplicate/partition,
+        #: raft/transport.py TransportFaults) — None = no fault layer, the
+        #: send path pays one attribute check
+        self.faults: Optional[TransportFaults] = None
 
     # -- wiring --------------------------------------------------------------
     def set_peer(self, store_id: str, addr: str) -> None:
@@ -82,21 +87,34 @@ class GrpcRaftTransport(Transport):
         store_id = target.split("/")[0]
         if store_id == self.store_id:
             return self.dispatch(target, method, msg)
+        copies = 1
+        if self.faults is not None:
+            deliver, delay_s, copies = self.faults.decide(
+                self.store_id, store_id)
+            if not deliver:
+                return None
+            if delay_s:
+                time.sleep(delay_s)
         stub = self._stub(store_id)
         if stub is None:
             return None
-        try:
-            resp = stub.RaftMessage(
-                pb.RaftMessageRequest(
-                    target=target, method=method,
-                    payload=wire.encode(msg),
-                    cluster_token=self.cluster_token,
-                ),
-                timeout=2.0,
-            )
-        except grpc.RpcError:
-            return None
-        if not resp.delivered:
+        req = pb.RaftMessageRequest(
+            target=target, method=method,
+            payload=wire.encode(msg),
+            cluster_token=self.cluster_token,
+        )
+        resp = None
+        for _ in range(copies):
+            # duplicate fault: the peer processes the message twice; the
+            # FIRST response is the one the raft node acts on (raft must
+            # dedupe re-delivery by term/index — the invariant exercised)
+            try:
+                r = stub.RaftMessage(req, timeout=2.0)
+            except grpc.RpcError:
+                r = None
+            if resp is None:
+                resp = r
+        if resp is None or not resp.delivered:
             return None
         try:
             return wire.decode(resp.payload)

@@ -262,6 +262,7 @@ _REGION_METRIC_FIELDS = (
     # state-integrity plane (obs/integrity.py): applied-index-tagged
     # per-artifact digest vector + store-local scrub verdict
     "integrity_applied_index", "integrity_digests", "integrity_mismatch",
+    "device_degraded",
 )
 
 _STORE_METRIC_FIELDS = (

@@ -1,0 +1,156 @@
+"""Device-failure recovery plane (index/recovery.py): the OOM ladder,
+degraded-mode serving semantics, re-materialization, and the heartbeat
+device_degraded flag."""
+
+import time
+
+import numpy as np
+import pytest
+
+from dingo_tpu.coordinator.control import CoordinatorControl
+from dingo_tpu.engine.raw_engine import MemEngine
+from dingo_tpu.index import codec as vcodec
+from dingo_tpu.index.base import IndexParameter, IndexType
+from dingo_tpu.index.recovery import RECOVERY, DeviceRecoveryPlane
+from dingo_tpu.ops.devfault import DEVFAULT
+from dingo_tpu.raft import LocalTransport
+from dingo_tpu.store.node import StoreNode
+from dingo_tpu.store.region import RegionType
+
+DIM = 8
+
+
+@pytest.fixture()
+def node():
+    coord = CoordinatorControl(MemEngine(), replication=1)
+    n = StoreNode("s0", LocalTransport(), coord, raft_kw={"seed": 0})
+    d = coord.create_region(
+        start_key=vcodec.encode_vector_key(0, 0),
+        end_key=vcodec.encode_vector_key(0, 1 << 40),
+        region_type=RegionType.INDEX,
+        index_parameter=IndexParameter(index_type=IndexType.FLAT,
+                                       dimension=DIM),
+    )
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        n.heartbeat_once()
+        rn = n.engine.get_node(d.region_id)
+        if rn is not None and rn.is_leader():
+            break
+        time.sleep(0.02)
+    yield n, d.region_id
+    DEVFAULT.disarm()
+    RECOVERY.clear()
+    n.stop()
+
+
+def _rows(n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return (np.arange(n, dtype=np.int64),
+            rng.standard_normal((n, DIM)).astype(np.float32))
+
+
+def test_single_fault_recovered_by_ladder_retry(node):
+    n, rid = node
+    region = n.get_region(rid)
+    ids, x = _rows()
+    n.storage.vector_add(region, ids, x)
+    DEVFAULT.arm(1)
+    res = n.storage.vector_batch_search(region, x[:1], 3)
+    assert res[0][0].id == 0
+    assert not RECOVERY.is_degraded(rid)
+    assert DEVFAULT.armed() == 0   # the fault actually fired
+
+
+def test_persistent_oom_degrades_and_serves_host_path(node):
+    n, rid = node
+    region = n.get_region(rid)
+    ids, x = _rows()
+    n.storage.vector_add(region, ids[:8], x[:8])
+    DEVFAULT.arm(1 << 30)
+    # write under the storm: absorbed (engine keeps it), region degrades
+    n.storage.vector_add(region, ids[8:], x[8:])
+    assert RECOVERY.is_degraded(rid)
+    # search under the storm: host exact path, sees BOTH the pre-degrade
+    # rows and the degraded-window write held by the engine
+    res = n.storage.vector_batch_search(region, x[8:9], 3)
+    assert res[0][0].id == 8
+    res = n.storage.vector_batch_search(region, x[:1], 3)
+    assert res[0][0].id == 0
+
+
+def test_degraded_write_does_not_advance_apply_log_id(node):
+    n, rid = node
+    region = n.get_region(rid)
+    ids, x = _rows()
+    n.storage.vector_add(region, ids[:8], x[:8])
+    wrapper = region.vector_index_wrapper
+    before = wrapper.apply_log_id
+    DEVFAULT.arm(1 << 30)
+    n.storage.vector_add(region, ids[8:], x[8:])
+    assert RECOVERY.is_degraded(rid)
+    # the device index did not materialize the write, so its applied
+    # cursor must not claim it (replica digest comparisons key on it)
+    assert wrapper.apply_log_id == before
+
+
+def test_rematerialization_exits_degraded_at_lower_precision(node):
+    n, rid = node
+    region = n.get_region(rid)
+    ids, x = _rows()
+    n.storage.vector_add(region, ids[:8], x[:8])
+    DEVFAULT.arm(1 << 30)
+    n.storage.vector_add(region, ids[8:], x[8:])
+    assert RECOVERY.is_degraded(rid)
+    DEVFAULT.disarm()
+
+    assert RECOVERY.run_rematerializations(n) == 1
+    assert not RECOVERY.is_degraded(rid)
+    idx = region.vector_index_wrapper.own_index
+    # advisory-lower resident precision; the region DEFINITION unchanged
+    assert idx.parameter.precision == "sq8"
+    assert region.definition.index_parameter.precision == ""
+    # the degraded-window write materialized during the rebuild
+    res = n.storage.vector_batch_search(region, x[8:9], 3)
+    assert res[0][0].id == 8
+
+
+def test_heartbeat_snapshot_carries_device_degraded(node):
+    n, rid = node
+    region = n.get_region(rid)
+    ids, x = _rows()
+    n.storage.vector_add(region, ids[:8], x[:8])
+    DEVFAULT.arm(1 << 30)
+    n.storage.vector_add(region, ids[8:], x[8:])
+    DEVFAULT.disarm()
+    snap = n.metrics.collect()
+    rm = [r for r in snap.regions if r.region_id == rid][0]
+    assert rm.device_degraded is True
+    RECOVERY.run_rematerializations(n)
+    rm = [r for r in n.metrics.collect().regions
+          if r.region_id == rid][0]
+    assert rm.device_degraded is False
+
+
+def test_remat_parameter_narrows_only_when_different():
+    import dataclasses
+
+    p = IndexParameter(index_type=IndexType.FLAT, dimension=8,
+                       precision="fp32")
+    out = DeviceRecoveryPlane.remat_parameter(p)
+    assert out.precision == "sq8"
+    assert p.precision == "fp32"            # original untouched (frozen)
+    already = dataclasses.replace(p, precision="sq8")
+    assert DeviceRecoveryPlane.remat_parameter(already) is already
+
+
+def test_non_oom_exception_propagates_untouched():
+    plane = DeviceRecoveryPlane()
+
+    def op():
+        raise KeyError("not an oom")
+
+    with pytest.raises(KeyError):
+        plane.attempt(None, 1, op)
+    assert not plane.is_degraded(1)
+    assert plane.ladder_runs == 0

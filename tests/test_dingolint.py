@@ -501,7 +501,7 @@ def test_cli_json_mode(capsys):
     out = json.loads(capsys.readouterr().out)
     assert rc == 0 and out["ok"] is True
     assert out["wall_s"] < 30.0
-    assert len(out["checkers"]) == 6
+    assert len(out["checkers"]) == 7
     assert out["findings"] == []
     assert len(out["baselined"]) >= 1
 

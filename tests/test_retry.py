@@ -1,0 +1,286 @@
+"""RetryPolicy unit tests (client/retry.py): error classification,
+rotation, budget exhaustion, circuit breaker lifecycle, hedge dedupe."""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from dingo_tpu.client.retry import (
+    ATTEMPT_METADATA_KEY,
+    FATAL,
+    OK,
+    ROTATE,
+    CircuitBreaker,
+    RetryPolicy,
+    attempt_metadata,
+)
+from dingo_tpu.obs.pressure import Budget, attach_budget, detach_budget
+
+
+class _RpcError(grpc.RpcError):
+    def __init__(self, code):
+        self._code = code
+
+    def code(self):
+        return self._code
+
+
+@pytest.fixture()
+def policy():
+    # seeded + tiny backoff: deterministic and fast
+    return RetryPolicy(rounds=3, base_backoff_ms=1.0, max_backoff_ms=2.0,
+                       breaker_threshold=3, breaker_cooldown_s=0.05,
+                       seed=7)
+
+
+# -- classification ----------------------------------------------------------
+
+def test_never_served_codes_rotate_even_for_mutations():
+    for code in (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.CANCELLED):
+        exc = _RpcError(code)
+        assert RetryPolicy.classify_exception(exc, idempotent=False) is ROTATE
+        assert RetryPolicy.classify_exception(exc, idempotent=True) is ROTATE
+
+
+def test_deadline_exceeded_is_ambiguous():
+    exc = _RpcError(grpc.StatusCode.DEADLINE_EXCEEDED)
+    # a read may re-send; a mutation must not (may have committed)
+    assert RetryPolicy.classify_exception(exc, idempotent=True) is ROTATE
+    assert RetryPolicy.classify_exception(exc, idempotent=False) is FATAL
+
+
+def test_non_grpc_exception_is_fatal():
+    assert RetryPolicy.classify_exception(ValueError("x"), True) is FATAL
+
+
+# -- rotation / in-band verdicts ---------------------------------------------
+
+def test_rotates_past_unavailable_target(policy):
+    calls = []
+
+    def fn(target, attempt):
+        calls.append((target, attempt))
+        if target == "a":
+            raise _RpcError(grpc.StatusCode.UNAVAILABLE)
+        return f"ok-{target}"
+
+    assert policy.call(["a", "b"], fn, op="t") == "ok-b"
+    assert calls == [("a", 0), ("b", 1)]
+
+
+def test_inband_rotate_verdict_moves_on(policy):
+    def fn(target, attempt):
+        return target
+
+    def classify(resp):
+        return OK if resp == "c" else (ROTATE, f"{resp} not leader")
+
+    assert policy.call(["a", "b", "c"], fn, classify=classify) == "c"
+
+
+def test_inband_fatal_verdict_raises(policy):
+    def classify(resp):
+        return (FATAL, "bad argument")
+
+    with pytest.raises(KeyError):
+        policy.call(["a"], lambda t, a: "r", classify=classify,
+                    error_cls=KeyError)
+
+
+def test_fatal_exception_reraises_immediately(policy):
+    calls = []
+
+    def fn(target, attempt):
+        calls.append(target)
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError):
+        policy.call(["a", "b"], fn)
+    assert calls == ["a"]   # no second target tried
+
+
+def test_exhaustion_raises_error_cls(policy):
+    def fn(target, attempt):
+        raise _RpcError(grpc.StatusCode.UNAVAILABLE)
+
+    with pytest.raises(RuntimeError, match="retries exhausted"):
+        policy.call(["a", "b"], fn, op="op")
+
+
+# -- budget ------------------------------------------------------------------
+
+def test_budget_exhaustion_stops_retries(policy):
+    calls = []
+
+    def fn(target, attempt):
+        calls.append(attempt)
+        time.sleep(0.02)
+        raise _RpcError(grpc.StatusCode.UNAVAILABLE)
+
+    token = attach_budget(Budget(deadline_ms=30.0))
+    try:
+        with pytest.raises(ValueError, match="budget exhausted"):
+            policy.call(["a", "b"], fn, op="op", error_cls=ValueError,
+                        rounds=50)
+    finally:
+        detach_budget(token)
+    # far fewer attempts than 50 rounds x 2 targets: the budget cut it
+    assert len(calls) < 8
+
+
+def test_expired_budget_prevents_first_attempt(policy):
+    token = attach_budget(Budget(deadline_ms=-1.0))
+    try:
+        with pytest.raises(RuntimeError, match="budget exhausted"):
+            policy.call(["a"], lambda t, a: "r")
+    finally:
+        detach_budget(token)
+
+
+def test_no_budget_means_no_budget_gate(policy):
+    assert policy.call(["a"], lambda t, a: "r") == "r"
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+def test_breaker_open_half_open_close():
+    br = CircuitBreaker(threshold=3, cooldown_s=0.05)
+    for _ in range(3):
+        br.on_failure("t")
+    st = br._state("t")
+    assert st.state == st.OPEN
+    assert not br.allow("t")            # open: rejected
+    time.sleep(0.06)
+    assert br.allow("t")                # cooldown: one half-open probe
+    assert not br.allow("t")            # second concurrent probe rejected
+    br.on_success("t")
+    assert br._state("t").state == st.CLOSED
+    assert br.allow("t")
+
+
+def test_breaker_half_open_failure_reopens():
+    br = CircuitBreaker(threshold=2, cooldown_s=0.05)
+    br.on_failure("t")
+    br.on_failure("t")
+    time.sleep(0.06)
+    assert br.allow("t")                # half-open probe
+    br.on_failure("t")                  # probe failed
+    st = br._state("t")
+    assert st.state == st.OPEN
+    assert not br.allow("t")
+
+
+def test_open_breaker_skips_target_but_final_round_probes(policy):
+    # break target "a" hard
+    for _ in range(3):
+        policy.breaker.on_failure("a")
+    for _ in range(3):
+        policy.breaker.on_failure("b")
+    calls = []
+
+    def fn(target, attempt):
+        calls.append(target)
+        return "r"
+
+    # both breakers open and inside cooldown: the final-round force-probe
+    # still reaches a target instead of failing without a single attempt
+    assert policy.call(["a", "b"], fn) == "r"
+    assert calls   # at least one probe fired
+
+
+def test_inband_response_closes_breaker(policy):
+    policy.breaker.on_failure("a")
+    policy.breaker.on_failure("a")
+
+    def classify(resp):
+        return (ROTATE, "not leader")   # in-band verdict, endpoint alive
+
+    with pytest.raises(RuntimeError):
+        policy.call(["a"], lambda t, a: "r", classify=classify, rounds=1)
+    st = policy.breaker._state("a")
+    assert st.failures == 0 and st.state == st.CLOSED
+
+
+# -- hedging -----------------------------------------------------------------
+
+def test_attempt_metadata_stamping():
+    assert attempt_metadata(0) is None
+    assert attempt_metadata(0, [("k", "v")]) == [("k", "v")]
+    assert attempt_metadata(2) == [(ATTEMPT_METADATA_KEY, "2")]
+    assert attempt_metadata(1, [("k", "v")]) == [
+        ("k", "v"), (ATTEMPT_METADATA_KEY, "1")]
+
+
+def test_hedge_fires_after_delay_and_dedupes_by_attempt(policy):
+    """Slow primary -> hedge fires at the backup stamped attempt=1; the
+    server side can dedupe on the attempt metadata."""
+    seen = []
+    release = threading.Event()
+
+    def fn(target, attempt):
+        seen.append((target, attempt))
+        if target == "slow":
+            release.wait(1.0)
+        return f"ok-{target}"
+
+    out = policy.call_hedged(["slow", "fast"], fn, op="read")
+    release.set()
+    assert out == "ok-fast"
+    # primary went out as attempt 0, hedge as attempt 1 — distinct stamps
+    assert ("slow", 0) in seen and ("fast", 1) in seen
+
+
+def test_hedge_not_used_when_primary_fast(policy):
+    seen = []
+
+    def fn(target, attempt):
+        seen.append(target)
+        return "ok"
+
+    # prime the latency sensor so the hedge delay is well above the
+    # primary's actual (instant) response time
+    for _ in range(16):
+        policy.note_latency("a", 50.0)
+    assert policy.call_hedged(["a", "b"], fn) == "ok"
+    assert seen == ["a"]
+
+
+def test_hedge_single_target_falls_back_to_plain_call(policy):
+    assert policy.call_hedged(["only"], lambda t, a: "r") == "r"
+
+
+def test_hedge_skipped_when_budget_too_small(policy):
+    seen = []
+
+    def fn(target, attempt):
+        seen.append(target)
+        return "ok"
+
+    for _ in range(16):
+        policy.note_latency("a", 40.0)
+    token = attach_budget(Budget(deadline_ms=50.0))  # < 2x hedge delay
+    try:
+        assert policy.call_hedged(["a", "b"], fn) == "ok"
+    finally:
+        detach_budget(token)
+    assert seen == ["a"]    # plain call path, no hedge thread
+
+
+def test_hedge_primary_error_falls_to_hedge(policy):
+    def fn(target, attempt):
+        if target == "bad":
+            raise _RpcError(grpc.StatusCode.UNAVAILABLE)
+        return "ok"
+
+    assert policy.call_hedged(["bad", "good"], fn) == "ok"
+
+
+# -- p99 sensor --------------------------------------------------------------
+
+def test_hedge_delay_uses_p99_with_floor(policy):
+    assert policy.hedge_delay_ms("cold") == policy.hedge_min_delay_ms
+    for i in range(100):
+        policy.note_latency("warm", float(i))
+    assert policy.hedge_delay_ms("warm") >= 90.0

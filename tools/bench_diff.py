@@ -22,6 +22,10 @@ key name, so the tool keeps working as bench grows scenarios:
   recompiles — `recompiles` keys: regression when a steady-state counter
                that was meeting the invariant (0) becomes nonzero, or
                grows at all.
+  recovery   — chaos-scenario `recovery_ms` keys: regression when the
+               figure more than doubles AND crosses 1s absolute (coarse
+               on purpose — recovery is bounded, not benchmarked).
+               Chaos `goodput` keys ride the qps rule.
 
 Exit status: 0 = no regressions, 1 = regressions found (CI-gateable),
 2 = usage/file errors. All human output goes to stdout; --json emits the
@@ -99,6 +103,11 @@ def classify(path: str, summary: Optional[dict] = None) -> Optional[str]:
         return "recall"
     if "recompile" in low:
         return "recompiles"
+    if low.endswith("recovery_ms"):
+        # chaos-scenario recovery times (kill/restart, failover, remat):
+        # wall-clock on a shared CI host, so the gate is coarse — only a
+        # large relative blow-up signals a real recovery-path regression
+        return "recovery"
     if "hbm" in low or low.endswith("bytes") or low.endswith(
             "bytes_per_vector"):
         return "bytes"
@@ -135,6 +144,14 @@ def compare(old: dict, new: dict, qps_drop: float = 0.15,
             row["change"] = round(nv - ov, 4)
             # the steady-state invariant: any growth is a regression
             bad = nv > ov
+        elif kind == "recovery":
+            # recovery is bounded, not benchmarked: flag only when a
+            # recovery that used to be fast blows past double its old
+            # figure AND crosses a 1s absolute floor (sub-second jitter
+            # on shared hosts is machine weather, not a regression)
+            change = (nv - ov) / ov if ov else 0.0
+            row["change"] = round(change, 4)
+            bad = ov > 0 and change > 1.0 and nv > 1000.0
         elif kind == "overhead":
             # overhead percentages regress when they grow by more than
             # 5 points (the integrity_scrub acceptance bound); shrinking

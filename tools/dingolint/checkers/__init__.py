@@ -16,6 +16,7 @@ def all_checkers() -> List[Checker]:
     from tools.dingolint.checkers.ladder_shape import LadderShapeChecker
     from tools.dingolint.checkers.lock_order import LockOrderChecker
     from tools.dingolint.checkers.metric_names import MetricNamesChecker
+    from tools.dingolint.checkers.retry_policy import RetryPolicyChecker
 
     return [
         LockOrderChecker(),
@@ -24,6 +25,7 @@ def all_checkers() -> List[Checker]:
         LadderShapeChecker(),
         ContextHandoffChecker(),
         MetricNamesChecker(),
+        RetryPolicyChecker(),
     ]
 
 

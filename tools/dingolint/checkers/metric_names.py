@@ -180,6 +180,31 @@ FAMILY_NAMES = {
         "quality.tuner_rerank_factor",
         "quality.tuner_precision_target",  # advisory tier (ladder index)
     },
+    "fault": {
+        # fault-domain hardening (PR 14): injection planes, the client
+        # resilience policy, and the device-failure recovery ladder
+        "fault.injected",           # fired injections, by {point}
+                                    # (failpoints + the device-fault shim)
+        "fault.transport_faults",   # raft transport faults, by {kind}:
+                                    # drop / delay / duplicate / partition
+        "fault.retries",            # RetryPolicy re-attempts, by {target}
+        "fault.hedges",             # hedged duplicates sent, by {target}
+        "fault.hedge_wins",         # hedge answered before the primary
+        "fault.breaker_opens",      # circuit transitions to open, by
+                                    # {target}
+        "fault.budget_exhausted",   # deadline budget died mid-retry-loop
+        "fault.cmd_retry_exhausted",  # coordinator command dropped after
+                                    # its poison-retry budget
+        "fault.oom_recoveries",     # recovery-ladder outcomes, by {rung}:
+                                    # drop_rerank / evict_mirrors /
+                                    # retry / degrade
+        "fault.degraded_regions",   # regions currently device-degraded
+        "fault.rematerializations",  # degraded regions rebuilt (lower
+                                    # precision) from the engine
+        "fault.rebuilds",           # scrub-corruption rebuilds from the
+                                    # engine
+        "fault.recovery_ms",        # ladder wall-time recorder (us)
+    },
 }
 
 

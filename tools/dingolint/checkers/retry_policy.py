@@ -1,0 +1,104 @@
+"""retry-policy: gRPC client call sites go through RetryPolicy.
+
+PR 14 replaced the ad-hoc NotLeader rotation loops with one client
+resilience policy (``client/retry.py``): error-class-aware retries,
+equal-jitter backoff, per-target circuit breakers, budget-aware hedging.
+A module that opens its own ``grpc.insecure_channel`` / ``ServiceStub``
+and fires RPCs directly gets none of that — its failures retry immediately
+in a tight loop (the thundering-herd bug this PR fixed in coord_channel),
+ignore the deadline budget, and never trip a breaker. This checker keeps
+new RPC surfaces honest.
+
+Rule: a ``*.insecure_channel(...)`` or ``ServiceStub(...)`` call in
+``dingo_tpu/`` is flagged unless one of:
+
+- the module IS the resilience layer (``client/retry.py``) or the
+  retry-routing channel (``common/coord_channel.py``);
+- the module imports ``dingo_tpu.client.retry`` — channel/stub creation
+  is fine when the call loop visibly routes through the policy (the
+  import is the cheap static proxy for that; reviewers check the rest);
+- the site is baseline-adjudicated with a rationale (raft's transport
+  owns its own retry protocol — election timeouts and append retries ARE
+  raft's correctness story, wrapping them in a client policy would fight
+  it) or carries an inline ``# dingolint: ok[retry-policy] reason``.
+
+Server-side modules never trip this: creating a *server* or servicing a
+stub doesn't match the two client-construction forms.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.dingolint.callgraph import dotted_name
+from tools.dingolint.core import Checker, Finding, Module, Repo
+
+#: the resilience layer itself + the channel that routes through it
+_EXEMPT_MODULES = {
+    "dingo_tpu.client.retry",
+    "dingo_tpu.common.coord_channel",
+}
+
+#: importing the policy module marks the call loop as policy-routed
+_POLICY_MODULE = "dingo_tpu.client.retry"
+
+
+def _imports_policy(module: Module) -> bool:
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == _POLICY_MODULE:
+                return True
+            if mod == "dingo_tpu.client" and any(
+                    a.name == "retry" for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any(a.name == _POLICY_MODULE for a in node.names):
+                return True
+    return False
+
+
+class RetryPolicyChecker(Checker):
+    name = "retry-policy"
+    description = ("gRPC client channels/stubs outside RetryPolicy lose "
+                   "backoff, breakers, and budget awareness")
+
+    def check_module(self, module: Module, repo: Repo) -> List[Finding]:
+        if not module.name.startswith("dingo_tpu."):
+            return []
+        if module.name in _EXEMPT_MODULES:
+            return []
+        if _imports_policy(module):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            parts = dotted_name(node.func)
+            if not parts:
+                continue
+            tail = parts[-1]
+            if tail == "insecure_channel":
+                f = module.finding(
+                    self.name, node,
+                    "raw grpc channel outside RetryPolicy — RPCs on it "
+                    "retry with no backoff/jitter, ignore the deadline "
+                    "budget, and never trip a circuit breaker; route the "
+                    "call loop through dingo_tpu.client.retry.RetryPolicy "
+                    "(or baseline this site with a rationale)",
+                )
+                if f:
+                    out.append(f)
+            elif tail == "ServiceStub":
+                f = module.finding(
+                    self.name, node,
+                    "direct ServiceStub construction outside RetryPolicy "
+                    "— stub RPCs bypass the client resilience policy "
+                    "(backoff, breakers, budget); route calls through "
+                    "dingo_tpu.client.retry.RetryPolicy (or baseline "
+                    "this site with a rationale)",
+                )
+                if f:
+                    out.append(f)
+        return out

@@ -77,6 +77,10 @@ NEW_MESSAGES = {
         ("integrity_applied_index", 25, T.TYPE_INT64, None, False),
         ("integrity_digests", 26, T.TYPE_STRING, None, False),
         ("integrity_mismatch", 27, T.TYPE_BOOL, None, False),
+        # fault-domain hardening (index/recovery.py): region's device
+        # index OOMed past the recovery ladder — served by the host
+        # exact path until the background re-materialization completes
+        ("device_degraded", 28, T.TYPE_BOOL, None, False),
     ],
     # whole-store snapshot (process device gauges + per-region list)
     "StoreMetrics": [
