@@ -57,6 +57,23 @@ section too, but ANY admission work between the check and the append —
 exactly what QoS adds — would have opened a window where a request could
 slip into a queue nobody will ever flush; the decision is now made at
 append time, where it cannot be stale).
+
+Stall-free pipeline (``pipeline.enabled``, common/pipeline.py): when a
+``dispatch_fn`` is provided and the tri-state flag resolves on, the
+flush loop splits dispatch from resolve. Each due batch's kernels are
+dispatched (priority order, same device_lock discipline — dispatch_fn
+returns a resolve thunk without syncing), so region B's kernel overlaps
+region A's D2H fetch; the thunks then drain FIFO on a CompletionLane
+thread, the only place the pipelined path calls ``jax.device_get``.
+Query staging (pad + H2D upload) moves into a per-key StagingRing of
+``pipeline.depth`` pow2-ladder host buffers so batch N+1's upload
+overlaps batch N's compute. Expiry-before-dispatch runs inside
+``_dispatch`` — i.e. at REAL dispatch time even for cap-displaced
+batches — and per-stage accounting books the enqueue cost under a
+``dispatch`` stage instead of inflating kernel time. The shutdown
+contract extends to the lane: stop(drain=True) resolves queued
+handoffs, stop(drain=False) abandons them (futures fail fast, but the
+fetch still runs so device-side SearchLeases are released).
 """
 
 from __future__ import annotations
@@ -141,8 +158,10 @@ class SearchCoalescer:
     """
 
     def __init__(self, run_fn: Callable[[Any, np.ndarray], Sequence],
-                 window_ms: float = 2.0, max_batch: int = 256):
+                 window_ms: float = 2.0, max_batch: int = 256,
+                 dispatch_fn: Optional[Callable] = None):
         self.run_fn = run_fn
+        self.dispatch_fn = dispatch_fn
         self.window_s = window_ms / 1000.0
         self.max_batch = max_batch
         import inspect
@@ -152,6 +171,23 @@ class SearchCoalescer:
                 run_fn).parameters
         except (TypeError, ValueError):
             self._run_takes_stages = False
+        self._dispatch_params = frozenset()
+        if dispatch_fn is not None:
+            try:
+                self._dispatch_params = frozenset(
+                    inspect.signature(dispatch_fn).parameters)
+            except (TypeError, ValueError):
+                pass
+        # pipelined-path state: the lane thread starts lazily on the
+        # first handoff; staging rings materialize per key on first use
+        from dingo_tpu.common.pipeline import CompletionLane
+
+        self._lane = CompletionLane()
+        self._staging = None
+        #: cumulative per-stage wall time (ms) across all pipelined
+        #: flushes — bench reads dispatch_overhead_fraction from here
+        #: without needing QoS budget plumbing
+        self.stage_totals_ms: Dict[str, float] = {}
         self._lock = threading.Lock()
         self._pending: Dict[Any, _PendingBatch] = {}
         #: cap-displaced batches awaiting the timer thread (QoS mode):
@@ -405,11 +441,16 @@ class SearchCoalescer:
                 live.append(e)
         return live
 
-    def _run(self, key: Any, batch: _PendingBatch) -> None:
-        # queue-wait ends here; the run span parents to the first sampled
-        # waiter so the device work lands in ITS trace, with the rest of
-        # the batch recorded as co-batched trace links
-        flush_t0 = time.monotonic()
+    def _begin_flush(self, key: Any, batch: _PendingBatch,
+                     flush_t0: float):
+        """Shared flush prologue for the serial and pipelined arms:
+        end queue-wait spans, mirror QoS dequeue accounting, expire dead
+        entries (this runs at REAL dispatch time — cap-displaced batches
+        included), priority-sort the survivors, and open the run span
+        parented to the first sampled waiter. Returns
+        (entries, region_id, run_span, waits_ms, qos); an empty entries
+        list means everything expired (the span is already closed and no
+        kernel must dispatch)."""
         qos = False
         try:
             from dingo_tpu.obs import pressure as qp
@@ -449,7 +490,7 @@ class SearchCoalescer:
                 if run_span is not NOOP_SPAN:
                     run_span.set_attr("all_expired", True)
                     run_span.end()
-                return
+                return [], region_id, NOOP_SPAN, waits_ms, qos
             # priority batch forming: highest priority first (stable), so
             # the result slicing below follows the dispatch order
             entries = sorted(entries, key=lambda e: -e.priority)
@@ -463,6 +504,35 @@ class SearchCoalescer:
             )
             if links:
                 run_span.set_attr("cobatched_traces", links)
+        return entries, region_id, run_span, waits_ms, qos
+
+    def _note_stage_totals(self, **stages_ms) -> None:
+        with self._lock:
+            for name, ms in stages_ms.items():
+                if ms > 0:
+                    self.stage_totals_ms[name] = (
+                        self.stage_totals_ms.get(name, 0.0) + ms)
+
+    def stage_totals(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self.stage_totals_ms)
+
+    def _pipelined(self) -> bool:
+        if self.dispatch_fn is None:
+            return False
+        from dingo_tpu.common.config import serving_pipeline_enabled
+
+        return serving_pipeline_enabled()
+
+    def _run(self, key: Any, batch: _PendingBatch) -> None:
+        # queue-wait ends here; the run span parents to the first sampled
+        # waiter so the device work lands in ITS trace, with the rest of
+        # the batch recorded as co-batched trace links
+        flush_t0 = time.monotonic()
+        entries, region_id, run_span, waits_ms, qos = self._begin_flush(
+            key, batch, flush_t0)
+        if not entries:
+            return
         token = run_span.attach()
         stage_us: Optional[Dict[str, int]] = (
             {} if (qos and self._run_takes_stages) else None
@@ -495,13 +565,18 @@ class SearchCoalescer:
             run_span.end()
 
     @staticmethod
-    def _account_stages(entries, waits_ms, form_ms, run_ms, stage_us):
+    def _account_stages(entries, waits_ms, form_ms, run_ms, stage_us,
+                        dispatch_ms: Optional[float] = None):
         """Per-stage time-budget accounting: queue / batch_form / kernel /
         rerank as fractions of each entry's deadline. The kernel/rerank
         split comes from the reader's stage_us dict when the run callback
         exposes it (search_us = the device scan+topk, postfilter+backfill
         = the rerank/materialize tail); otherwise the whole run counts as
-        kernel time."""
+        kernel time. On the pipelined path ``dispatch_ms`` (the kernel
+        enqueue + staging cost, during which the flush thread — not the
+        device — was the bottleneck) books under its own ``dispatch``
+        stage so overlapped-dispatch wait is not misbooked as kernel
+        time."""
         from dingo_tpu.obs.pressure import PRESSURE
 
         kernel_ms, rerank_ms = run_ms, 0.0
@@ -514,12 +589,72 @@ class SearchCoalescer:
         for e in entries:
             if e.budget is None:
                 continue
-            PRESSURE.observe_stages(e.budget, {
+            stages = {
                 "queue": waits_ms.get(id(e), 0.0),
                 "batch_form": form_ms,
                 "kernel": kernel_ms,
                 "rerank": rerank_ms,
-            })
+            }
+            if dispatch_ms is not None:
+                stages["dispatch"] = dispatch_ms
+            PRESSURE.observe_stages(e.budget, stages)
+
+    # -- pipelined arm -------------------------------------------------------
+    def _dispatch(self, key: Any, batch: _PendingBatch):
+        """Dispatch one due batch's kernels WITHOUT resolving: stage the
+        stacked queries (reusable pinned ring buffer, upload started
+        here so the next batch's H2D overlaps this one's compute), call
+        dispatch_fn for the resolve thunk, and return a _Handoff for the
+        completion lane. Returns None when the batch fully expired or
+        dispatch itself failed (futures are resolved either way). Runs
+        on the flush thread; MUST NOT block on device results — the one
+        sanctioned ``device_get`` of this path lives in
+        _Handoff.resolve() on the lane thread (dingolint: resolve-sync
+        enforces this split)."""
+        flush_t0 = time.monotonic()
+        entries, region_id, run_span, waits_ms, qos = self._begin_flush(
+            key, batch, flush_t0)
+        if not entries:
+            return None
+        token = run_span.attach()
+        staged = None
+        stage_us: Optional[Dict[str, int]] = (
+            {} if "stage_us" in self._dispatch_params else None
+        )
+        try:
+            stacked = np.concatenate([e.queries for e in entries], axis=0)
+            if "staged" in self._dispatch_params:
+                if self._staging is None:
+                    from dingo_tpu.common.config import pipeline_depth
+                    from dingo_tpu.common.pipeline import KeyedStaging
+
+                    self._staging = KeyedStaging(pipeline_depth())
+                staged = self._staging.ring(key).stage(stacked)
+            form_ms = (time.monotonic() - flush_t0) * 1000.0
+            dispatch_t0 = time.monotonic()
+            kw: Dict[str, Any] = {}
+            if staged is not None:
+                kw["staged"] = staged
+            if stage_us is not None:
+                kw["stage_us"] = stage_us
+            thunk = self.dispatch_fn(key, stacked, **kw)
+            dispatch_ms = (time.monotonic() - dispatch_t0) * 1000.0
+            self._note_stage_totals(batch_form=form_ms,
+                                    dispatch=dispatch_ms)
+            run_span.detach(token)
+            return _Handoff(self, key, entries, waits_ms, form_ms,
+                            dispatch_ms, run_span, staged, thunk,
+                            stage_us, qos)
+        except Exception as exc:  # noqa: BLE001
+            run_span.set_error(exc)
+            run_span.detach(token)
+            run_span.end()
+            if staged is not None:
+                staged.release()
+            for e in entries:
+                if not e.future.done():
+                    e.future.set_exception(exc)
+            return None
 
     def _flush_loop(self) -> None:
         timeout = None   # nothing pending: sleep until a submit wakes us
@@ -552,8 +687,24 @@ class SearchCoalescer:
             due.sort(key=lambda kb: -max(
                 (e.priority for e in kb[1].entries), default=0
             ))
-            for key, batch in due:
-                self._run(key, batch)
+            if self._pipelined():
+                # overlapped dispatch: EVERY due batch's kernels enqueue
+                # before ANY resolve runs — batch B's kernel overlaps
+                # batch A's D2H fetch; the completion lane drains the
+                # thunks FIFO so this thread never blocks on device_get
+                handoffs = []
+                for key, batch in due:
+                    h = self._dispatch(key, batch)
+                    if h is not None:
+                        handoffs.append(h)
+                for h in handoffs:
+                    if not self._lane.submit(h):
+                        # lane already stopped (stop racing a flush):
+                        # resolve inline — the futures must still settle
+                        h.resolve()
+            else:
+                for key, batch in due:
+                    self._run(key, batch)
 
     def stop(self, drain: bool = True) -> None:
         """Shut down. drain=True runs pending batches to completion so
@@ -586,4 +737,97 @@ class SearchCoalescer:
                                             e.budget)
                     if not e.future.done():
                         e.future.set_exception(exc)
+        # the completion lane honors the same contract: drain resolves
+        # queued handoffs to real results, no-drain abandons them (their
+        # futures fail fast but the fetch still runs so device leases
+        # release — see _Handoff.abandon)
+        self._lane.stop(drain=drain)
+        if self._staging is not None:
+            self._staging.close()
         self._thread.join(timeout=2)
+
+
+class _Handoff:
+    """One dispatched-but-unresolved batch riding the completion lane.
+
+    ``resolve()`` is the single sanctioned host-sync point of the
+    pipelined path: it runs the dispatch_fn's thunk (one ``device_get``
+    inside), slices results to the waiters' futures, and closes the
+    accounting the dispatch half opened. ``abandon()`` is the
+    stop(drain=False) arm: futures fail fast with CoalescerStopped, but
+    the thunk still runs — a dropped fetch must not leak the SlotStore
+    SearchLeases the dispatch acquired."""
+
+    __slots__ = ("coalescer", "key", "entries", "waits_ms", "form_ms",
+                 "dispatch_ms", "run_span", "staged", "thunk", "stage_us",
+                 "qos")
+
+    def __init__(self, coalescer, key, entries, waits_ms, form_ms,
+                 dispatch_ms, run_span, staged, thunk, stage_us, qos):
+        self.coalescer = coalescer
+        self.key = key
+        self.entries = entries
+        self.waits_ms = waits_ms
+        self.form_ms = form_ms
+        self.dispatch_ms = dispatch_ms
+        self.run_span = run_span
+        self.staged = staged
+        self.thunk = thunk
+        self.stage_us = stage_us
+        self.qos = qos
+
+    def resolve(self) -> None:
+        c = self.coalescer
+        token = self.run_span.attach()
+        t0 = time.monotonic()
+        try:
+            results = self.thunk()
+            resolve_ms = (time.monotonic() - t0) * 1000.0
+            rows = sum(len(e.queries) for e in self.entries)
+            c._note_run(rows, self.dispatch_ms + resolve_ms)
+            kernel_ms, rerank_ms = resolve_ms, 0.0
+            if self.stage_us:
+                k = self.stage_us.get("search_us", 0) / 1000.0
+                r = (self.stage_us.get("postfilter_us", 0)
+                     + self.stage_us.get("backfill_us", 0)) / 1000.0
+                if k > 0:
+                    kernel_ms = k
+                    rerank_ms = min(r, max(0.0, resolve_ms - k))
+            c._note_stage_totals(kernel=kernel_ms, rerank=rerank_ms,
+                                 resolve=resolve_ms)
+            off = 0
+            for e in self.entries:
+                n = len(e.queries)
+                e.future.set_result(list(results[off:off + n]))
+                off += n
+            if self.qos:
+                c._account_stages(self.entries, self.waits_ms,
+                                  self.form_ms, resolve_ms, self.stage_us,
+                                  dispatch_ms=self.dispatch_ms)
+        except Exception as exc:  # noqa: BLE001
+            self.run_span.set_error(exc)
+            for e in self.entries:
+                if not e.future.done():
+                    e.future.set_exception(exc)
+        finally:
+            self.run_span.detach(token)
+            self.run_span.end()
+            if self.staged is not None:
+                self.staged.release()
+
+    def abandon(self) -> None:
+        exc = CoalescerStopped("coalescer stopped before resolve")
+        for e in self.entries:
+            if not e.future.done():
+                e.future.set_exception(exc)
+        try:
+            # run the fetch anyway: the dispatch half acquired device-
+            # side leases (SlotStore begin_search) that only the thunk's
+            # finally releases — dropping it would strand limbo slots
+            self.thunk()
+        except Exception:  # noqa: BLE001
+            pass
+        finally:
+            self.run_span.end()
+            if self.staged is not None:
+                self.staged.release()

@@ -417,6 +417,22 @@ FLAGS.define("device_recovery_remat_precision", "sq8", mutable=True,
                    "rebuilds a device-degraded region at (advisory-lower "
                    "than the configured tier; the region definition keeps "
                    "its declared precision)")
+FLAGS.define("pipeline_enabled", "auto", mutable=True,
+             help_="stall-free serving pipeline: the coalescer flush "
+                   "thread dispatches every due batch's kernels before any "
+                   "resolve runs, resolves drain on a completion lane, and "
+                   "query staging double-buffers H2D uploads. 'auto' = "
+                   "TPU-only (on CPU the backend is synchronous so overlap "
+                   "buys nothing and the extra thread hop costs latency). "
+                   "True/False force; same tri-state crossover discipline "
+                   "as hnsw_device_search")
+FLAGS.define("pipeline_depth", 2, mutable=True,
+             help_="staging-ring depth per coalescer key (pow2-ladder "
+                   "shaped host buffers): batch N+1's query upload can "
+                   "overlap batch N's compute up to this many batches in "
+                   "flight. 1 degenerates to the serial path (staging "
+                   "still used, no overlap); 2 is classic double "
+                   "buffering")
 FLAGS.define("vector_blocked_layout", "auto", mutable=True,
              help_="maintain a dimension-blocked ([n_blocks, capacity, "
                    "block_d]) scan mirror + per-block norms in float/sq8 "
@@ -494,6 +510,25 @@ def hnsw_device_enabled() -> bool:
     if v is None:
         return _on_tpu()
     return v
+
+
+def serving_pipeline_enabled() -> bool:
+    """Tri-state pipeline.enabled: 'auto' keeps the overlapped-dispatch
+    serving pipeline TPU-only (CPU XLA executes synchronously inside
+    dispatch, so there is nothing to overlap — the completion-lane hop
+    would only add latency). True/False force."""
+    v = _parse_tri(FLAGS.get("pipeline_enabled"))
+    if v is None:
+        return _on_tpu()
+    return v
+
+
+def pipeline_depth() -> int:
+    """Staging-ring depth for the serving pipeline (floor 1)."""
+    try:
+        return max(1, int(FLAGS.get("pipeline_depth")))
+    except (TypeError, ValueError):
+        return 2
 
 
 def blocked_layout_enabled() -> bool:

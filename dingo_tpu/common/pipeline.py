@@ -1,0 +1,235 @@
+"""Stall-free serving pipeline primitives (ROADMAP open item 5).
+
+Three small pieces the coalescer composes into an overlapped hot path:
+
+- ``StagingRing`` — per-key double-buffered query staging. ``stage()``
+  pads the stacked host batch into a reusable pow2-ladder host buffer
+  (same shape discipline as ``_pad_batch``: zeroed tail rows) and starts
+  the H2D upload off the device-lock critical section, so batch N+1's
+  transfer overlaps batch N's compute. Depth-bounded: at most
+  ``depth`` staged batches may be outstanding per key; ``stage()``
+  blocks when the ring is full (natural backpressure toward admission).
+
+- ``CompletionLane`` — a single drainer thread that owns every
+  ``jax.device_get`` of the pipelined path. The flush thread dispatches
+  kernels for ALL due batches, hands each a ``resolve()`` thunk here,
+  and never blocks on D2H. Handoffs resolve in FIFO order (= dispatch
+  order), which keeps per-future completion deterministic.
+
+- the handoff protocol — anything with ``resolve()`` and ``abandon()``
+  can ride the lane. ``abandon()`` is the stop(drain=False) contract:
+  fail the futures, but still run the fetch so device-side leases are
+  released (a dropped resolve must not leak SlotStore limbo slots).
+
+Host-buffer reuse safety: a ring slot is only reissued after its
+``StagedBatch.release()``, which the lane calls after the resolve's
+``device_get`` completed — by then nothing on the device reads the
+buffer, so ``np.copyto`` into it cannot race a pending transfer.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1)).bit_length()
+
+
+class StagedBatch:
+    """One staged query batch: the original host array it was built from
+    plus its padded on-device upload. Index families accept this via the
+    ``staged=`` kwarg and use ``take()`` to claim the upload — the
+    identity check makes staleness impossible: if `_prep_queries`
+    rebound the array (binary bit-unpack, dtype cast), ``take`` returns
+    None and the family falls back to its own pad+upload."""
+
+    __slots__ = ("src", "qpad", "rows", "_ring", "_slot", "_released")
+
+    def __init__(self, src: np.ndarray, qpad, rows: int,
+                 ring: "StagingRing", slot: int):
+        self.src = src
+        self.qpad = qpad
+        self.rows = rows
+        self._ring = ring
+        self._slot = slot
+        self._released = False
+
+    def take(self, queries) -> Optional[Any]:
+        """Return the staged device upload iff ``queries`` is the exact
+        array this batch was staged from (post-`_prep_queries` identity
+        survives for float families because ``np.asarray`` with a
+        matching dtype returns the same object)."""
+        if queries is self.src:
+            return self.qpad
+        return None
+
+    def release(self) -> None:
+        """Return the host buffer slot to the ring. Idempotent. Call
+        only after the batch's results were fetched to host (or the
+        dispatch never happened) — see module docstring."""
+        if self._released:
+            return
+        self._released = True
+        self.qpad = None
+        ring, self._ring = self._ring, None
+        if ring is not None:
+            ring._return_slot(self._slot)
+
+
+class StagingRing:
+    """Per-coalescer-key ring of ``depth`` reusable host staging buffers.
+
+    Buffers are pow2-ladder shaped ([_next_pow2(b), *tail], matching
+    ``_pad_batch``) and zero-padded on every ``stage`` so the padded
+    rows are byte-identical to the serial path's ``np.zeros`` pad. A
+    slot whose cached buffer doesn't fit the requested (shape, dtype)
+    is reallocated in place — the ladder keeps that rare at steady
+    state."""
+
+    def __init__(self, depth: int = 2):
+        self.depth = max(1, int(depth))
+        self._free = threading.Semaphore(self.depth)
+        self._lock = threading.Lock()
+        self._slots: List[Optional[np.ndarray]] = [None] * self.depth
+        self._avail: deque = deque(range(self.depth))
+        self._closed = False
+
+    def stage(self, stacked: np.ndarray) -> StagedBatch:
+        """Pad ``stacked`` into a ring buffer and start its device
+        upload. Blocks while all ``depth`` slots are in flight."""
+        import jax.numpy as jnp
+
+        self._free.acquire()
+        with self._lock:
+            if self._closed:
+                self._free.release()
+                raise RuntimeError("staging ring closed")
+            slot = self._avail.popleft()
+            buf = self._slots[slot]
+        b = stacked.shape[0]
+        bb = _next_pow2(max(1, b))
+        shape = (bb,) + stacked.shape[1:]
+        if buf is None or buf.shape != shape or buf.dtype != stacked.dtype:
+            buf = np.zeros(shape, stacked.dtype)
+            with self._lock:
+                self._slots[slot] = buf
+        np.copyto(buf[:b], stacked)
+        if bb != b:
+            buf[b:] = 0
+        qpad = jnp.asarray(buf)
+        return StagedBatch(stacked, qpad, b, self, slot)
+
+    def _return_slot(self, slot: int) -> None:
+        with self._lock:
+            self._avail.append(slot)
+        self._free.release()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+
+
+class CompletionLane:
+    """Single-thread FIFO drain for pipelined resolves. The lane thread
+    is the only place the pipelined path calls ``jax.device_get`` — the
+    flush thread stays free to dispatch the next due batch (dingolint's
+    resolve-sync checker enforces the flush-thread side)."""
+
+    def __init__(self, name: str = "dingo-completion-lane"):
+        self._name = name
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        self._active = False  # a handoff is mid-resolve on the lane
+
+    def submit(self, handoff) -> bool:
+        """Enqueue a handoff for resolution. Returns False once the lane
+        is stopped — the caller must resolve (or abandon) inline."""
+        with self._cv:
+            if self._stopped:
+                return False
+            self._queue.append(handoff)
+            if self._thread is None:
+                # each handoff carries its run_span explicitly and
+                # _Handoff.resolve() re-attaches it on the lane thread
+                # dingolint: ok[context-handoff] span travels in the handoff
+                self._thread = threading.Thread(
+                    target=self._loop, name=self._name, daemon=True
+                )
+                self._thread.start()
+            self._cv.notify_all()
+        return True
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._queue) + (1 if self._active else 0)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopped:
+                    self._cv.wait(timeout=0.5)
+                if not self._queue:
+                    if self._stopped:
+                        return
+                    continue
+                handoff = self._queue.popleft()
+                self._active = True
+            try:
+                handoff.resolve()
+            except Exception:  # noqa: BLE001 — handoff owns its futures
+                pass
+            finally:
+                with self._cv:
+                    self._active = False
+                    self._cv.notify_all()
+
+    def stop(self, drain: bool = True, timeout: float = 10.0) -> None:
+        """Stop the lane. drain=True resolves everything queued first
+        (futures get real results); drain=False abandons queued handoffs
+        (futures fail fast, device leases still released)."""
+        with self._cv:
+            self._stopped = True
+            abandoned: Tuple = ()
+            if not drain:
+                abandoned = tuple(self._queue)
+                self._queue.clear()
+            self._cv.notify_all()
+        for handoff in abandoned:
+            try:
+                handoff.abandon()
+            except Exception:  # noqa: BLE001
+                pass
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=timeout)
+
+
+class KeyedStaging:
+    """Map coalescer keys to their StagingRing lazily (a key's first
+    pipelined flush creates its ring)."""
+
+    def __init__(self, depth: int = 2):
+        self.depth = max(1, int(depth))
+        self._lock = threading.Lock()
+        self._rings: Dict[Any, StagingRing] = {}
+
+    def ring(self, key) -> StagingRing:
+        with self._lock:
+            ring = self._rings.get(key)
+            if ring is None:
+                ring = self._rings[key] = StagingRing(self.depth)
+            return ring
+
+    def close(self) -> None:
+        with self._lock:
+            rings = list(self._rings.values())
+            self._rings.clear()
+        for ring in rings:
+            ring.close()

@@ -235,6 +235,30 @@ class Storage:
         reader = self.engine.new_vector_reader(region)
         return reader.vector_batch_search(queries, topk, **kw)
 
+    def vector_batch_search_async(
+        self, region: Region, queries: np.ndarray, topk: int, **kw
+    ):
+        """Dispatch-now/resolve-later arm of vector_batch_search (serving
+        pipeline): same guards, returns the reader's resolve thunk."""
+        from dingo_tpu.index.vector_reader import is_binary_dim_param
+
+        qdtype = (
+            np.uint8
+            if is_binary_dim_param(region.definition.index_parameter)
+            else np.float32
+        )
+        queries = np.asarray(queries, qdtype)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if len(queries) > VECTOR_MAX_BATCH_COUNT:
+            raise InvalidParameter("too many queries")
+        if topk * len(queries) > MAX_TOPN_BATCH_PRODUCT:
+            raise InvalidParameter(
+                "topN * batch exceeds guard (index_service.cc:206)"
+            )
+        reader = self.engine.new_vector_reader(region)
+        return reader.vector_batch_search_async(queries, topk, **kw)
+
     def vector_batch_query(self, region: Region, ids: Sequence[int], **kw):
         return self.engine.new_vector_reader(region).vector_batch_query(ids, **kw)
 

@@ -31,8 +31,14 @@ from dingo_tpu.index.base import (
 )
 from dingo_tpu.index.rerank_cache import DeviceRerankCache
 from dingo_tpu.index.slot_store import SlotStore, SqSlotStore, _next_pow2
-from dingo_tpu.ops.distance import Metric, np_normalize, score_matrix, scores_to_distances
-from dingo_tpu.ops.topk import topk_scores
+from dingo_tpu.ops.distance import (
+    Metric,
+    device_wait_span,
+    np_normalize,
+    score_matrix,
+    scores_to_distances,
+)
+from dingo_tpu.ops.topk import begin_host_fetch, topk_scores
 from dingo_tpu.obs.quality import QUALITY
 from dingo_tpu.obs.sentinel import sentinel_jit
 
@@ -325,6 +331,7 @@ class _SlotStoreIndex(VectorIndex):
         queries: np.ndarray,
         topk: int,
         filter_spec: Optional[FilterSpec] = None,
+        staged=None,
     ) -> Callable[[], List[SearchResult]]:
         """Dispatch the search and return a thunk materializing results.
 
@@ -332,10 +339,23 @@ class _SlotStoreIndex(VectorIndex):
         (~60-80 ms vs ~4 ms kernel); callers with concurrent requests
         (service layer, bench) dispatch many searches and resolve later,
         pipelining the device. Slots freed while a search is in flight park
-        in limbo (slot_store.py) so resolve never misattributes results."""
+        in limbo (slot_store.py) so resolve never misattributes results.
+
+        ``staged`` (common/pipeline.StagedBatch) carries a pre-padded
+        device upload from the serving pipeline's staging ring; it is
+        claimed only when its identity check proves it was built from
+        THESE queries (``_prep_queries`` rebinding — binary bit-unpack,
+        dtype cast — makes the claim fail and the local pad run instead).
+
+        One-sync contract: resolve() performs exactly ONE
+        ``jax.device_get`` on the whole fetch tuple (dists, slots, and
+        the prune-stats block when present) — dingolint's resolve-sync
+        checker enforces this across index families."""
         queries = self._prep_queries(queries)
         b = queries.shape[0]
-        qpad = jnp.asarray(_pad_batch(queries))
+        qpad = staged.take(queries) if staged is not None else None
+        if qpad is None:
+            qpad = jnp.asarray(_pad_batch(queries))
         store = self.store
         # lease BEFORE dispatch: kernel-produced slots must stay limbo-
         # parked (not reassigned) until resolve translates them
@@ -368,26 +388,21 @@ class _SlotStoreIndex(VectorIndex):
         if kprime is not None:
             # sampled traces get a true ops.rerank kernel-time span
             # (outside the lock; no-op when the request isn't sampled)
-            from dingo_tpu.ops.distance import device_wait_span
-
             device_wait_span("rerank", (dists, slots))
-        # Start the D2H copy as soon as the kernel finishes: the tunnel's
-        # fetch RTT then overlaps across in-flight searches instead of
+        # Start the D2H copy as soon as the kernel finishes — ONE group
+        # covering the whole reply (stats included): the tunnel's fetch
+        # RTT then overlaps across in-flight searches instead of
         # serializing at resolve time.
-        dists.copy_to_host_async()
-        slots.copy_to_host_async()
-        if stats is not None:
-            stats.copy_to_host_async()
+        fetch = begin_host_fetch(dists, slots, stats)
         # trace hook OUTSIDE the device lock: a sampled request blocks for
         # a true kernel-time span without stalling concurrent searches
-        from dingo_tpu.ops.distance import device_wait_span
-
         device_wait_span("flat_scan", (dists, slots))
         def resolve() -> List[SearchResult]:
             try:
-                dists_h, slots_h = jax.device_get((dists, slots))
+                fetched = jax.device_get(fetch)
+                dists_h, slots_h = fetched[0], fetched[1]
                 if stats is not None:
-                    self._note_prune_stats(jax.device_get(stats)[:b])
+                    self._note_prune_stats(fetched[2][:b])
                 ids = store.ids_of_slots(slots_h[:b])
                 dists_h = self._convert_distances(dists_h)
                 # head-sampled shadow scoring (async lane; noop at rate 0);

@@ -363,6 +363,7 @@ class TpuHnsw(_SlotStoreIndex):
         topk: int,
         filter_spec: Optional[FilterSpec] = None,
         ef: Optional[int] = None,
+        staged=None,
     ):
         queries = self._prep_queries(queries)
         b = queries.shape[0]
@@ -373,10 +374,10 @@ class TpuHnsw(_SlotStoreIndex):
         self._count_search()
         if self._device_search_on():
             return self._device_search_async(
-                queries, b, int(topk), filter_spec, ef
+                queries, b, int(topk), filter_spec, ef, staged=staged
             )
         return self._host_search_async(queries, b, int(topk), filter_spec,
-                                       ef)
+                                       ef, staged=staged)
 
     def _device_search_on(self) -> bool:
         from dingo_tpu.common.config import hnsw_device_enabled
@@ -395,7 +396,8 @@ class TpuHnsw(_SlotStoreIndex):
             return max(fixed, topk)
         return max(shape_bucket(max(ef, topk)), 1)
 
-    def _device_search_async(self, queries, b, topk, filter_spec, ef):
+    def _device_search_async(self, queries, b, topk, filter_spec, ef,
+                             staged=None):
         from dingo_tpu.common.config import FLAGS
         from dingo_tpu.ops.beam import beam_search
 
@@ -404,7 +406,11 @@ class TpuHnsw(_SlotStoreIndex):
         max_iters = max(1, int(FLAGS.get("hnsw_max_iters")))
         METRICS.counter("hnsw.device_searches", region_id=self.id).add(1)
         prep = self._prep_filter(filter_spec)
-        qpad = jnp.asarray(_pad_batch(queries))
+        # staging-ring upload (serving pipeline): claimed only when the
+        # identity check proves it was built from THESE queries
+        qpad = staged.take(queries) if staged is not None else None
+        if qpad is None:
+            qpad = jnp.asarray(_pad_batch(queries))
         lease = store.begin_search()
         try:
             with store.device_lock:
@@ -440,8 +446,13 @@ class TpuHnsw(_SlotStoreIndex):
         except Exception:
             lease.release()
             raise
-        dists.copy_to_host_async()
-        out_slots.copy_to_host_async()
+        # one-sync epilogue: walk diagnostics (hops/vcount/occ) join the
+        # SAME D2H copy group as the reply — previously they rode the
+        # device_get cold (no async copy started), adding a serialized
+        # transfer to every resolve
+        from dingo_tpu.ops.topk import begin_host_fetch
+
+        fetch = begin_host_fetch(dists, out_slots, hops, vcount, occ)
         from dingo_tpu.ops.distance import device_wait_span
 
         device_wait_span("beam_search", (dists, out_slots))
@@ -449,7 +460,7 @@ class TpuHnsw(_SlotStoreIndex):
         def resolve() -> List[SearchResult]:
             try:
                 dists_h, slots_h, hops_h, vc_h, occ_h = jax.device_get(
-                    (dists, out_slots, hops, vcount, occ)
+                    fetch
                 )
                 self._note_walk_stats(
                     hops_h[:b], vc_h[:b], occ_h[:b], cap, beam
@@ -470,7 +481,8 @@ class TpuHnsw(_SlotStoreIndex):
 
         return resolve
 
-    def _host_search_async(self, queries, b, topk, filter_spec, ef):
+    def _host_search_async(self, queries, b, topk, filter_spec, ef,
+                           staged=None):
         METRICS.counter("hnsw.host_searches", region_id=self.id).add(1)
         # 1) CPU graph: over-fetched candidate labels per query.
         cand_labels = np.empty((b, ef), np.int64)
@@ -497,7 +509,9 @@ class TpuHnsw(_SlotStoreIndex):
             safe = np.where(slots >= 0, slots, 0)
             valid &= fmask[safe]
         # 3) exact device rerank (shared with the device path).
-        qpad = jnp.asarray(_pad_batch(queries))
+        qpad = staged.take(queries) if staged is not None else None
+        if qpad is None:
+            qpad = jnp.asarray(_pad_batch(queries))
         bb = qpad.shape[0]
         cand = np.where(valid, slots, -1).astype(np.int32)
         if bb != b:
@@ -514,12 +528,13 @@ class TpuHnsw(_SlotStoreIndex):
         except Exception:
             lease.release()
             raise
-        dists.copy_to_host_async()
-        out_slots.copy_to_host_async()
+        from dingo_tpu.ops.topk import begin_host_fetch
+
+        fetch = begin_host_fetch(dists, out_slots)
 
         def resolve() -> List[SearchResult]:
             try:
-                dists_h, slots_h = jax.device_get((dists, out_slots))
+                dists_h, slots_h = jax.device_get(fetch)
                 ids = store.ids_of_slots(slots_h[:b])
                 from dingo_tpu.obs.quality import QUALITY
 

@@ -87,7 +87,7 @@ from dingo_tpu.ops.kmeans import (
     kmeans_assign,
     train_kmeans,
 )
-from dingo_tpu.ops.topk import merge_topk, topk_scores
+from dingo_tpu.ops.topk import begin_host_fetch, merge_topk, topk_scores
 
 
 def coarse_probes(queries, centroids, c_sqnorm, nprobe):
@@ -784,6 +784,7 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         topk: int,
         filter_spec: Optional[FilterSpec] = None,
         nprobe: Optional[int] = None,
+        staged=None,
     ):
         if not self.is_trained():
             raise NotTrained("IVF_FLAT not trained")  # reader falls back
@@ -800,7 +801,11 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         )
         kprime = self._rerank_shortlist(topk)
         k_eff, nprobe = self._shape_buckets(max(topk, kprime or 0), nprobe)
-        qpad = jnp.asarray(_pad_batch(queries))
+        # staging-ring upload (serving pipeline): claimed only when the
+        # identity check proves it was built from THESE queries
+        qpad = staged.take(queries) if staged is not None else None
+        if qpad is None:
+            qpad = jnp.asarray(_pad_batch(queries))
         # lease BEFORE dispatch: kernel slots must stay limbo-parked until
         # resolve translates them (delete+reinsert would misattribute)
         lease = self.store.begin_search()
@@ -905,17 +910,17 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
             # (outside the lock; no-op for unsampled requests)
             device_wait_span("rerank", (dists, slots))
         store = self.store
-        dists.copy_to_host_async()
-        slots.copy_to_host_async()
-        if stats is not None:
-            stats.copy_to_host_async()
+        # one-sync epilogue: the whole reply (prune stats included) joins
+        # a single D2H copy group; resolve device_gets it exactly once
+        fetch = begin_host_fetch(dists, slots, stats)
         def resolve() -> List[SearchResult]:
             try:
-                dists_h, slots_h = jax.device_get((dists, slots))
+                fetched = jax.device_get(fetch)
+                dists_h, slots_h = fetched[0], fetched[1]
                 if stats is not None:
                     # pruned-fraction observability rides the result
                     # fetch — no extra sync on the dispatch path
-                    self._note_prune_stats(jax.device_get(stats)[:b])
+                    self._note_prune_stats(fetched[2][:b])
                 # shape bucketing may have run a larger k; slice back
                 ids = store.ids_of_slots(slots_h[:b, :topk])
                 dists_h = self._convert_distances(dists_h[:b, :topk])

@@ -549,10 +549,15 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
         topk: int,
         filter_spec: Optional[FilterSpec] = None,
         nprobe: Optional[int] = None,
+        staged=None,
     ):
         queries = self._prep_queries(queries)
         b = queries.shape[0]
-        qpad = jnp.asarray(_pad_batch(queries))
+        # staging-ring upload (serving pipeline): claimed only when the
+        # identity check proves it was built from THESE queries
+        qpad = staged.take(queries) if staged is not None else None
+        if qpad is None:
+            qpad = jnp.asarray(_pad_batch(queries))
         store = self.store
         # lease BEFORE any kernel dispatch: slots produced by the kernel
         # must stay stable (limbo-parked, not reassigned) until resolve
@@ -689,8 +694,9 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
         except Exception:
             lease.release()
             raise
-        dists.copy_to_host_async()
-        slots.copy_to_host_async()
+        from dingo_tpu.ops.topk import begin_host_fetch
+
+        fetch = begin_host_fetch(dists, slots)
 
         def resolve() -> List[SearchResult]:
             try:
@@ -698,14 +704,19 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
                     # ADC was a prune; the exact rows sit in host memory
                     # (host_vectors mode), so rerank at RESOLVE time — the
                     # dispatch above stays non-blocking and the device keeps
-                    # pipelining (diskann/core.py prune+rerank recipe)
-                    cand = np.asarray(jax.device_get(slots))[:b]
+                    # pipelining (diskann/core.py prune+rerank recipe).
+                    # Two syncs are INHERENT to this arm: the candidate
+                    # slots must reach the host before the row gather can
+                    # even start, and the rerank's output is a second
+                    # device round-trip (adjudicated resolve-sync
+                    # exception — see dingolint baseline).
+                    cand = np.asarray(jax.device_get(fetch)[1])[:b]
                     d_r, s_r = _exact_rerank_host(
                         store, qpad[:b], cand, int(topk), self.metric
                     )
                     dists_h, slots_h = jax.device_get((d_r, s_r))
                 else:
-                    dists_h, slots_h = jax.device_get((dists, slots))
+                    dists_h, slots_h = jax.device_get(fetch)
                 # shape bucketing may have run a larger k; slice back
                 ids = store.ids_of_slots(slots_h[:b, : int(topk)])
                 # head-sampled shadow scoring (async lane; noop at rate 0)

@@ -172,6 +172,13 @@ class SlotStore:
         # (it translates them to -1/dropped instead of to the wrong id).
         self._inflight: int = 0
         self._limbo: list[int] = []
+        # Guards the _inflight/_limbo/_free transitions: end_search's
+        # check-then-drain and remove_slots' limbo-vs-free choice are
+        # read-modify-write pairs, and with the serving pipeline's
+        # completion lane they run on a thread of their own — unlocked,
+        # a release racing a writer could drain a slot to _free while
+        # the search that must still translate it is in flight.
+        self._lease_lock = threading.Lock()
         # Serializes DONATED device writes against kernel dispatch: the DUS
         # write path donates vecs/sqnorm (invalidating the old Array), so a
         # concurrent search must not dispatch with a stale reference (the
@@ -380,15 +387,16 @@ class SlotStore:
         here avoids a second id->slot resolution pass before removal."""
         slots = np.full(len(ids), -1, np.int64)
         removed = 0
-        dest = self._limbo if self._inflight > 0 else self._free
-        for i, vid in enumerate(ids):
-            s = self._id_to_slot.pop(int(vid), None)
-            if s is not None:
-                self.ids_by_slot[s] = -1
-                self.valid_h[s] = False
-                dest.append(s)
-                slots[i] = s
-                removed += 1
+        with self._lease_lock:
+            dest = self._limbo if self._inflight > 0 else self._free
+            for i, vid in enumerate(ids):
+                s = self._id_to_slot.pop(int(vid), None)
+                if s is not None:
+                    self.ids_by_slot[s] = -1
+                    self.valid_h[s] = False
+                    dest.append(s)
+                    slots[i] = s
+                    removed += 1
         if removed:
             self._dmask = None
             self.mutation_version += 1
@@ -396,14 +404,16 @@ class SlotStore:
 
     # -- in-flight search accounting --------------------------------------
     def begin_search(self) -> "SearchLease":
-        self._inflight += 1
+        with self._lease_lock:
+            self._inflight += 1
         return SearchLease(self)
 
     def end_search(self) -> None:
-        self._inflight -= 1
-        if self._inflight == 0 and self._limbo:
-            self._free.extend(self._limbo)
-            self._limbo.clear()
+        with self._lease_lock:
+            self._inflight -= 1
+            if self._inflight == 0 and self._limbo:
+                self._free.extend(self._limbo)
+                self._limbo.clear()
 
     def _grow(self, new_capacity: int) -> None:
         new_capacity = _next_pow2(new_capacity)

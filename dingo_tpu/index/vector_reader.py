@@ -191,6 +191,79 @@ class VectorReader:
                 queries, topk, filter_mode, filter_type, **kw
             )
 
+    def vector_batch_search_async(
+        self,
+        queries: np.ndarray,
+        topk: int,
+        staged=None,
+        stage_us: Optional[dict] = None,
+        **search_kw,
+    ):
+        """Dispatch-now/resolve-later arm of vector_batch_search for the
+        serving pipeline's coalescer: kernels enqueue here (flush
+        thread), the returned thunk performs the reply's single host
+        sync (completion lane). PLAIN searches only — the coalescer's
+        plain-path conditions (no filters, no radius, no data backfill)
+        are exactly the shapes whose whole post-kernel work is the one
+        fetch. Anything that cannot stay async — degraded region,
+        wrapper not ready/supported, a dispatch-time error — falls back
+        to a thunk around the full sync path, which keeps its brute-
+        force and OOM-recovery ladders. ``stage_us`` is filled at
+        RESOLVE time: search_us there is the device wait, which the
+        coalescer books as kernel time (the dispatch stage is accounted
+        separately)."""
+        import time as _time
+
+        queries = np.asarray(queries,
+                             np.uint8 if self._binary else np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+
+        def sync_thunk():
+            return self.vector_batch_search(
+                queries, topk, stage_us=stage_us, **search_kw
+            )
+
+        from dingo_tpu.index.recovery import RECOVERY
+
+        wrapper = self.ctx.index_wrapper
+        if (wrapper is None or not wrapper.is_ready()
+                or RECOVERY.is_degraded(self.ctx.region_id)):
+            return sync_thunk
+        base = FilterSpec(ranges=[self.ctx.id_window()])
+        with TRACER.start_span("index.search") as span:
+            if span.sampled:
+                span.set_attr("region_id", self.ctx.region_id)
+                span.set_attr("batch", int(queries.shape[0]))
+                span.set_attr("topk", int(topk))
+                span.set_attr("pipelined", True)
+            try:
+                thunk = wrapper.search_async(
+                    queries, topk, base, staged=staged, **search_kw
+                )
+            except Exception:  # noqa: BLE001 — sync path re-raises real
+                # errors through its own fallback/recovery ladders
+                return sync_thunk
+
+        def resolve() -> List[List[VectorWithData]]:
+            t0 = _time.perf_counter_ns()
+            results = thunk()
+            out = [
+                [VectorWithData(int(i), float(d))
+                 for i, d in zip(r.ids, r.distances)]
+                for r in results
+            ]
+            if stage_us is not None:
+                total_ns = _time.perf_counter_ns() - t0
+                stage_us["prefilter_us"] = 0
+                stage_us["postfilter_us"] = 0
+                stage_us["backfill_us"] = 0
+                stage_us["search_us"] = total_ns // 1000
+                stage_us["total_us"] = total_ns // 1000
+            return out
+
+        return resolve
+
     def _batch_search_impl(
         self,
         queries: np.ndarray,

@@ -12,7 +12,7 @@ materialized view).
 from __future__ import annotations
 
 import threading
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 import numpy as np
 
@@ -246,6 +246,31 @@ class VectorIndexWrapper:
                 for a, b in zip(results, other)
             ]
         return results
+
+    def search_async(
+        self,
+        queries: np.ndarray,
+        topk: int,
+        filter_spec: Optional[FilterSpec] = None,
+        staged=None,
+        **kw,
+    ) -> Callable[[], List[SearchResult]]:
+        """Dispatch-now/resolve-later arm of search() for the serving
+        pipeline: kernels enqueue here, the returned thunk performs the
+        single host sync. The sibling-merge window (post-merge, absorbed
+        region still serving its id range) falls back to a thunk around
+        the serial path — merging two result sets needs both on host
+        anyway, and the window is short-lived."""
+        idx = self.active()
+        if idx is None:
+            raise VectorIndexError(f"vector index {self.id} not ready")
+        sibling = self.sibling_index
+        if sibling is not None and sibling.active() is not None:
+            return lambda: self.search(queries, topk, filter_spec, **kw)
+        dispatch = getattr(idx, "search_async", None)
+        if dispatch is None:
+            return lambda: idx.search(queries, topk, filter_spec, **kw)
+        return dispatch(queries, topk, filter_spec, staged=staged, **kw)
 
     # -- policies --------------------------------------------------------------
     def need_to_save(self) -> bool:

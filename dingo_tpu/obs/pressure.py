@@ -397,7 +397,10 @@ class PressurePlane:
         """Per-stage time-budget accounting: each stage's share of the
         request's deadline, observed in PERCENT (the recorder's p50/p99
         then read as 'the kernel stage typically eats N% of the grant').
-        Stages: queue / batch_form / kernel / rerank."""
+        Stages: queue / batch_form / kernel / rerank, plus dispatch on
+        the pipelined path (the kernel-enqueue + staging cost the
+        overlapped flush pays per batch — booked separately so it never
+        inflates the kernel fraction the SLO tuner reads)."""
         if budget is None or budget.deadline_ms <= 0:
             return
         for stage, ms in stages_ms.items():

@@ -28,6 +28,14 @@ descending) so they drop in right after any scan kernel:
                           from the same candidate set; chain
                           cached_rerank_device after it to upgrade cached
                           rows to true f32-exact scores.
+
+One-sync epilogue contract (serving pipeline): every device rerank here
+CHAINS onto the scan in the same stream and its outputs join the reply's
+single ``copy_to_host_async`` group (ops/topk.begin_host_fetch) — a
+family's resolve() then performs exactly one ``jax.device_get`` for
+rerank + stats + top-k together. The host rerank above is the one
+adjudicated exception (two syncs are inherent to a host gather);
+dingolint's resolve-sync checker enforces the rest.
 """
 
 from __future__ import annotations

@@ -49,6 +49,28 @@ def topk_scores(
     return vals, out_ids
 
 
+def begin_host_fetch(*arrays):
+    """Start ONE D2H copy group for a reply's whole fetch tuple.
+
+    The one-sync epilogue contract (serving pipeline): everything a
+    resolve() needs on the host — distances, slots, prune stats,
+    diagnostic counters — joins a single ``copy_to_host_async`` group
+    here, and resolve performs exactly one ``jax.device_get`` on the
+    returned tuple. None entries are dropped (optional members like the
+    prune-stats block just don't join), so the caller indexes the
+    result positionally over its non-None arguments. Host-side values
+    (numpy fallbacks) pass through untouched."""
+    out = []
+    for a in arrays:
+        if a is None:
+            continue
+        start = getattr(a, "copy_to_host_async", None)
+        if start is not None:
+            start()
+        out.append(a)
+    return tuple(out)
+
+
 def merge_topk(
     scores_a: jax.Array,
     ids_a: jax.Array,

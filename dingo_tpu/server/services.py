@@ -165,7 +165,22 @@ class IndexService:
                         **dict(kw_items)
                     )
 
-                self._coalescer = SearchCoalescer(run, window_ms=window)
+                def dispatch(key, stacked, staged=None, stage_us=None):
+                    # pipelined arm (pipeline.enabled): enqueue kernels
+                    # now, return the resolve thunk — the coalescer's
+                    # completion lane performs the one host sync
+                    region_id, topn, kw_items = key
+                    region = self.node.get_region(region_id)
+                    if region is None:
+                        raise VectorIndexError(f"region {region_id} gone")
+                    return self.node.storage.vector_batch_search_async(
+                        region, stacked, topn, staged=staged,
+                        stage_us=stage_us, **dict(kw_items)
+                    )
+
+                self._coalescer = SearchCoalescer(
+                    run, window_ms=window, dispatch_fn=dispatch
+                )
             return self._coalescer
 
     def close(self) -> None:

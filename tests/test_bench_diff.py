@@ -224,3 +224,38 @@ def test_integrity_overhead_growth_is_a_regression():
     result = bench_diff.compare(old, new)
     assert [r["path"] for r in result["regressions"]] == [
         "integrity_scrub.p99_overhead_pct"]
+
+
+def test_pipeline_sweep_classification():
+    """ISSUE 15: the pipeline_sweep scenario rides the key-name rules —
+    saturation_qps is a throughput figure, dispatch_overhead_pct an
+    absolute-points overhead figure, steady_state_recompiles the zero
+    invariant; stage fractions and sha strings are diagnostics."""
+    assert bench_diff.classify(
+        "pipeline_sweep.depths.2.saturation_qps") == "qps"
+    assert bench_diff.classify(
+        "pipeline_sweep.depths.2.dispatch_overhead_pct") == "overhead"
+    assert bench_diff.classify(
+        "pipeline_sweep.depths.2.steady_state_recompiles") == "recompiles"
+    assert bench_diff.classify(
+        "pipeline_sweep.depths.2.stage_fractions.dispatch") is None
+
+
+def test_pipeline_sweep_regressions(tmp_path):
+    old = {"pipeline_sweep": {
+        "serial": {"saturation_qps": 4000.0,
+                   "steady_state_recompiles": 0},
+        "depths": {"2": {"saturation_qps": 5000.0,
+                         "dispatch_overhead_pct": 4.0,
+                         "steady_state_recompiles": 0}},
+    }}
+    new = copy.deepcopy(old)
+    new["pipeline_sweep"]["depths"]["2"]["saturation_qps"] = 2000.0
+    new["pipeline_sweep"]["depths"]["2"]["dispatch_overhead_pct"] = 12.0
+    new["pipeline_sweep"]["depths"]["2"]["steady_state_recompiles"] = 1
+    result = bench_diff.compare(old, new)
+    assert sorted(r["path"] for r in result["regressions"]) == [
+        "pipeline_sweep.depths.2.dispatch_overhead_pct",
+        "pipeline_sweep.depths.2.saturation_qps",
+        "pipeline_sweep.depths.2.steady_state_recompiles",
+    ]

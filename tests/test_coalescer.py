@@ -264,3 +264,71 @@ def test_submit_racing_stop_never_hangs(qos):
             assert served + stopped == 160
     finally:
         FLAGS.set("qos_enabled", False)
+
+
+def test_pipelined_pending_batches_drain_on_stop():
+    """stop(drain=True) before the window expires, pipelined mode: the
+    never-dispatched pending batches still resolve to real results (the
+    leftovers drain through the serial arm; the completion lane honors
+    the same contract for anything already dispatched)."""
+    from dingo_tpu.common.config import FLAGS
+
+    FLAGS.set("pipeline_enabled", "true")
+    try:
+        def dispatch(key, stacked, staged=None):
+            return lambda: list(range(len(stacked)))
+
+        co = SearchCoalescer(lambda k, q: list(range(len(q))),
+                             window_ms=10_000.0, dispatch_fn=dispatch)
+        futs = [co.submit("k", np.zeros((2, 4), np.float32))
+                for _ in range(3)]
+        co.stop(drain=True)
+        for f in futs:
+            assert len(f.result(timeout=5)) == 2
+    finally:
+        FLAGS.set("pipeline_enabled", "auto")
+
+
+def test_pipelined_submit_stop_race_storm():
+    """The submit-vs-stop determinism contract holds with the pipelined
+    arm on: every future resolves to a result or CoalescerStopped — no
+    hangs on the flush thread OR the completion lane."""
+    from dingo_tpu.common.config import FLAGS
+
+    FLAGS.set("pipeline_enabled", "true")
+    try:
+        for trial in range(6):
+            def dispatch(key, stacked, staged=None):
+                return lambda: list(range(len(stacked)))
+
+            co = SearchCoalescer(lambda k, q: list(range(len(q))),
+                                 window_ms=1.0, dispatch_fn=dispatch)
+            start = threading.Barrier(4)
+            futs: list = []
+            flock = threading.Lock()
+
+            def submitter():
+                start.wait()
+                for _ in range(30):
+                    f = co.submit("k", np.zeros((1, 2), np.float32))
+                    with flock:
+                        futs.append(f)
+
+            threads = [threading.Thread(target=submitter)
+                       for _ in range(3)]
+            for t in threads:
+                t.start()
+            start.wait()
+            time.sleep(0.0015 * trial)
+            co.stop(drain=(trial % 2 == 0))
+            for t in threads:
+                t.join(timeout=10)
+                assert not t.is_alive()
+            assert len(futs) == 90
+            for f in futs:
+                try:
+                    f.result(timeout=5)
+                except CoalescerStopped:
+                    pass
+    finally:
+        FLAGS.set("pipeline_enabled", "auto")

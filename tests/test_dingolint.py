@@ -22,6 +22,7 @@ from tools.dingolint.checkers.host_sync import HostSyncChecker
 from tools.dingolint.checkers.ladder_shape import LadderShapeChecker
 from tools.dingolint.checkers.lock_order import LockOrderChecker
 from tools.dingolint.checkers.metric_names import MetricNamesChecker
+from tools.dingolint.checkers.resolve_sync import ResolveSyncChecker
 
 
 def _lint(tmp_path, rel, source, checker, root_rel=None):
@@ -213,6 +214,124 @@ def test_host_sync_hidden_cast_flagged(tmp_path):
 def test_host_sync_outside_search_modules_ignored(tmp_path):
     findings = _lint(tmp_path, "dingo_tpu/metrics/x.py", _HOT_SYNC,
                      HostSyncChecker())
+    assert findings == []
+
+
+# -- resolve-sync ------------------------------------------------------------
+
+_TWO_SYNC_RESOLVE = """
+    import jax
+
+    class Idx:
+        def search_async(self, queries, topk):
+            fetch = self._dispatch(queries)
+
+            def resolve():
+                dists = jax.device_get(fetch)
+                extra = jax.device_get(self._stats)   # BAD: second sync
+                return dists, extra
+
+            return resolve
+"""
+
+
+def test_resolve_sync_flags_second_device_get(tmp_path):
+    findings = _lint(tmp_path, "dingo_tpu/index/bad.py",
+                     _TWO_SYNC_RESOLVE, ResolveSyncChecker())
+    assert len(findings) == 1
+    assert "second jax.device_get" in findings[0].message
+    assert findings[0].symbol.endswith("resolve")
+
+
+def test_resolve_sync_branch_exclusive_arms_clean(tmp_path):
+    src = """
+        import jax
+
+        class Idx:
+            def search_async(self, queries, topk, rerank):
+                fetch = self._dispatch(queries)
+
+                def resolve():
+                    if rerank:
+                        return jax.device_get(fetch)[0]
+                    else:
+                        return jax.device_get(fetch)
+
+                return resolve
+    """
+    assert _lint(tmp_path, "dingo_tpu/index/arms.py", src,
+                 ResolveSyncChecker()) == []
+
+
+def test_resolve_sync_flags_block_until_ready(tmp_path):
+    src = """
+        import jax
+
+        class Idx:
+            def search_async(self, queries):
+                fetch = self._dispatch(queries)
+
+                def resolve():
+                    jax.block_until_ready(fetch)   # BAD: fetch IS the wait
+                    return jax.device_get(fetch)
+
+                return resolve
+    """
+    findings = _lint(tmp_path, "dingo_tpu/index/blk.py", src,
+                     ResolveSyncChecker())
+    assert len(findings) == 1
+    assert "block_until_ready" in findings[0].message
+
+
+def test_resolve_sync_flags_reachable_helper(tmp_path):
+    src = """
+        import jax
+
+        def _note_stats(arr):
+            host = jax.device_get(arr)      # BAD: sync under resolve()
+            return host.sum()
+
+        class Idx:
+            def search_async(self, queries):
+                fetch = self._dispatch(queries)
+
+                def resolve():
+                    out = jax.device_get(fetch)
+                    _note_stats(self._stats)
+                    return out
+
+                return resolve
+    """
+    findings = _lint(tmp_path, "dingo_tpu/index/helper.py", src,
+                     ResolveSyncChecker())
+    assert len(findings) == 1
+    assert "helper reachable from resolve" in findings[0].message
+    assert findings[0].symbol == "_note_stats"
+
+
+def test_resolve_sync_flags_coalescer_flush_thread(tmp_path):
+    src = """
+        import jax
+
+        class SearchCoalescer:
+            def _dispatch(self, key, batch):
+                thunk = self.dispatch_fn(key, batch)
+                return jax.device_get(thunk)   # BAD: sync on flush thread
+
+        class _Handoff:
+            def resolve(self):
+                return jax.device_get(self.thunk())   # ok: completion lane
+    """
+    findings = _lint(tmp_path, "dingo_tpu/common/coal.py", src,
+                     ResolveSyncChecker())
+    assert len(findings) == 1
+    assert "SearchCoalescer" in findings[0].message
+    assert findings[0].symbol == "SearchCoalescer._dispatch"
+
+
+def test_resolve_sync_outside_index_modules_ignored(tmp_path):
+    findings = _lint(tmp_path, "dingo_tpu/obs/x.py", _TWO_SYNC_RESOLVE,
+                     ResolveSyncChecker())
     assert findings == []
 
 
@@ -501,7 +620,7 @@ def test_cli_json_mode(capsys):
     out = json.loads(capsys.readouterr().out)
     assert rc == 0 and out["ok"] is True
     assert out["wall_s"] < 30.0
-    assert len(out["checkers"]) == 7
+    assert len(out["checkers"]) == 8
     assert out["findings"] == []
     assert len(out["baselined"]) >= 1
 

@@ -171,6 +171,82 @@ def test_expiry_in_queue_skips_kernel_entirely(qos_flags):
         co.stop()
 
 
+def test_pipelined_expiry_checked_at_real_dispatch(qos_flags):
+    """A cap-displaced batch rides the ready queue until the timer
+    thread dispatches it; on the pipelined arm expiry runs inside
+    _dispatch — i.e. at REAL dispatch time — so a budget that died in
+    the ready queue never reaches dispatch_fn."""
+    FLAGS.set("pipeline_enabled", "true")
+    dispatched = []
+
+    def dispatch(key, stacked, staged=None):
+        dispatched.append(len(stacked))
+        return lambda: list(range(len(stacked)))
+
+    co = SearchCoalescer(lambda k, q: list(range(len(q))),
+                         window_ms=10_000.0, max_batch=4,
+                         dispatch_fn=dispatch)
+    try:
+        token = attach_budget(Budget(30.0))     # dies in the ready queue
+        try:
+            doomed = co.submit("k", np.zeros((2, 4), np.float32),
+                               region_id=79)
+        finally:
+            detach_budget(token)
+        time.sleep(0.06)                        # budget now dead
+        # displace the pending batch to the ready queue: 2+4 > cap 4
+        token = attach_budget(Budget(60_000.0))
+        try:
+            live = co.submit("k", np.zeros((4, 4), np.float32),
+                             region_id=79)
+        finally:
+            detach_budget(token)
+        with pytest.raises(DeadlineExceeded, match="expired in queue"):
+            doomed.result(timeout=5)
+        # the displaced batch expired wholesale: no kernel dispatched
+        # for it (the 4-row batch that displaced it flushes at its full-
+        # ladder cap through the serial inline arm)
+        assert 2 not in dispatched, dispatched
+        assert len(live.result(timeout=5)) == 4
+    finally:
+        co.stop()
+        FLAGS.set("pipeline_enabled", "auto")
+
+
+def test_pipelined_dispatch_stage_accounted(qos_flags):
+    """The pipelined flush books its kernel-enqueue cost under the new
+    'dispatch' stage of the per-stage budget accounting."""
+    FLAGS.set("pipeline_enabled", "true")
+
+    def dispatch(key, stacked, staged=None):
+        return lambda: list(range(len(stacked)))
+
+    co = SearchCoalescer(lambda k, q: list(range(len(q))),
+                         window_ms=5.0, dispatch_fn=dispatch)
+    try:
+        stage0 = METRICS.latency(
+            "qos.stage_budget_pct",
+            labels={"stage": "dispatch"}).stats()["count"]
+        token = attach_budget(Budget(10_000.0))
+        try:
+            fut = co.submit("k", np.zeros((2, 4), np.float32))
+        finally:
+            detach_budget(token)
+        assert len(fut.result(timeout=5)) == 2
+        deadline = time.monotonic() + 5
+        while METRICS.latency(
+                "qos.stage_budget_pct",
+                labels={"stage": "dispatch"}).stats()["count"] <= stage0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert METRICS.latency(
+            "qos.stage_budget_pct",
+            labels={"stage": "dispatch"}).stats()["count"] > stage0
+    finally:
+        co.stop()
+        FLAGS.set("pipeline_enabled", "auto")
+
+
 def test_admission_shed_hopeless_and_priority_pressure(qos_flags):
     co = SearchCoalescer(lambda k, q: list(range(len(q))), window_ms=5.0)
     try:

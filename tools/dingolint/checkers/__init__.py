@@ -16,11 +16,13 @@ def all_checkers() -> List[Checker]:
     from tools.dingolint.checkers.ladder_shape import LadderShapeChecker
     from tools.dingolint.checkers.lock_order import LockOrderChecker
     from tools.dingolint.checkers.metric_names import MetricNamesChecker
+    from tools.dingolint.checkers.resolve_sync import ResolveSyncChecker
     from tools.dingolint.checkers.retry_policy import RetryPolicyChecker
 
     return [
         LockOrderChecker(),
         HostSyncChecker(),
+        ResolveSyncChecker(),
         BareJitChecker(),
         LadderShapeChecker(),
         ContextHandoffChecker(),
