@@ -1343,6 +1343,283 @@ def overload(platform):
     return result
 
 
+def zipf_cache(platform):
+    """ISSUE 16: serving-edge result cache + in-flight dedupe under
+    Zipf-skewed open-loop traffic, cache ON vs OFF per skew.
+
+    Real query streams are heavy-tailed; a result cache only earns its
+    bytes when the tail is actually heavy. This reuses the overload
+    harness (open-loop arrival at 2x measured capacity, deadlines from
+    the SCHEDULED instant, QoS shaping on in every arm) and sweeps the
+    Zipf exponent s over {0, 0.9, 1.2}: at s=0 every query is distinct
+    and the cache can only lose; at s>=0.9 repeats dominate and hits
+    bypass the QoS queue and the kernel entirely while in-flight dedupe
+    collapses duplicate rows inside one flush window.
+
+    Reported per (skew, arm): goodput, served p99, hit rate, deduped
+    rows, dispatched rows, recompiles. Gates: cache hits byte-identical
+    to an uncached dispatch of the same rows (the mutation_version key
+    makes this an identity, not an approximation), hit_rate > 0 at
+    s >= 0.9, zero steady-state recompiles in every arm (dedupe shrinks
+    batches but lands on the same pow2 pad ladder), and goodput(on) >
+    goodput(off) at s=1.2."""
+    import threading
+    import time as _time
+
+    from dingo_tpu.cache import edge as cache_edge
+    from dingo_tpu.common.config import FLAGS
+    from dingo_tpu.common.coalescer import SearchCoalescer
+    from dingo_tpu.common.metrics import METRICS
+    from dingo_tpu.index import IndexParameter, IndexType, new_index
+    from dingo_tpu.obs.pressure import (
+        PRESSURE,
+        Budget,
+        DeadlineExceeded,
+        RequestShed,
+        attach_budget,
+        detach_budget,
+    )
+
+    n = int(os.environ.get("DINGO_BENCH_ZIPF_N", 20_000))
+    d = int(os.environ.get("DINGO_BENCH_ZIPF_D", 64))
+    window_s = float(os.environ.get("DINGO_BENCH_ZIPF_S", 2.5))
+    nlist, nprobe, k = 32, 8, 10
+    req_rows = 4
+    pool_m = 512                 # distinct queries in the Zipf pool
+    deadline_ms = 250.0
+    rid = 1600
+    kw_items = (("nprobe", nprobe),)
+    rng = np.random.default_rng(29)
+    ncl = 64
+    centers = rng.standard_normal((ncl, d), dtype=np.float32)
+    x = centers[rng.integers(0, ncl, n)] + 0.3 * rng.standard_normal(
+        (n, d)).astype(np.float32)
+    ids = np.arange(n, dtype=np.int64)
+    idx = new_index(rid, IndexParameter(
+        index_type=IndexType.IVF_FLAT, dimension=d, ncentroids=nlist,
+        default_nprobe=nprobe,
+    ))
+    idx.store.reserve(n)
+    idx.upsert(ids, x)
+    idx.train()
+    max_batch = 64
+    warm = []
+    b = 1
+    while b <= max_batch:
+        warm.append(b)
+        b *= 2
+    idx.warmup(batches=tuple(warm), topk=k, nprobe=nprobe)
+    pool = x[rng.choice(n, pool_m, replace=False)] + 0.05 * (
+        rng.standard_normal((pool_m, d)).astype(np.float32))
+
+    dispatched_rows = [0]
+
+    def run(key, stacked):
+        dispatched_rows[0] += len(stacked)
+        res = idx.search(np.asarray(stacked), k, nprobe=nprobe)
+        # per-row reply as the (id, distance) item list services caches —
+        # plain python values, so byte-identity compares are exact
+        return [list(zip(r.ids.tolist(), r.distances.tolist()))
+                for r in res]
+
+    def measure_capacity():
+        FLAGS.set("qos_enabled", False)
+        co = SearchCoalescer(run, window_ms=2.0, max_batch=max_batch)
+        done = 0
+        t0 = _time.perf_counter()
+        while _time.perf_counter() - t0 < 1.2:
+            futs = [co.submit("cap", pool[:req_rows]) for _ in range(16)]
+            for f in futs:
+                f.result(timeout=30)
+                done += req_rows
+        dt = _time.perf_counter() - t0
+        co.stop()
+        return done / dt
+
+    capacity_rows_s = measure_capacity()
+    offered_rows_s = 2.0 * capacity_rows_s
+    interval_s = req_rows / offered_rows_s
+    log(f"zipf_cache: capacity ~{capacity_rows_s:,.0f} rows/s, offering "
+        f"{offered_rows_s:,.0f} rows/s for {window_s:.1f}s per arm")
+
+    def zipf_rows(s: float, count: int, arm_rng) -> np.ndarray:
+        if s <= 0.0:
+            return arm_rng.integers(0, pool_m, count)
+        w = 1.0 / np.arange(1, pool_m + 1, dtype=np.float64) ** s
+        w /= w.sum()
+        return arm_rng.choice(pool_m, size=count, p=w)
+
+    def one_arm(s: float, cache_on: bool):
+        FLAGS.set("qos_enabled", False)
+        FLAGS.set("qos_shed_policy", "degrade_drop")
+        FLAGS.set("qos_max_queue_ms", deadline_ms / 2.0)
+        FLAGS.set("cache_enabled", cache_on)
+        cache_edge.CACHE.reset()
+        co = SearchCoalescer(run, window_ms=3.0, max_batch=max_batch)
+        seed_end = _time.perf_counter() + 0.4
+        while _time.perf_counter() < seed_end:
+            for f in [co.submit("seed", pool[:req_rows])
+                      for _ in range(16)]:
+                f.result(timeout=30)
+        cache_edge.CACHE.reset()   # seeding must not pre-warm the cache
+        FLAGS.set("qos_enabled", True)
+        PRESSURE.reset()
+        dispatched_rows[0] = 0
+        recompiles_c = METRICS.counter("xla.recompiles")
+        recompiles0 = recompiles_c.get()
+        arm_rng = np.random.default_rng(int(31 + 100 * s) + int(cache_on))
+        lock = threading.Lock()
+        outcomes = []            # (kind, latency_ms_from_sched)
+
+        def record(kind, sched_t):
+            lat_ms = (_time.monotonic() - sched_t) * 1000.0
+            with lock:
+                outcomes.append((kind, lat_ms))
+
+        def on_done(fut, sched_t, looked, q):
+            exc = fut.exception()
+            if exc is None:
+                if looked is not None:
+                    cache_edge.fill(rid, looked, fut.result(),
+                                    cache_edge.index_version(idx), q,
+                                    tenant="t0")
+                record("served", sched_t)
+            elif isinstance(exc, DeadlineExceeded):
+                record("expired", sched_t)
+            elif isinstance(exc, RequestShed):
+                record("shed", sched_t)
+            else:
+                record("error", sched_t)
+
+        t0 = _time.monotonic()
+        i = 0
+        end = t0 + window_s
+        while True:
+            sched_t = t0 + i * interval_s
+            now = _time.monotonic()
+            if sched_t >= end:
+                break
+            if sched_t > now:
+                _time.sleep(sched_t - now)
+            q = pool[zipf_rows(s, req_rows, arm_rng)]
+            looked = None
+            if cache_edge.active():
+                looked = cache_edge.lookup(
+                    rid, q, k, kw_items, cache_edge.index_version(idx),
+                    index=idx)
+            if looked is not None and looked.complete:
+                # full hit: no queue slot, no kernel — served on the spot
+                record("served", sched_t)
+                i += 1
+                continue
+            submit_q = q if looked is None else q[looked.miss_idx]
+            budget = Budget(deadline_ms, tenant=f"t{i % 2}",
+                            priority=(0 if i % 2 == 0 else 2), t0=sched_t)
+            token = attach_budget(budget)
+            try:
+                fut = co.submit("load", submit_q, region_id=rid)
+            finally:
+                detach_budget(token)
+            fut.add_done_callback(
+                lambda f, st=sched_t, lk=looked, qq=q:
+                on_done(f, st, lk, qq))
+            i += 1
+        co.stop(drain=True)
+        settle_end = _time.monotonic() + 30.0
+        while _time.monotonic() < settle_end:
+            with lock:
+                if len(outcomes) >= i:
+                    break
+            _time.sleep(0.05)
+        recompiles = recompiles_c.get() - recompiles0
+        cs = cache_edge.CACHE.region_stats(rid)
+        hit_total = cs["hits"] + cs["misses"]
+        with lock:
+            outs = list(outcomes)
+        served = [o for o in outs if o[0] == "served"]
+        in_dl = [o for o in served if o[1] <= deadline_ms]
+        lat_sorted = sorted(o[1] for o in served)
+        p99 = (lat_sorted[min(len(lat_sorted) - 1,
+                              int(len(lat_sorted) * 0.99))]
+               if lat_sorted else 0.0)
+        arm = {
+            "offered": i,
+            "served": len(served),
+            "goodput_qps": round(len(in_dl) * req_rows / window_s, 1),
+            "served_p99_ms": round(p99, 1),
+            "shed": sum(1 for o in outs if o[0] == "shed"),
+            "expired": sum(1 for o in outs if o[0] == "expired"),
+            "errors": sum(1 for o in outs if o[0] == "error"),
+            "hit_rate": round(cs["hits"] / hit_total, 3) if hit_total
+            else 0.0,
+            "dedup_collapsed_rows": int(cs["dedup_collapsed"]),
+            "dispatched_rows": int(dispatched_rows[0]),
+            "steady_state_recompiles": int(recompiles),
+        }
+        if cache_on:
+            # byte-identity gate: every probe row the cache serves must
+            # equal an uncached dispatch of the SAME rows, exactly
+            looked = cache_edge.lookup(
+                rid, pool[:8], k, kw_items, cache_edge.index_version(idx),
+                index=idx)
+            fresh = run("probe", pool[:8])
+            checked = 0
+            identical = True
+            if looked is not None:
+                for j, row in enumerate(looked.rows):
+                    if row is None:
+                        continue
+                    checked += 1
+                    identical = identical and (row == fresh[j])
+            arm["hits_checked"] = checked
+            arm["byte_identical_hits"] = bool(identical)
+        FLAGS.set("qos_enabled", False)
+        return arm
+
+    skews = (("s0", 0.0), ("s09", 0.9), ("s12", 1.2))
+    out_skews = {}
+    for name, s in skews:
+        out_skews[name] = {
+            "cache_on": one_arm(s, True),
+            "cache_off": one_arm(s, False),
+        }
+    FLAGS.set("cache_enabled", False)
+    FLAGS.set("qos_enabled", False)
+    FLAGS.set("qos_max_queue_ms", 50.0)
+    cache_edge.CACHE.reset()
+    on12 = out_skews["s12"]["cache_on"]
+    off12 = out_skews["s12"]["cache_off"]
+    gain = (on12["goodput_qps"] / off12["goodput_qps"]
+            if off12["goodput_qps"] else float("inf"))
+    result = {
+        "config": f"zipf_cache_ivf_{n//1000}k_x{d}_2x_open_loop_"
+                  f"pool{pool_m}",
+        "capacity_qps": round(capacity_rows_s, 1),
+        "offered_qps": round(offered_rows_s, 1),
+        "deadline_ms": deadline_ms,
+        "skews": out_skews,
+        "goodput_gain_s12": round(min(gain, 1000.0), 2),
+        # acceptance gates
+        "goodput_gate_s12": bool(
+            on12["goodput_qps"] > off12["goodput_qps"]),
+        "hit_rate_gate": bool(
+            out_skews["s09"]["cache_on"]["hit_rate"] > 0.0
+            and on12["hit_rate"] > 0.0),
+        "byte_identical_hits": all(
+            out_skews[nm]["cache_on"].get("byte_identical_hits", True)
+            for nm, _ in skews),
+        "steady_state_recompiles": int(sum(
+            out_skews[nm][arm]["steady_state_recompiles"]
+            for nm, _ in skews for arm in ("cache_on", "cache_off"))),
+    }
+    log(f"zipf_cache: s=1.2 goodput on={on12['goodput_qps']:,.0f} "
+        f"off={off12['goodput_qps']:,.0f} rows/s ({gain:.2f}x), "
+        f"hit_rate={on12['hit_rate']:.2f} "
+        f"deduped={on12['dedup_collapsed_rows']} "
+        f"recompiles={result['steady_state_recompiles']}")
+    return result
+
+
 def pipeline_sweep(platform):
     """ISSUE 15: stall-free serving pipeline — closed-loop saturation
     through the coalescer's overlapped-dispatch arm at staging depth
@@ -1729,6 +2006,10 @@ def main():
     #     ladder vs serial flush (ISSUE 15) ---
     pipe = pipeline_sweep(platform)
 
+    # --- serving-edge result cache + in-flight dedupe under Zipf
+    #     traffic, cache on vs off per skew (ISSUE 16) ---
+    zipf = zipf_cache(platform)
+
     # --- state integrity: digest ledger + corruption scrub on vs off
     #     (ISSUE 11) ---
     integ = integrity_scrub(platform)
@@ -1846,6 +2127,11 @@ def main():
         # dispatch-overhead gate (hard on TPU, informational on CPU),
         # byte-identical shortlists, zero recompiles per depth
         "pipeline_sweep": pipe,
+        # serving-edge cache (ISSUE 16): Zipf-skewed open-loop arrival
+        # with the result cache + in-flight dedupe on vs off per skew —
+        # goodput/p99/hit-rate, the byte-identical-hits gate, hit_rate>0
+        # at s>=0.9, and zero recompiles with dedupe-shrunk batches
+        "zipf_cache": zipf,
         # state-integrity plane (ISSUE 11): mixed r/w p99 with the digest
         # ledger + concurrent scrub on vs off (< 5% overhead gate, zero
         # recompiles — the ledger is host hashing only) and the
@@ -1898,6 +2184,16 @@ if __name__ == "__main__":
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps({"overload": overload("cpu")}))
         sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--zipf":
+        # standalone: just the serving-edge cache arms (acceptance
+        # smoke); exits non-zero when a cache hit was not byte-identical
+        # to an uncached dispatch of the same rows
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        out = zipf_cache("cpu")
+        print(json.dumps({"zipf_cache": out}))
+        sys.exit(0 if out["byte_identical_hits"] else 1)
     if len(sys.argv) >= 2 and sys.argv[1] == "--pipeline":
         # standalone: just the stall-free pipeline sweep (acceptance
         # smoke); exits non-zero if any depth broke byte-identity

@@ -1,0 +1,76 @@
+"""Serving-edge result cache + in-flight query dedupe.
+
+Skewed (power-law) traffic re-asks the same and near-same queries; every
+repeat bought a full kernel dispatch. This package closes that gap with
+three rungs, each reusing machinery earlier PRs built:
+
+- **dedupe.py** — identical query rows inside one coalescer flush
+  collapse to a single kernel row fanned out to every waiter (PR 11 row
+  fingerprints; the batch shrinks BEFORE padding, so the pow2 ladder and
+  staging rings are untouched).
+- **store.py / keys.py** — a bounded per-region result cache keyed
+  ``(query fingerprint, SlotStore.mutation_version, resolved params,
+  filter fingerprint)``: the version key makes invalidation structural
+  (every put/remove/growth bumps it), entries hold final post-rerank
+  rows so hits are byte-identical to fresh dispatch, LRU bounded by
+  ``cache.max_bytes`` with per-tenant fairness.
+- **policy.py / edge.py** — tier gates and the services.py glue: hits
+  are consulted at admission (before QoS queuing — a hit costs no queue
+  slot), a "serve-slightly-stale" rung opens only while the shed ladder
+  is degraded, and optional sq8-semantic hits (PR 4 codec) serve only
+  while the PR 9 shadow-quality estimator attests the recall SLO.
+
+Everything is host-side: a cache lookup can never introduce a device
+sync on the admission path (dingolint's host-sync checker roots this
+package to enforce exactly that).
+
+Off by default (``cache.enabled``); one flag read when off.
+"""
+
+from dingo_tpu.cache.dedupe import DedupePlan, build_plan, deduped_rows
+from dingo_tpu.cache.edge import (
+    CACHE,
+    CODECS,
+    EdgeLookup,
+    active,
+    fill,
+    index_version,
+    lookup,
+    region_version,
+)
+from dingo_tpu.cache.keys import (
+    SemanticCodec,
+    params_seed,
+    query_fingerprints,
+    semantic_fingerprints,
+)
+from dingo_tpu.cache.policy import (
+    cache_enabled,
+    dedupe_enabled,
+    semantic_allowed,
+    stale_versions_allowed,
+)
+from dingo_tpu.cache.store import ResultCache
+
+__all__ = [
+    "CACHE",
+    "CODECS",
+    "DedupePlan",
+    "EdgeLookup",
+    "ResultCache",
+    "SemanticCodec",
+    "active",
+    "build_plan",
+    "cache_enabled",
+    "dedupe_enabled",
+    "deduped_rows",
+    "fill",
+    "index_version",
+    "lookup",
+    "params_seed",
+    "query_fingerprints",
+    "region_version",
+    "semantic_allowed",
+    "semantic_fingerprints",
+    "stale_versions_allowed",
+]

@@ -68,6 +68,12 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
         # '-' beats a misleading 0.000
         return f"{recall:.3f}" if samples else "-"
 
+    def _cache_cell(hits: int, misses: int) -> str:
+        # serving-edge cache hit rate; no lookups yet (cache off or no
+        # plain-search traffic) renders '-', not a misleading 0%
+        total = hits + misses
+        return f"{100.0 * hits / total:.0f}%" if total else "-"
+
     for entry in resp.stores:
         m = entry.metrics
         # store-level recall: sample-weighted mean over leader regions
@@ -98,6 +104,8 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
                 (r.qos_queue_wait_ms for r in m.regions), default=0.0
             ),
             str(sum(r.qos_shed_total for r in m.regions)),
+            _cache_cell(sum(r.cache_hits for r in m.regions),
+                        sum(r.cache_misses for r in m.regions)),
         ])
         for r in m.regions:
             if region_id and r.region_id != region_id:
@@ -138,6 +146,7 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
                 str(r.qos_queue_depth),
                 f"{r.qos_queue_wait_ms:.0f}ms",
                 str(r.qos_shed_total),
+                _cache_cell(r.cache_hits, r.cache_misses),
                 ",".join(flags) or "-",
             ])
     region_rows.sort(key=lambda r: (int(r[0]), r[1]))
@@ -145,14 +154,14 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
         _render_table(
             ["STORE", "METRICS", "REGIONS", "LEADERS", "KEYS", "VECTORS",
              "MEM", "DEVMEM", "DEVPEAK", "DEV-IN-USE", "QPS", "RECALL",
-             "QDEPTH", "PRESS", "SHED"],
+             "QDEPTH", "PRESS", "SHED", "CACHE"],
             store_rows,
         ),
         "",
         _render_table(
             ["REGION", "STORE", "ROLE", "KEYS", "VECTORS", "MEM", "DEVMEM",
              "DEVPEAK", "LAG", "QPS", "RECALL", "QDEPTH", "PRESS", "SHED",
-             "FLAGS"],
+             "CACHE", "FLAGS"],
             region_rows,
         ),
     ]

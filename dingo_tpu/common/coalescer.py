@@ -74,6 +74,21 @@ batches — and per-stage accounting books the enqueue cost under a
 contract extends to the lane: stop(drain=True) resolves queued
 handoffs, stop(drain=False) abandons them (futures fail fast, but the
 fetch still runs so device-side SearchLeases are released).
+
+In-flight dedupe (``cache.enabled``, dingo_tpu/cache/): identical query
+rows inside one flush collapse to a single kernel row fanned out to
+every waiter's future — entries in a batch already share the (region,
+topn, params) key, so row identity is the query bytes (PR 11 row
+fingerprints). The plan is built from the POST-expiry, priority-sorted
+survivors: an expired member has already failed its own future and
+cannot drag duplicate siblings down, first occurrence wins the kernel
+slot (the collapsed row dispatches at its highest-priority member's
+position), and the hopeless-shed estimate in ``_expire_dead`` prices
+the batch at its DEDUPED row count — the kernel cost actually being
+bought — so a duplicate-heavy flush is never shed on a phantom row
+count (each member's own deadline is still checked individually). The
+batch shrinks BEFORE padding/staging, so the pow2 ladder, staging rings
+and the one-sync-per-reply contract are untouched.
 """
 
 from __future__ import annotations
@@ -418,8 +433,23 @@ class SearchCoalescer:
         # hopeless-shed arm is a DROP and obeys the same policy gate as
         # admission ('off'/'degrade' must never fail a live request)
         drops = qp._policy_drops()
-        est_run = _EXPIRY_RUN_MARGIN * self._est_run_ms(
-            sum(len(e.queries) for e in entries))
+        rows = sum(len(e.queries) for e in entries)
+        if drops:
+            try:
+                from dingo_tpu.cache import policy as cache_policy
+
+                if cache_policy.dedupe_enabled():
+                    # price the batch at the row count dedupe will
+                    # actually dispatch: a duplicate-heavy flush must
+                    # not be hopeless-shed on phantom rows (the count
+                    # here may still include rows about to expire —
+                    # over-counting only errs conservative)
+                    from dingo_tpu.cache.dedupe import deduped_rows
+
+                    rows = deduped_rows(entries)
+            except ImportError:  # pragma: no cover
+                pass
+        est_run = _EXPIRY_RUN_MARGIN * self._est_run_ms(rows)
         live: List[_Entry] = []
         for e in entries:
             if e.budget is None or e.budget.deadline_ms <= 0:
@@ -506,6 +536,49 @@ class SearchCoalescer:
                 run_span.set_attr("cobatched_traces", links)
         return entries, region_id, run_span, waits_ms, qos
 
+    def _form_batch(self, entries: List[_Entry], region_id: int):
+        """Stack the survivors' queries, collapsing in-flight duplicates
+        when dedupe is on. Returns (stacked, plan): plan is None on the
+        plain path (contiguous offset slicing) and a DedupePlan when
+        rows collapsed — result fan-out then goes through
+        ``plan.rows_for``. Runs AFTER expiry and the priority sort, so
+        an expired member never holds a kernel slot and a shared row
+        dispatches at its most urgent member's position."""
+        plan = None
+        try:
+            from dingo_tpu.cache import policy as cache_policy
+
+            if cache_policy.dedupe_enabled():
+                from dingo_tpu.cache.dedupe import build_plan
+
+                plan = build_plan(entries)
+        except ImportError:  # pragma: no cover
+            pass
+        if plan is None:
+            return (np.concatenate([e.queries for e in entries], axis=0),
+                    None)
+        try:
+            from dingo_tpu.cache.edge import CACHE
+
+            CACHE.on_dedup(region_id, plan.collapsed)
+        except ImportError:  # pragma: no cover
+            pass
+        return plan.stacked, plan
+
+    @staticmethod
+    def _fan_out(entries: List[_Entry], results, plan) -> None:
+        """Resolve every entry's future from the batch results — plan
+        fan-out when rows collapsed, contiguous slices otherwise."""
+        if plan is not None:
+            for i, e in enumerate(entries):
+                e.future.set_result(plan.rows_for(i, results))
+            return
+        off = 0
+        for e in entries:
+            n = len(e.queries)
+            e.future.set_result(list(results[off:off + n]))
+            off += n
+
     def _note_stage_totals(self, **stages_ms) -> None:
         with self._lock:
             for name, ms in stages_ms.items():
@@ -538,7 +611,7 @@ class SearchCoalescer:
             {} if (qos and self._run_takes_stages) else None
         )
         try:
-            stacked = np.concatenate([e.queries for e in entries], axis=0)
+            stacked, plan = self._form_batch(entries, region_id)
             form_ms = (time.monotonic() - flush_t0) * 1000.0
             run_t0 = time.monotonic()
             if stage_us is not None:
@@ -547,11 +620,7 @@ class SearchCoalescer:
                 results = self.run_fn(key, stacked)
             run_ms = (time.monotonic() - run_t0) * 1000.0
             self._note_run(len(stacked), run_ms)
-            off = 0
-            for e in entries:
-                n = len(e.queries)
-                e.future.set_result(list(results[off:off + n]))
-                off += n
+            self._fan_out(entries, results, plan)
             if qos:
                 self._account_stages(entries, waits_ms, form_ms, run_ms,
                                      stage_us)
@@ -622,7 +691,7 @@ class SearchCoalescer:
             {} if "stage_us" in self._dispatch_params else None
         )
         try:
-            stacked = np.concatenate([e.queries for e in entries], axis=0)
+            stacked, plan = self._form_batch(entries, region_id)
             if "staged" in self._dispatch_params:
                 if self._staging is None:
                     from dingo_tpu.common.config import pipeline_depth
@@ -644,7 +713,7 @@ class SearchCoalescer:
             run_span.detach(token)
             return _Handoff(self, key, entries, waits_ms, form_ms,
                             dispatch_ms, run_span, staged, thunk,
-                            stage_us, qos)
+                            stage_us, qos, plan, len(stacked))
         except Exception as exc:  # noqa: BLE001
             run_span.set_error(exc)
             run_span.detach(token)
@@ -760,10 +829,11 @@ class _Handoff:
 
     __slots__ = ("coalescer", "key", "entries", "waits_ms", "form_ms",
                  "dispatch_ms", "run_span", "staged", "thunk", "stage_us",
-                 "qos")
+                 "qos", "plan", "rows")
 
     def __init__(self, coalescer, key, entries, waits_ms, form_ms,
-                 dispatch_ms, run_span, staged, thunk, stage_us, qos):
+                 dispatch_ms, run_span, staged, thunk, stage_us, qos,
+                 plan=None, rows=0):
         self.coalescer = coalescer
         self.key = key
         self.entries = entries
@@ -775,6 +845,11 @@ class _Handoff:
         self.thunk = thunk
         self.stage_us = stage_us
         self.qos = qos
+        #: dedupe fan-out plan (None = contiguous slices) and the row
+        #: count actually dispatched (deduped) — the EWMA must track the
+        #: kernel's true service rate, not the pre-collapse demand
+        self.plan = plan
+        self.rows = rows
 
     def resolve(self) -> None:
         c = self.coalescer
@@ -783,7 +858,7 @@ class _Handoff:
         try:
             results = self.thunk()
             resolve_ms = (time.monotonic() - t0) * 1000.0
-            rows = sum(len(e.queries) for e in self.entries)
+            rows = self.rows or sum(len(e.queries) for e in self.entries)
             c._note_run(rows, self.dispatch_ms + resolve_ms)
             kernel_ms, rerank_ms = resolve_ms, 0.0
             if self.stage_us:
@@ -795,11 +870,7 @@ class _Handoff:
                     rerank_ms = min(r, max(0.0, resolve_ms - k))
             c._note_stage_totals(kernel=kernel_ms, rerank=rerank_ms,
                                  resolve=resolve_ms)
-            off = 0
-            for e in self.entries:
-                n = len(e.queries)
-                e.future.set_result(list(results[off:off + n]))
-                off += n
+            c._fan_out(self.entries, results, self.plan)
             if self.qos:
                 c._account_stages(self.entries, self.waits_ms,
                                   self.form_ms, resolve_ms, self.stage_us,

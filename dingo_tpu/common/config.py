@@ -433,6 +433,38 @@ FLAGS.define("pipeline_depth", 2, mutable=True,
                    "flight. 1 degenerates to the serial path (staging "
                    "still used, no overlap); 2 is classic double "
                    "buffering")
+FLAGS.define("cache_enabled", False, mutable=True,
+             help_="serving-edge result cache + in-flight query dedupe "
+                   "(dingo_tpu/cache/): identical query rows inside one "
+                   "coalescer flush window collapse to a single kernel "
+                   "row, and exact repeats of plain searches are answered "
+                   "from a bounded per-region result cache keyed on "
+                   "(query fingerprint, SlotStore.mutation_version, "
+                   "resolved params) — a hit costs no queue slot and "
+                   "dispatches no kernel")
+FLAGS.define("cache_max_bytes", 64 * 1024 * 1024, mutable=True,
+             help_="LRU bound on the result cache's host memory across "
+                   "all regions (approximate accounting: cached rows are "
+                   "(id, distance) pairs). 0 disables caching while "
+                   "leaving in-flight dedupe active")
+FLAGS.define("cache_stale_versions", 1, mutable=True,
+             help_="serve-slightly-stale degrade rung: while a region's "
+                   "shed ladder is degraded (qos.degrade_level > 0) a "
+                   "lookup may fall back to entries at most this many "
+                   "mutation_versions behind the live store. 0 = exact "
+                   "version only, always")
+FLAGS.define("cache_semantic", False, mutable=True,
+             help_="semantic (approximate) cache hits via sq8-quantized "
+                   "query fingerprints: near-identical queries that "
+                   "quantize to the same codes share a cache entry. "
+                   "Gated live by the shadow-quality estimator — "
+                   "approximate hits serve only while the windowed "
+                   "recall CI lower bound holds quality.slo_recall")
+FLAGS.define("cache_tenant_share", 0.5, mutable=True,
+             help_="per-tenant fairness bound: the fraction of "
+                   "cache.max_bytes any single tenant's entries may "
+                   "occupy (its own inserts evict its own LRU tail past "
+                   "the share). <= 0 or >= 1 disables the bound")
 FLAGS.define("vector_blocked_layout", "auto", mutable=True,
              help_="maintain a dimension-blocked ([n_blocks, capacity, "
                    "block_d]) scan mirror + per-block norms in float/sq8 "
@@ -529,6 +561,16 @@ def pipeline_depth() -> int:
         return max(1, int(FLAGS.get("pipeline_depth")))
     except (TypeError, ValueError):
         return 2
+
+
+def result_cache_enabled() -> bool:
+    """Whole-subsystem gate for the serving-edge cache (dedupe + result
+    cache). One boolean read — with the flag off every hook is a cheap
+    early return, mirroring qos_enabled()."""
+    v = FLAGS.get("cache_enabled")
+    if isinstance(v, str):
+        return v.strip().lower() in ("true", "1", "on", "yes")
+    return bool(v)
 
 
 def blocked_layout_enabled() -> bool:

@@ -648,6 +648,11 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
             self._assign_h[live] = assign
         self._integrity_reset_assign()
         self._invalidate_view()
+        # retrain moves centroids + reassignments: the same query bytes now
+        # produce different results with no row having been written, so
+        # serving-state version consumers (the serving-edge result cache
+        # keys on mutation_version) must see a new version
+        self.store.mutation_version += 1
 
     # -- bucketed view (IvfViewMaintenance data hooks) -----------------------
     def _prune_dim_block(self):

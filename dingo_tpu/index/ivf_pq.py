@@ -482,6 +482,10 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
         self._integrity_reset_assign()
         self._integrity_reset_codes()
         self._invalidate_view()
+        # retrain re-encoded every row: results change for identical query
+        # bytes, so the serving-edge result cache (keyed on
+        # mutation_version) must not serve pre-retrain entries as exact
+        self.store.mutation_version += 1
 
     # -- state-integrity: PQ code artifact -----------------------------------
     def _integrity_codes(self, ids: np.ndarray, codes) -> None:

@@ -201,6 +201,14 @@ class StoreMetricsCollector:
         from dingo_tpu.index.recovery import RECOVERY
 
         rm.device_degraded = RECOVERY.is_degraded(region.id)
+        # serving-edge cache rollup (dingo_tpu/cache/): hits/misses/live
+        # entries ride the heartbeat into the cluster top CACHE column
+        from dingo_tpu.cache.edge import CACHE
+
+        cs = CACHE.region_stats(region.id)
+        rm.cache_hits = int(cs["hits"])
+        rm.cache_misses = int(cs["misses"])
+        rm.cache_entries = int(cs["entries"])
         last = INTEGRITY.last_verified_ms(region.id)
         self.registry.gauge(
             "consistency.digest_age_s", region.id
@@ -236,11 +244,14 @@ class StoreMetricsCollector:
             self.registry.drop_region(rid)
             HBM.forget_region(rid)
             QUALITY.forget_region(rid)
+            from dingo_tpu.cache.edge import CACHE, CODECS
             from dingo_tpu.obs.integrity import INTEGRITY
             from dingo_tpu.obs.pressure import PRESSURE
 
             PRESSURE.forget_region(rid)
             INTEGRITY.forget_region(rid)
+            CACHE.forget_region(rid)
+            CODECS.forget_region(rid)
         self._published_regions = current
         g = self.registry.gauge
         g("store.device.bytes_in_use").set(snap.device_bytes_in_use)

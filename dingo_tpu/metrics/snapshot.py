@@ -67,6 +67,13 @@ class RegionMetricsSnapshot:
     #: index OOMed past the ladder and serves host-exact until the
     #: background re-materialization lands
     device_degraded: bool = False
+    #: serving-edge cache rollup (dingo_tpu/cache/): cumulative hit/miss
+    #: counts and live entries for the region — the cluster top CACHE
+    #: column renders hit rate, showing '-' while hits+misses == 0 (cache
+    #: off or no plain-search traffic)
+    cache_hits: int = 0
+    cache_misses: int = 0
+    cache_entries: int = 0
 
 
 @persist.register

@@ -129,9 +129,11 @@ class FlightRecorder:
         routing), hnsw.* (hops, visited fraction, beam occupancy,
         adjacency rebuilds), and quality.* (live recall/CI/RBO + tuner
         knob positions — was the store trading recall when the incident
-        hit?), and qos.* (queue depth/wait, shed/expired counters,
-        degrade level — was the store under pressure, and what had
-        admission already given up on?)."""
+        hit?), qos.* (queue depth/wait, shed/expired counters, degrade
+        level — was the store under pressure, and what had admission
+        already given up on?), and cache.* (hit/miss/dedupe/stale/
+        semantic counters, resident bytes — was the serving-edge cache
+        absorbing the skewed traffic or churning?)."""
         return {k: v for k, v in now_flat.items() if k.startswith(prefix)}
 
     @staticmethod
@@ -292,6 +294,7 @@ class FlightRecorder:
             "quality": self._family_state(now_flat, "quality."),
             "qos": self._family_state(now_flat, "qos."),
             "consistency": self._family_state(now_flat, "consistency."),
+            "cache": self._family_state(now_flat, "cache."),
             "integrity": self._integrity_state(),
             "config": config,
         }

@@ -468,6 +468,21 @@ class PressurePlane:
 PRESSURE = PressurePlane()
 
 
+def degrade_level(region_id: int) -> int:
+    """Current shed-ladder degrade level for a region, read from the
+    published ``qos.degrade_level`` gauge (the same value heartbeats and
+    the SLO tuner consume) — 0 when no ShedController has run. Lets
+    consumers outside the qos plane (e.g. the serving-edge cache's
+    stale-rung policy) observe pressure without holding a ShedController
+    reference."""
+    from dingo_tpu.common.metrics import METRICS
+
+    try:
+        return int(METRICS.gauge("qos.degrade_level", region_id).get())
+    except (TypeError, ValueError):
+        return 0
+
+
 # ---------------------------------------------------------------------------
 # ShedController: graduated degrade on the tuner's ladder
 # ---------------------------------------------------------------------------

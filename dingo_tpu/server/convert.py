@@ -263,6 +263,8 @@ _REGION_METRIC_FIELDS = (
     # per-artifact digest vector + store-local scrub verdict
     "integrity_applied_index", "integrity_digests", "integrity_mismatch",
     "device_degraded",
+    # serving-edge cache (dingo_tpu/cache/): hit/miss rollup + entries
+    "cache_hits", "cache_misses", "cache_entries",
 )
 
 _STORE_METRIC_FIELDS = (

@@ -251,6 +251,7 @@ class IndexService:
                 and kw.get("scalar_filter") is None
             )
             if plain:
+                from dingo_tpu.cache import edge as cache_edge
                 from dingo_tpu.engine.storage import (
                     MAX_TOPN_BATCH_PRODUCT,
                     VECTOR_MAX_BATCH_COUNT,
@@ -269,26 +270,55 @@ class IndexService:
                     VECTOR_MAX_BATCH_COUNT,
                     MAX_TOPN_BATCH_PRODUCT // max(1, topn),
                 )
-                try:
-                    results = self._get_coalescer().submit(
-                        key, queries, max_batch=cap, region_id=region.id
-                    ).result(timeout=30)
-                except qos.QosRejected as e:
-                    # an admission/expiry decision is FINAL — falling back
-                    # to a direct search would serve exactly the work the
-                    # QoS layer decided the store cannot afford
-                    return _err(
-                        resp,
-                        30002 if isinstance(e, qos.DeadlineExceeded)
-                        else 30003,
-                        str(e),
-                    ), None
-                except (RuntimeError, FuturesTimeoutError):
-                    # coalescer stopped mid-flight (flag hot-change) or the
-                    # batch stalled: serve this request directly
-                    results = self.node.storage.vector_batch_search(
-                        region, queries, topn, **kw
+                # serving-edge cache consult BEFORE QoS queuing: a hit
+                # costs no queue slot, no admission estimate, no kernel;
+                # a partial hit dispatches only its miss rows
+                looked = None
+                if cache_edge.active():
+                    w = getattr(region, "vector_index_wrapper", None)
+                    looked = cache_edge.lookup(
+                        region.id, queries, topn, key[2],
+                        cache_edge.region_version(region),
+                        index=getattr(w, "own_index", None),
                     )
+                if looked is not None and looked.complete:
+                    results = looked.rows
+                else:
+                    submit_q = (queries if looked is None
+                                else queries[looked.miss_idx])
+                    try:
+                        results = self._get_coalescer().submit(
+                            key, submit_q, max_batch=cap,
+                            region_id=region.id
+                        ).result(timeout=30)
+                    except qos.QosRejected as e:
+                        # an admission/expiry decision is FINAL — falling
+                        # back to a direct search would serve exactly the
+                        # work the QoS layer decided the store cannot
+                        # afford
+                        return _err(
+                            resp,
+                            30002 if isinstance(e, qos.DeadlineExceeded)
+                            else 30003,
+                            str(e),
+                        ), None
+                    except (RuntimeError, FuturesTimeoutError):
+                        # coalescer stopped mid-flight (flag hot-change) or
+                        # the batch stalled: serve this request directly
+                        results = self.node.storage.vector_batch_search(
+                            region, submit_q, topn, **kw
+                        )
+                    if looked is not None:
+                        # fill only if the store version didn't move while
+                        # the kernel ran (edge.fill re-checks), then stitch
+                        # cached + fresh rows back into request order
+                        cache_edge.fill(
+                            region.id, looked, results,
+                            cache_edge.region_version(region), queries,
+                            tenant=(budget.tenant if budget is not None
+                                    else "default"),
+                        )
+                        results = looked.merge(results)
             else:
                 results = self.node.storage.vector_batch_search(
                     region, queries, topn, stage_us=stage_us, **kw

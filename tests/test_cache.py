@@ -1,0 +1,479 @@
+"""Serving-edge result cache + in-flight dedupe (ISSUE 16).
+
+Covers the three serving tiers and the coalescer integration:
+
+- in-flight dedupe: N submitters of identical rows share ONE kernel row
+  (sentinel spy proves a single dispatch) and every future resolves with
+  the rows a solo dispatch would have produced;
+- exact hits are byte-identical to a fresh dispatch across index family
+  x precision tier, and invalidate on upsert / delete / retrain via
+  SlotStore.mutation_version;
+- the stale rung serves only while the shed ladder is degraded and never
+  beyond cache.stale_versions;
+- per-tenant fairness: one tenant's inserts evict its OWN tail first and
+  can never push another tenant out;
+- the semantic tier closes when the shadow-quality estimator's recall CI
+  dips below quality.slo_recall (and stays closed while cold);
+- eviction accounting: bytes/entries track the LRU exactly;
+- budget/priority across dedupe: an admission-expired member fails its
+  own future without killing its fan-out siblings, and the collapsed
+  row rides its highest-priority member's dispatch position.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from dingo_tpu.cache import edge as cache_edge
+from dingo_tpu.cache import keys as cache_keys
+from dingo_tpu.cache import policy
+from dingo_tpu.cache.dedupe import build_plan, deduped_rows
+from dingo_tpu.cache.store import ResultCache
+from dingo_tpu.common.coalescer import SearchCoalescer
+from dingo_tpu.common.config import FLAGS
+from dingo_tpu.common.metrics import METRICS
+from dingo_tpu.index import IndexParameter, IndexType, new_index
+from dingo_tpu.obs.pressure import (
+    PRESSURE,
+    Budget,
+    DeadlineExceeded,
+    attach_budget,
+    detach_budget,
+)
+
+
+@pytest.fixture
+def cache_on():
+    FLAGS.set("cache_enabled", True)
+    cache_edge.CACHE.reset()
+    cache_edge.CODECS.reset()
+    yield
+    FLAGS.set("cache_enabled", False)
+    FLAGS.set("cache_semantic", False)
+    FLAGS.set("cache_max_bytes", 64 * 1024 * 1024)
+    FLAGS.set("cache_stale_versions", 1)
+    FLAGS.set("cache_tenant_share", 0.5)
+    cache_edge.CACHE.reset()
+    cache_edge.CODECS.reset()
+
+
+def rows_of(results):
+    """Per-row reply as the plain (id, distance) item list services
+    caches — python scalars, so equality compares are exact."""
+    return [list(zip(r.ids.tolist(), r.distances.tolist()))
+            for r in results]
+
+
+# -- in-flight dedupe ---------------------------------------------------------
+
+
+def test_dedupe_collapses_to_one_kernel_row(cache_on):
+    calls = []
+
+    def run(key, stacked):
+        calls.append(np.array(stacked, copy=True))
+        return [("reply", float(q.sum())) for q in stacked]
+
+    co = SearchCoalescer(run, window_ms=40.0)
+    try:
+        dup = np.full((1, 4), 7.0, np.float32)
+        solo = np.full((1, 4), 9.0, np.float32)
+        futs = [co.submit("k", dup) for _ in range(4)]
+        futs.append(co.submit("k", solo))
+        got = [f.result(timeout=5) for f in futs]
+    finally:
+        co.stop()
+    # one kernel call, duplicates collapsed before padding
+    assert len(calls) == 1
+    assert len(calls[0]) == 2
+    # every duplicate submitter got the rows a solo dispatch produces
+    for rows in got[:4]:
+        assert rows == [("reply", 28.0)]
+    assert got[4] == [("reply", 36.0)]
+    # the collapse is accounted to the region's cache.* family
+    assert cache_edge.CACHE.region_stats(0)["dedup_collapsed"] == 3
+
+
+def test_dedupe_off_without_subsystem():
+    calls = []
+
+    def run(key, stacked):
+        calls.append(len(stacked))
+        return list(range(len(stacked)))
+
+    co = SearchCoalescer(run, window_ms=30.0)
+    try:
+        dup = np.full((1, 4), 7.0, np.float32)
+        for f in [co.submit("k", dup) for _ in range(3)]:
+            f.result(timeout=5)
+    finally:
+        co.stop()
+    assert calls == [3]     # no plan: the kernel sees every row
+
+
+def test_build_plan_none_when_nothing_collapses():
+    class E:
+        def __init__(self, q):
+            self.queries = q
+
+    a = E(np.arange(4, dtype=np.float32).reshape(1, 4))
+    b = E(np.arange(4, 8, dtype=np.float32).reshape(1, 4))
+    assert build_plan([a, b]) is None
+    assert deduped_rows([a, b]) == 2
+    dup = E(np.arange(4, dtype=np.float32).reshape(1, 4))
+    plan = build_plan([a, b, dup])
+    assert plan is not None
+    assert plan.collapsed == 1
+    assert len(plan.stacked) == 2
+
+
+# -- exact hits: byte-identity + invalidation --------------------------------
+
+FAMILIES = [
+    (IndexType.FLAT, "fp32"),
+    (IndexType.FLAT, "sq8"),
+    (IndexType.IVF_FLAT, "fp32"),
+    (IndexType.IVF_FLAT, "sq8"),
+    (IndexType.HNSW, "fp32"),
+    (IndexType.HNSW, "sq8"),
+]
+
+
+def _mk_index(rid, index_type, precision, d=16, n=96):
+    kw = {}
+    if index_type == IndexType.IVF_FLAT:
+        kw = {"ncentroids": 4, "default_nprobe": 4}
+    elif index_type == IndexType.HNSW:
+        kw = {"nlinks": 8, "efconstruction": 40}
+    idx = new_index(rid, IndexParameter(
+        index_type=index_type, dimension=d, precision=precision, **kw))
+    rng = np.random.default_rng(rid)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    ids = np.arange(n, dtype=np.int64)
+    idx.upsert(ids, x)
+    if index_type == IndexType.IVF_FLAT:
+        idx.train()
+    search_kw = ({"nprobe": 4} if index_type == IndexType.IVF_FLAT
+                 else {})
+    return idx, x, search_kw
+
+
+@pytest.mark.parametrize(
+    "index_type,precision", FAMILIES,
+    ids=[f"{t.value}-{p}" for t, p in FAMILIES])
+def test_hit_byte_identical_to_fresh_dispatch(cache_on, index_type,
+                                              precision):
+    rid = 4000 + FAMILIES.index((index_type, precision))
+    idx, x, kw = _mk_index(rid, index_type, precision)
+    kw_items = tuple(sorted(kw.items()))
+    q = x[:3] + np.float32(0.01)
+    ver = cache_edge.index_version(idx)
+    assert ver is not None
+
+    looked = cache_edge.lookup(rid, q, 5, kw_items, ver, index=idx)
+    assert looked is not None and not looked.any_hit
+    fresh = rows_of(idx.search(q, 5, **kw))
+    cache_edge.fill(rid, looked, fresh, cache_edge.index_version(idx), q)
+
+    again = cache_edge.lookup(rid, q, 5, kw_items, ver, index=idx)
+    assert again is not None and again.complete
+    # the hit is byte-identical to a SECOND uncached dispatch, not just
+    # to the rows that populated it — determinism is part of the claim
+    assert again.rows == rows_of(idx.search(q, 5, **kw))
+    assert again.rows == fresh
+    st = cache_edge.CACHE.region_stats(rid)
+    assert st["hits"] == 3 and st["misses"] == 3
+
+
+def test_params_change_is_a_different_key(cache_on):
+    rid = 4100
+    idx, x, kw = _mk_index(rid, IndexType.FLAT, "fp32")
+    q = x[:2]
+    ver = cache_edge.index_version(idx)
+    looked = cache_edge.lookup(rid, q, 5, (), ver, index=idx)
+    cache_edge.fill(rid, looked, rows_of(idx.search(q, 5)), ver, q)
+    # same rows, different topn -> different params seed -> miss
+    other = cache_edge.lookup(rid, q, 7, (), ver, index=idx)
+    assert other is not None and not other.any_hit
+
+
+def test_partial_hit_submits_only_miss_rows(cache_on):
+    rid = 4200
+    idx, x, kw = _mk_index(rid, IndexType.FLAT, "fp32")
+    ver = cache_edge.index_version(idx)
+    q0 = x[:1]
+    looked = cache_edge.lookup(rid, q0, 5, (), ver, index=idx)
+    cache_edge.fill(rid, looked, rows_of(idx.search(q0, 5)), ver, q0)
+
+    q = np.concatenate([x[:1], x[10:11]], axis=0)
+    part = cache_edge.lookup(rid, q, 5, (), ver, index=idx)
+    assert part is not None and part.any_hit and not part.complete
+    assert part.miss_idx.tolist() == [1]
+    miss_rows = rows_of(idx.search(q[part.miss_idx], 5))
+    merged = part.merge(miss_rows)
+    # stitching: the hit row is byte-identical to the dispatch that
+    # populated it, the miss row to the dispatch that just ran (pad
+    # buckets differ between a 1-row and a 2-row dispatch, so low float
+    # bits may differ ACROSS shapes — the per-row identity is the claim)
+    assert merged[0] == rows_of(idx.search(q0, 5))[0]
+    assert merged[1] == miss_rows[0]
+    full = rows_of(idx.search(q, 5))
+    for got, want in zip(merged, full):
+        assert [i for i, _ in got] == [i for i, _ in want]
+        assert np.allclose([s for _, s in got], [s for _, s in want],
+                           atol=1e-4)
+
+
+@pytest.mark.parametrize("mutate", ["upsert", "delete", "train"])
+def test_invalidation_on_mutation(cache_on, mutate):
+    rid = 4300
+    idx, x, kw = _mk_index(rid, IndexType.IVF_FLAT, "fp32")
+    kw_items = tuple(sorted(kw.items()))
+    q = x[:2]
+    v0 = cache_edge.index_version(idx)
+    looked = cache_edge.lookup(rid, q, 5, kw_items, v0, index=idx)
+    cache_edge.fill(rid, looked, rows_of(idx.search(q, 5, **kw)), v0, q)
+    assert cache_edge.lookup(rid, q, 5, kw_items, v0, index=idx).complete
+
+    if mutate == "upsert":
+        idx.upsert(np.array([500], np.int64), x[:1] + np.float32(1.0))
+    elif mutate == "delete":
+        idx.delete(np.array([3], np.int64))
+    else:
+        idx.train()
+    v1 = cache_edge.index_version(idx)
+    assert v1 > v0      # every mutation kind bumps the serving version
+    # the old entry keys at v0; a live lookup (degrade_level 0 -> no
+    # stale allowance) must MISS
+    after = cache_edge.lookup(rid, q, 5, kw_items, v1, index=idx)
+    assert not after.any_hit
+
+
+def test_fill_skipped_when_version_moved_mid_flight(cache_on):
+    rid = 4400
+    idx, x, kw = _mk_index(rid, IndexType.FLAT, "fp32")
+    q = x[:1]
+    v0 = cache_edge.index_version(idx)
+    looked = cache_edge.lookup(rid, q, 5, (), v0, index=idx)
+    fresh = rows_of(idx.search(q, 5))
+    idx.upsert(np.array([700], np.int64), x[5:6])   # write lands mid-flight
+    cache_edge.fill(rid, looked, fresh, cache_edge.index_version(idx), q)
+    assert cache_edge.CACHE.stats()["entries"] == 0
+
+
+# -- stale rung ---------------------------------------------------------------
+
+
+def test_stale_rung_only_under_degrade_and_never_beyond_bound(cache_on):
+    rid = 4500
+    FLAGS.set("cache_stale_versions", 2)
+    rc = cache_edge.CACHE
+    rows = [[(1, 0.5)]]
+    rc.put(rid, 99, version=5, rows=rows)
+
+    # not degraded: the policy grants no stale allowance at all
+    METRICS.gauge("qos.degrade_level", rid).set(0.0)
+    assert policy.stale_versions_allowed(rid) == 0
+    assert rc.lookup(rid, 99, version=6, stale_versions=0) is None
+
+    # degraded: up to cache.stale_versions behind serves...
+    METRICS.gauge("qos.degrade_level", rid).set(1.0)
+    allowed = policy.stale_versions_allowed(rid)
+    assert allowed == 2
+    got = rc.lookup(rid, 99, version=7, stale_versions=allowed)
+    assert got == rows
+    assert rc.region_stats(rid)["stale_served"] == 1
+    # ...but NEVER beyond the bound, degraded or not
+    assert rc.lookup(rid, 99, version=8, stale_versions=allowed) is None
+    METRICS.gauge("qos.degrade_level", rid).set(0.0)
+
+
+# -- per-tenant fairness + eviction accounting -------------------------------
+
+
+def test_tenant_evicts_own_tail_never_neighbors(cache_on):
+    FLAGS.set("cache_max_bytes", 2000)
+    FLAGS.set("cache_tenant_share", 0.5)    # 1000 bytes per tenant
+    rc = ResultCache()
+    rows = [(i, float(i)) for i in range(5)]    # 160 + 5*56 = 440 bytes
+    assert rc.put(1, 1, 1, rows, tenant="b")
+    for fp in (10, 11, 12):                     # 3rd insert busts a's share
+        assert rc.put(1, fp, 1, rows, tenant="a")
+    assert rc.tenant_bytes("a") <= 1000
+    assert rc.tenant_bytes("b") == 440          # b untouched
+    assert rc.lookup(1, 10, 1) is None          # a's own LRU tail paid
+    assert rc.lookup(1, 12, 1) == rows
+    # a single entry larger than the tenant share is refused outright
+    big = [(i, float(i)) for i in range(20)]    # 160 + 20*56 = 1280
+    assert not rc.put(1, 77, 1, big, tenant="a")
+
+
+def test_eviction_accounting_tracks_lru(cache_on):
+    FLAGS.set("cache_max_bytes", 1000)
+    FLAGS.set("cache_tenant_share", 0.0)        # no per-tenant carve-out
+    rc = ResultCache()
+    rows = [(i, float(i)) for i in range(5)]    # 440 bytes each
+    rc.put(7, 1, 1, rows)
+    rc.put(7, 2, 1, rows)
+    assert rc.stats() == {"bytes": 880, "entries": 2, "tenants": 1}
+    rc.put(7, 3, 1, rows)                       # evicts fp=1 (oldest)
+    st = rc.stats()
+    assert st["bytes"] == 880 and st["entries"] == 2
+    assert rc.lookup(7, 1, 1) is None
+    assert rc.lookup(7, 2, 1) == rows
+    assert rc.region_stats(7)["entries"] == 2
+    # a hit refreshes recency: inserting again now evicts fp=3, not fp=2
+    rc.put(7, 4, 1, rows)
+    assert rc.lookup(7, 3, 1) is None
+    assert rc.lookup(7, 2, 1) == rows
+
+
+# -- semantic tier ------------------------------------------------------------
+
+
+def test_semantic_gate_fails_closed_and_closes_on_dip(cache_on,
+                                                      monkeypatch):
+    from dingo_tpu.obs import quality as quality_mod
+
+    rid = 4600
+    FLAGS.set("cache_semantic", True)
+    # cold estimator: no evidence -> no semantic serving
+    monkeypatch.setattr(quality_mod.QUALITY, "region_estimate",
+                        lambda _rid: None)
+    assert not policy.semantic_allowed(rid)
+    # healthy CI above the SLO -> open
+    FLAGS.set("quality_slo_recall", 0.95)
+    monkeypatch.setattr(quality_mod.QUALITY, "region_estimate",
+                        lambda _rid: {"ci_low": 0.97})
+    assert policy.semantic_allowed(rid)
+    # recall dip below the SLO -> the gate closes
+    monkeypatch.setattr(quality_mod.QUALITY, "region_estimate",
+                        lambda _rid: {"ci_low": 0.90})
+    assert not policy.semantic_allowed(rid)
+
+
+def test_semantic_hit_serves_rounded_query_and_respects_gate(
+        cache_on, monkeypatch):
+    from dingo_tpu.obs import quality as quality_mod
+
+    rid = 4700
+    idx, x, kw = _mk_index(rid, IndexType.FLAT, "fp32", d=8, n=300)
+    FLAGS.set("cache_semantic", True)
+    FLAGS.set("quality_slo_recall", 0.95)
+    monkeypatch.setattr(quality_mod.QUALITY, "region_estimate",
+                        lambda _rid: {"ci_low": 0.99})
+    # train the per-region sq8 fingerprint codec from real traffic
+    cache_edge.CODECS.observe(rid, x[:cache_keys.SEMANTIC_TRAIN_ROWS])
+    assert cache_edge.CODECS.trained(rid)
+
+    q = x[:1]
+    ver = cache_edge.index_version(idx)
+    looked = cache_edge.lookup(rid, q, 5, (), ver, index=idx)
+    cache_edge.fill(rid, looked, rows_of(idx.search(q, 5)), ver, q)
+
+    # a near-identical query (same sq8 rounding) misses exact, hits
+    # semantic while the SLO gate holds
+    near = q + np.float32(1e-6)
+    got = cache_edge.lookup(rid, near, 5, (), ver, index=idx)
+    assert got is not None and got.complete
+    assert cache_edge.CACHE.region_stats(rid)["semantic_served"] == 1
+
+    # the same lookup after a recall dip falls through to a miss
+    monkeypatch.setattr(quality_mod.QUALITY, "region_estimate",
+                        lambda _rid: {"ci_low": 0.50})
+    got = cache_edge.lookup(rid, near, 5, (), ver, index=idx)
+    assert not got.any_hit
+
+
+# -- budget/priority across dedupe (satellite 4 regression) ------------------
+
+
+def test_expired_member_fails_alone_dedupe_siblings_served(cache_on):
+    FLAGS.set("qos_enabled", True)
+    PRESSURE.reset()
+    calls = []
+
+    def run(key, stacked):
+        calls.append(np.array(stacked, copy=True))
+        return [("reply", float(q.sum())) for q in stacked]
+
+    co = SearchCoalescer(run, window_ms=80.0)
+    try:
+        dup = np.full((1, 4), 3.0, np.float32)
+        now = time.monotonic()
+        # alive member: generous deadline
+        token = attach_budget(Budget(60_000.0, priority=2, t0=now))
+        try:
+            f_alive = co.submit("k", dup, region_id=77)
+        finally:
+            detach_budget(token)
+        # doomed member of the SAME fan-out set: alive at admission,
+        # dead by the time the 80ms window flushes
+        token = attach_budget(Budget(20.0, priority=0, t0=now))
+        try:
+            f_dead = co.submit("k", dup, region_id=77)
+        finally:
+            detach_budget(token)
+        assert f_alive.result(timeout=5) == [("reply", 12.0)]
+        with pytest.raises(DeadlineExceeded):
+            f_dead.result(timeout=5)
+    finally:
+        co.stop()
+        FLAGS.set("qos_enabled", False)
+    # the survivor still dispatched its row — once
+    assert len(calls) == 1 and len(calls[0]) == 1
+
+
+def test_collapsed_row_rides_highest_priority_position(cache_on):
+    FLAGS.set("qos_enabled", True)
+    PRESSURE.reset()
+    calls = []
+
+    def run(key, stacked):
+        calls.append(np.array(stacked, copy=True))
+        return [("reply", float(q.sum())) for q in stacked]
+
+    co = SearchCoalescer(run, window_ms=80.0)
+    try:
+        row_a = np.full((1, 4), 1.0, np.float32)
+        row_b = np.full((1, 4), 2.0, np.float32)
+        futs = []
+        # background submits rows A then B; an interactive submitter
+        # duplicates row B — the collapsed B row must ride the
+        # interactive member's position, ahead of A
+        for q, prio in ((row_a, 0), (row_b, 0), (row_b, 2)):
+            token = attach_budget(Budget(60_000.0, priority=prio))
+            try:
+                futs.append(co.submit("k", q, region_id=78))
+            finally:
+                detach_budget(token)
+        got = [f.result(timeout=5) for f in futs]
+    finally:
+        co.stop()
+        FLAGS.set("qos_enabled", False)
+    assert len(calls) == 1
+    assert len(calls[0]) == 2                    # B collapsed
+    assert float(calls[0][0].sum()) == 8.0       # B dispatched first
+    assert got[1] == got[2] == [("reply", 8.0)]
+    assert got[0] == [("reply", 4.0)]
+
+
+# -- key derivation -----------------------------------------------------------
+
+
+def test_query_fingerprints_bind_params_and_bytes():
+    q = np.arange(8, dtype=np.float32).reshape(2, 4)
+    s1 = cache_keys.params_seed(5, (("nprobe", 4),))
+    s2 = cache_keys.params_seed(5, (("nprobe", 8),))
+    s3 = cache_keys.params_seed(5, (("nprobe", 4),), filter_fp=b"\x01")
+    f1 = cache_keys.query_fingerprints(q, s1)
+    assert f1.shape == (2,)
+    # identical rows, different resolved params -> disjoint keys
+    assert not np.any(f1 == cache_keys.query_fingerprints(q, s2))
+    assert not np.any(f1 == cache_keys.query_fingerprints(q, s3))
+    # a single flipped mantissa bit is a different key
+    q2 = q.copy()
+    q2[0, 0] = np.nextafter(q2[0, 0], np.float32(1e9))
+    f2 = cache_keys.query_fingerprints(q2, s1)
+    assert f2[0] != f1[0] and f2[1] == f1[1]

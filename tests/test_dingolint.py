@@ -217,6 +217,61 @@ def test_host_sync_outside_search_modules_ignored(tmp_path):
     assert findings == []
 
 
+# -- serving-edge cache admission path (host-sync + resolve-sync) ------------
+# the cache package roots WHOLESALE (every def, not just search*): a
+# lookup runs on the caller thread before QoS queuing, so any device
+# sync there stalls admission itself
+
+_CACHE_SYNC = """
+    import jax
+    import numpy as np
+
+    def lookup(region_id, fp, version):
+        probe = jax.device_get(_table[fp])   # BAD: sync at admission
+        return probe
+
+    def host_only_lookup(region_id, fp):
+        return _table.get((region_id, fp))
+"""
+
+
+def test_host_sync_roots_cache_modules(tmp_path):
+    findings = _lint(tmp_path, "dingo_tpu/cache/bad.py", _CACHE_SYNC,
+                     HostSyncChecker())
+    assert len(findings) == 1
+    assert "device_get" in findings[0].message
+
+
+def test_host_sync_cache_hidden_cast_flagged(tmp_path):
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def fingerprint(queries):
+            d = jnp.sum(queries, axis=1)
+            return np.asarray(d)             # hidden device_get
+    """
+    findings = _lint(tmp_path, "dingo_tpu/cache/cast.py", src,
+                     HostSyncChecker())
+    assert len(findings) == 1 and "hidden" in findings[0].message
+
+
+def test_host_sync_cache_host_only_clean(tmp_path):
+    good = _CACHE_SYNC.replace(
+        "probe = jax.device_get(_table[fp])   # BAD: sync at admission",
+        "probe = _table[fp]",
+    )
+    assert _lint(tmp_path, "dingo_tpu/cache/good.py", good,
+                 HostSyncChecker()) == []
+
+
+def test_resolve_sync_flags_cache_admission_sync(tmp_path):
+    findings = _lint(tmp_path, "dingo_tpu/cache/bad.py", _CACHE_SYNC,
+                     ResolveSyncChecker())
+    assert len(findings) == 1
+    assert "serving-edge cache" in findings[0].message
+
+
 # -- resolve-sync ------------------------------------------------------------
 
 _TWO_SYNC_RESOLVE = """

@@ -599,8 +599,9 @@ def test_cluster_top_renders_recall_column():
     # region 2 has no evidence: its RECALL cell is '-'
     line2 = next(ln for ln in out.splitlines() if ln.startswith("2 "))
     cells = line2.split()
-    # RECALL sits before the QDEPTH/PRESS/SHED pressure columns + FLAGS
-    assert cells[-5] == "-"
+    # RECALL sits before the QDEPTH/PRESS/SHED pressure columns, the
+    # CACHE column, and FLAGS
+    assert cells[-6] == "-"
 
 
 def test_flight_bundle_captures_quality_state(tmp_path):

@@ -48,6 +48,13 @@ from tools.dingolint.core import Checker, Finding, Module, Repo
 _ROOT_MODULE_PREFIXES = ("dingo_tpu.index.", "dingo_tpu.parallel.")
 _ROOT_BASENAMES = {"search", "search_async"}
 
+#: admission-path subsystems where EVERY def is hot: the serving-edge
+#: cache is consulted BEFORE QoS queuing on the caller thread and its
+#: dedupe plan runs on the flush thread — a device sync anywhere in the
+#: package stalls admission itself, so the whole package roots (not just
+#: defs named search)
+_ADMISSION_MODULE_PREFIXES = ("dingo_tpu.cache.",)
+
 #: traversal never descends into these (their own discipline applies)
 _SKIP_MODULE_PREFIXES = ("dingo_tpu.obs.", "dingo_tpu.trace.",
                          "dingo_tpu.metrics.")
@@ -103,8 +110,9 @@ class HostSyncChecker(Checker):
         cg = repo.callgraph()
         roots = [
             q for q, info in cg.funcs.items()
-            if q.rsplit(".", 1)[-1] in _ROOT_BASENAMES
-            and info.module.name.startswith(_ROOT_MODULE_PREFIXES)
+            if (q.rsplit(".", 1)[-1] in _ROOT_BASENAMES
+                and info.module.name.startswith(_ROOT_MODULE_PREFIXES))
+            or info.module.name.startswith(_ADMISSION_MODULE_PREFIXES)
         ]
 
         def skip(qual: str) -> bool:

@@ -180,6 +180,20 @@ FAMILY_NAMES = {
         "quality.tuner_rerank_factor",
         "quality.tuner_precision_target",  # advisory tier (ladder index)
     },
+    "cache": {
+        # serving-edge result cache + in-flight dedupe (dingo_tpu/cache/)
+        "cache.hits",               # replies served from the cache
+        "cache.misses",             # rows that fell through every tier
+        "cache.dedup_collapsed",    # duplicate in-flight rows merged out
+                                    # of kernel batches
+        "cache.stale_served",       # hits served from a bounded-stale
+                                    # version (degrade-rung only)
+        "cache.semantic_served",    # sq8-fingerprint approximate hits
+                                    # (SLO-gated)
+        "cache.evictions",          # LRU/tenant-fairness evictions
+        "cache.bytes",              # store-wide resident bytes (gauge)
+        "cache.entries",            # live entries per region (gauge)
+    },
     "fault": {
         # fault-domain hardening (PR 14): injection planes, the client
         # resilience policy, and the device-failure recovery ladder

@@ -47,7 +47,13 @@ from tools.dingolint.callgraph import dotted_name
 from tools.dingolint.core import Checker, Finding, Module, Repo
 
 #: where resolve() thunks live (same tiers host-sync roots at)
-_ROOT_MODULE_PREFIXES = ("dingo_tpu.index.", "dingo_tpu.parallel.")
+_ROOT_MODULE_PREFIXES = ("dingo_tpu.index.", "dingo_tpu.parallel.",
+                         "dingo_tpu.cache.")
+
+#: admission-path modules: every def runs on a caller or flush thread
+#: (cache lookup precedes QoS queuing; the dedupe plan forms batches),
+#: so ANY device sync is flagged — there is no sanctioned first fetch
+_ADMISSION_MODULE_PREFIXES = ("dingo_tpu.cache.",)
 
 #: traversal never descends into these (their own discipline applies)
 _SKIP_MODULE_PREFIXES = ("dingo_tpu.obs.", "dingo_tpu.trace.",
@@ -209,7 +215,27 @@ class ResolveSyncChecker(Checker):
     def _check_flush_thread(self, repo: Repo) -> List[Finding]:
         out: List[Finding] = []
         for module in repo.modules:
+            admission = module.name.startswith(_ADMISSION_MODULE_PREFIXES)
             for local, fn in sorted(module.funcs.items()):
+                if admission:
+                    # cache/ admission path: no sanctioned sync anywhere
+                    for node in ast.walk(fn):
+                        if module.qualname_of(node) != local:
+                            continue
+                        if _is_device_get(node) \
+                                or _is_block_until_ready(node):
+                            f = module.finding(
+                                self.name, node,
+                                "device sync in the serving-edge cache — "
+                                "the admission-path lookup/fill runs on "
+                                "the caller thread before QoS queuing and "
+                                "the dedupe plan on the flush thread; "
+                                "cache code must stay host-only (keys, "
+                                "dicts, numpy over host arrays)",
+                            )
+                            if f:
+                                out.append(f)
+                    continue
                 cnode = module.enclosing_class(fn)
                 if cnode is None or cnode.name not in _FLUSH_CLASSES:
                     continue

@@ -277,6 +277,50 @@ def _qos_section(qos: Dict[str, Any]) -> List[str]:
     return out
 
 
+def _cache_section(cache: Dict[str, Any]) -> List[str]:
+    """Serving-edge cache state at capture time (absolute cache.*
+    series): was the cache absorbing the skewed traffic (hits/dedupe) or
+    churning (evictions), and were the degraded tiers (stale/semantic)
+    serving when the incident hit. Store-wide gauges (cache.bytes)
+    render on a '-' region row."""
+    per: Dict[str, Dict[str, float]] = {}
+    for key, val in cache.items():
+        name, labels = _series_labels(key)
+        if not name.startswith("cache."):
+            continue
+        field = name[len("cache."):]
+        agg = per.setdefault(labels.get("region", "-"), {})
+        agg[field] = agg.get(field, 0.0) + val
+    out = [f"-- serving-edge cache ({len(cache)} series)"]
+    rows = []
+    for region in sorted(per):
+        st = per[region]
+        hits = st.get("hits", 0.0)
+        misses = st.get("misses", 0.0)
+        rate = (f"{100.0 * hits / (hits + misses):.0f}%"
+                if hits + misses else "-")
+        rows.append([
+            region,
+            f"{hits:.0f}",
+            f"{misses:.0f}",
+            rate,
+            f"{st.get('dedup_collapsed', 0):.0f}",
+            f"{st.get('stale_served', 0):.0f}",
+            f"{st.get('semantic_served', 0):.0f}",
+            f"{st.get('evictions', 0):.0f}",
+            f"{st.get('entries', 0):.0f}",
+            f"{st.get('bytes', 0):.0f}",
+        ])
+    if rows:
+        out.extend(_table(
+            ["REGION", "HITS", "MISSES", "RATE", "DEDUPED", "STALE",
+             "SEMANTIC", "EVICTED", "ENTRIES", "BYTES"], rows
+        ))
+    else:
+        out.append("  (no cache series)")
+    return out
+
+
 def _consistency_section(consistency: Dict[str, Any],
                          integrity: Dict[str, Any]) -> List[str]:
     """State-integrity view at capture time: the consistency.* counters
@@ -442,6 +486,11 @@ def render(bundle: Dict[str, Any]) -> str:
     if qos:
         out.append("")
         out.extend(_qos_section(qos))
+
+    cache = bundle.get("cache") or {}
+    if cache:
+        out.append("")
+        out.extend(_cache_section(cache))
 
     consistency = bundle.get("consistency") or {}
     integrity = bundle.get("integrity") or {}

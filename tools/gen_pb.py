@@ -81,6 +81,12 @@ NEW_MESSAGES = {
         # index OOMed past the recovery ladder — served by the host
         # exact path until the background re-materialization completes
         ("device_degraded", 28, T.TYPE_BOOL, None, False),
+        # serving-edge cache (dingo_tpu/cache/): cumulative hit/miss
+        # counts and live cached entries — the cluster top CACHE column
+        # renders hit rate ('-' while hits+misses == 0)
+        ("cache_hits", 29, T.TYPE_INT64, None, False),
+        ("cache_misses", 30, T.TYPE_INT64, None, False),
+        ("cache_entries", 31, T.TYPE_INT64, None, False),
     ],
     # whole-store snapshot (process device gauges + per-region list)
     "StoreMetrics": [
