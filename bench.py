@@ -1620,6 +1620,145 @@ def zipf_cache(platform):
     return result
 
 
+def heat_skew(platform):
+    """ISSUE 17: workload-heat plane under Zipf-planted bucket skew —
+    heat ON vs OFF on one IVF config.
+
+    A skewed query stream (90% of traffic drawn from a pool clustered
+    near a few centroids) concentrates IVF probes onto a small bucket
+    set. The heat plane must (a) see that concentration — the decayed
+    mass on the PLANTED hot buckets, read back through
+    HEAT.unit_masses, must be >= 0.8 of total mass — and (b) cost
+    nothing to collect: the touches ride the reply's existing
+    begin_host_fetch group and fold off-thread, so the heat-on arm's
+    p50 batch latency may exceed heat-off by < 2% (hard gate on TPU,
+    informational on CPU where timer jitter dominates at this scale).
+    Zero steady-state recompiles in both arms: observing probes adds no
+    new kernel shapes.
+
+    Reported: planted-hot-bucket mass, sketch gini / hot_fraction /
+    working-set bytes, per-arm p50 QPS, the on/off p50_overhead_pct,
+    recompile delta."""
+    import time as _time
+
+    from dingo_tpu.common.config import FLAGS
+    from dingo_tpu.common.metrics import METRICS
+    from dingo_tpu.index import IndexParameter, IndexType, new_index
+    from dingo_tpu.obs.heat import HEAT
+
+    n = int(os.environ.get("DINGO_BENCH_HEAT_N", 20_000))
+    d = 64
+    nlist, nprobe, k = 32, 8, 10
+    batch = 32
+    iters = int(os.environ.get("DINGO_BENCH_HEAT_ITERS", 40))
+    hot_centroids = 3            # planted skew: queries near these
+    hot_share = 0.9              # fraction of traffic from the hot pool
+    rid = 1700
+    rng = np.random.default_rng(37)
+    ncl = 64
+    centers = rng.standard_normal((ncl, d), dtype=np.float32)
+    x = centers[rng.integers(0, ncl, n)] + 0.3 * rng.standard_normal(
+        (n, d)).astype(np.float32)
+    ids = np.arange(n, dtype=np.int64)
+    idx = new_index(rid, IndexParameter(
+        index_type=IndexType.IVF_FLAT, dimension=d, ncentroids=nlist,
+        default_nprobe=nprobe,
+    ))
+    idx.store.reserve(n)
+    idx.upsert(ids, x)
+    idx.train()
+    idx.warmup(batches=(batch,), topk=k, nprobe=nprobe)
+
+    # plant the skew AFTER training so the hot set is defined in terms
+    # of the trained buckets: hot queries jitter around a few centroids,
+    # so their nprobe-nearest probe sets are small and stable
+    cents = np.asarray(idx.centroids)
+    hot_ids = rng.choice(nlist, hot_centroids, replace=False)
+    hot_pool = cents[rng.choice(hot_ids, 256)] + 0.05 * (
+        rng.standard_normal((256, d)).astype(np.float32))
+    cold_pool = rng.standard_normal((256, d)).astype(np.float32)
+    # the buckets those hot queries actually probe (same assignment math
+    # the kernel runs) — the mass-concentration gate's denominator
+    cd = ((hot_pool ** 2).sum(1)[:, None] - 2.0 * hot_pool @ cents.T
+          + (cents ** 2).sum(1)[None, :])
+    planted = np.unique(np.argsort(cd, axis=1)[:, :nprobe])
+
+    def make_batch(arm_rng):
+        hot_n = int(round(batch * hot_share))
+        qs = np.concatenate([
+            hot_pool[arm_rng.integers(0, len(hot_pool), hot_n)],
+            cold_pool[arm_rng.integers(0, len(cold_pool), batch - hot_n)],
+        ])
+        return qs[arm_rng.permutation(batch)]
+
+    def one_arm(heat_on: bool, seed: int):
+        FLAGS.set("heat_enabled", heat_on)
+        HEAT.reset()
+        arm_rng = np.random.default_rng(seed)
+        # warm this arm's path (flag is captured at dispatch)
+        idx.search(make_batch(arm_rng), k, nprobe=nprobe)
+        lats = []
+        for _ in range(iters):
+            q = make_batch(arm_rng)
+            t0 = _time.perf_counter()
+            idx.search(q, k, nprobe=nprobe)
+            lats.append(_time.perf_counter() - t0)
+        if heat_on:
+            HEAT.flush()
+        lats.sort()
+        p50 = lats[len(lats) // 2]
+        return {"p50_ms": round(p50 * 1e3, 3),
+                "p50_qps": round(batch / p50, 1)}
+
+    recompiles_c = METRICS.counter("xla.recompiles")
+    recompiles0 = recompiles_c.get()
+    off = one_arm(False, 101)
+    on = one_arm(True, 101)     # same stream: the arms differ by flag only
+    recompiles = recompiles_c.get() - recompiles0
+
+    # the heat-on arm left its sketch behind: read the skew back
+    masses = HEAT.unit_masses(rid, "ivf")
+    total_mass = sum(masses.values())
+    hot_mass = sum(v for (kind, unit), v in masses.items()
+                   if unit in set(planted.tolist()))
+    hot_mass_frac = hot_mass / total_mass if total_mass else 0.0
+    stats = HEAT.region_stats(rid) or {}
+    overhead_pct = (
+        (on["p50_ms"] - off["p50_ms"]) / off["p50_ms"] * 100.0
+        if off["p50_ms"] else 0.0
+    )
+    FLAGS.set("heat_enabled", False)
+    HEAT.reset()
+
+    result = {
+        "config": f"heat_skew_ivf_{n//1000}k_x{d}_nlist{nlist}_"
+                  f"nprobe{nprobe}_hot{hot_centroids}c_{hot_share:.0%}",
+        "planted_buckets": int(planted.size),
+        "hot_bucket_mass": round(hot_mass_frac, 3),
+        "sketch_gini": round(float(stats.get("gini", 0.0)), 3),
+        "sketch_hot_fraction": round(
+            float(stats.get("hot_fraction", 0.0)), 3),
+        "working_set_p99_bytes": int(
+            (stats.get("ws_bytes") or {}).get(99, 0)),
+        "heat_off": off,
+        "heat_on": on,
+        "p50_overhead_pct": round(overhead_pct, 2),
+        "steady_state_recompiles": int(recompiles),
+        # acceptance gates
+        "hot_mass_gate": bool(hot_mass_frac >= 0.8),
+        # hard on TPU; CPU timer jitter at ~ms batches swamps the real
+        # cost (one fetch-group entry + one deque append per reply)
+        "overhead_gate": bool(overhead_pct < 2.0) if platform == "tpu"
+        else None,
+        "recompile_gate": bool(recompiles == 0),
+    }
+    log(f"heat_skew: hot-bucket mass={hot_mass_frac:.2f} "
+        f"(gate>=0.8), gini={result['sketch_gini']:.2f}, "
+        f"p50 on={on['p50_ms']:.2f}ms off={off['p50_ms']:.2f}ms "
+        f"({overhead_pct:+.1f}%), recompiles={recompiles}")
+    return result
+
+
 def pipeline_sweep(platform):
     """ISSUE 15: stall-free serving pipeline — closed-loop saturation
     through the coalescer's overlapped-dispatch arm at staging depth
@@ -2010,6 +2149,10 @@ def main():
     #     traffic, cache on vs off per skew (ISSUE 16) ---
     zipf = zipf_cache(platform)
 
+    # --- workload-heat plane under planted bucket skew, heat on vs off
+    #     (ISSUE 17) ---
+    heat = heat_skew(platform)
+
     # --- state integrity: digest ledger + corruption scrub on vs off
     #     (ISSUE 11) ---
     integ = integrity_scrub(platform)
@@ -2132,6 +2275,13 @@ def main():
         # goodput/p99/hit-rate, the byte-identical-hits gate, hit_rate>0
         # at s>=0.9, and zero recompiles with dedupe-shrunk batches
         "zipf_cache": zipf,
+        # workload-heat plane (ISSUE 17): planted Zipf bucket skew with
+        # the heat sketch on vs off — the sketch's hot-bucket mass must
+        # recover >= 0.8 of the planted concentration, the heat-on arm's
+        # p50 must stay within 2% (hard on TPU), and observing probes
+        # must add zero recompiles (the touches ride the existing
+        # fetch group)
+        "heat_skew": heat,
         # state-integrity plane (ISSUE 11): mixed r/w p99 with the digest
         # ledger + concurrent scrub on vs off (< 5% overhead gate, zero
         # recompiles — the ledger is host hashing only) and the
@@ -2194,6 +2344,17 @@ if __name__ == "__main__":
         out = zipf_cache("cpu")
         print(json.dumps({"zipf_cache": out}))
         sys.exit(0 if out["byte_identical_hits"] else 1)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--heat-skew":
+        # standalone: just the workload-heat arms (acceptance smoke);
+        # exits non-zero when the sketch failed to recover the planted
+        # skew or observing it recompiled anything
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        out = heat_skew("cpu")
+        print(json.dumps({"heat_skew": out}))
+        sys.exit(0 if out["hot_mass_gate"] and out["recompile_gate"]
+                 else 1)
     if len(sys.argv) >= 2 and sys.argv[1] == "--pipeline":
         # standalone: just the stall-free pipeline sweep (acceptance
         # smoke); exits non-zero if any depth broke byte-identity

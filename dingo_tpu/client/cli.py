@@ -74,6 +74,15 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
         total = hits + misses
         return f"{100.0 * hits / total:.0f}%" if total else "-"
 
+    def _heat_cell(hot: float, touches: int) -> str:
+        # traffic concentration (mass on the hottest 10% of heat units);
+        # no sketch touches = no evidence (heat off / idle) renders '-'
+        return f"{hot:.2f}" if touches else "-"
+
+    def _wset_cell(ws: int, touches: int) -> str:
+        # bytes to serve 99% of measured traffic at the region's tier
+        return _fmt_bytes(int(ws)) if touches else "-"
+
     for entry in resp.stores:
         m = entry.metrics
         # store-level recall: sample-weighted mean over leader regions
@@ -97,6 +106,8 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
             _fmt_bytes(m.device_bytes_in_use),
             f"{sum(r.search_qps for r in m.regions if r.is_leader):.1f}",
             _recall_cell(q_recall, q_samples),
+            _wset_cell(sum(r.heat_working_set_p99 for r in m.regions),
+                       sum(r.heat_touches for r in m.regions)),
             str(sum(r.qos_queue_depth for r in m.regions)),
             # PRESSURE: worst recent queue-wait watermark across hosted
             # regions (ms) — the figure the shed ladder defends
@@ -143,6 +154,8 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
                 str(r.apply_lag),
                 f"{r.search_qps:.1f}",
                 _recall_cell(r.quality_recall, r.quality_samples),
+                _heat_cell(r.heat_hot_fraction, r.heat_touches),
+                _wset_cell(r.heat_working_set_p99, r.heat_touches),
                 str(r.qos_queue_depth),
                 f"{r.qos_queue_wait_ms:.0f}ms",
                 str(r.qos_shed_total),
@@ -154,17 +167,75 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
         _render_table(
             ["STORE", "METRICS", "REGIONS", "LEADERS", "KEYS", "VECTORS",
              "MEM", "DEVMEM", "DEVPEAK", "DEV-IN-USE", "QPS", "RECALL",
-             "QDEPTH", "PRESS", "SHED", "CACHE"],
+             "WSET", "QDEPTH", "PRESS", "SHED", "CACHE"],
             store_rows,
         ),
         "",
         _render_table(
             ["REGION", "STORE", "ROLE", "KEYS", "VECTORS", "MEM", "DEVMEM",
-             "DEVPEAK", "LAG", "QPS", "RECALL", "QDEPTH", "PRESS", "SHED",
-             "CACHE", "FLAGS"],
+             "DEVPEAK", "LAG", "QPS", "RECALL", "HEAT", "WSET", "QDEPTH",
+             "PRESS", "SHED", "CACHE", "FLAGS"],
             region_rows,
         ),
     ]
+    return "\n".join(out)
+
+
+def format_cluster_capacity(resp, store_id: str = "") -> str:
+    """`cluster capacity`: per-store headroom-vs-demand table plus the
+    advisory list, rendered from a GetStoreMetricsResponse. The plan is
+    recomputed client-side with the SAME pure functions the coordinator
+    heartbeat hook runs (coordinator/capacity.plan_store, duck-typed
+    over pb messages) — no second RPC, no divergent math. Advisories
+    are recommendations only; nothing in this path actuates."""
+    from dingo_tpu.coordinator import capacity as cap
+
+    store_rows = []
+    advice_rows = []
+    for entry in resp.stores:
+        if store_id and entry.store_id != store_id:
+            continue
+        plan = cap.plan_store(entry.metrics)
+        sid = plan["store_id"] or entry.store_id
+        touches = plan["touches"]
+        store_rows.append([
+            sid,
+            "STALE" if entry.stale else "ok",
+            _fmt_bytes(plan["limit_bytes"]),
+            _fmt_bytes(plan["in_use_bytes"]),
+            _fmt_bytes(plan["headroom_bytes"]),
+            f"{plan['headroom_frac']:.0%}",
+            # demand/cold columns need sketch evidence to mean anything
+            _fmt_bytes(plan["demand_p99_bytes"]) if touches else "-",
+            _fmt_bytes(plan["resident_bytes"]),
+            str(touches),
+            str(len(plan["advice"])),
+        ])
+        for a in plan["advice"]:
+            advice_rows.append([
+                sid,
+                str(a.region_id),
+                a.kind,
+                _fmt_bytes(a.bytes_at_stake),
+                a.reason,
+            ])
+    out = [
+        _render_table(
+            ["STORE", "METRICS", "LIMIT", "IN-USE", "HEADROOM", "FREE%",
+             "DEMAND-P99", "RESIDENT", "TOUCHES", "ADVICE"],
+            store_rows,
+        ),
+    ]
+    if advice_rows:
+        out += [
+            "",
+            _render_table(
+                ["STORE", "REGION", "KIND", "AT-STAKE", "WHY"],
+                advice_rows,
+            ),
+        ]
+    else:
+        out += ["", "no capacity advisories"]
     return "\n".join(out)
 
 
@@ -415,6 +486,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="limit to one store id")
     top.add_argument("--region", type=int, default=0,
                      help="limit the region table to one region id")
+    capacity = cluster.add_parser("capacity")  # headroom vs heat demand
+    capacity.add_argument("--store", dest="target_store", default="",
+                          help="limit to one store id")
     consistency = cluster.add_parser("consistency")
     consistency.add_argument("--region", type=int, default=0,
                              help="limit to one region id")
@@ -725,6 +799,12 @@ def run_command(client: DingoClient, args) -> int:
             pb.GetStoreMetricsRequest(store_id=args.target_store)
         )
         print(format_cluster_top(r, region_id=args.region))
+    elif g == "cluster" and c == "capacity":
+        stub = client.coordinator_service("ClusterStatService")
+        r = stub.GetStoreMetrics(
+            pb.GetStoreMetricsRequest(store_id=args.target_store)
+        )
+        print(format_cluster_capacity(r, store_id=args.target_store))
     elif g == "cluster" and c == "consistency":
         stub = client.coordinator_service("ClusterStatService")
         r = stub.GetRegionMetrics(

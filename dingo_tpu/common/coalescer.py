@@ -212,9 +212,13 @@ class SearchCoalescer:
         self._ready: List = []
         #: queued query rows per tenant (admission cap bookkeeping)
         self._tenant_rows: Dict[str, int] = {}
-        #: EWMA of per-row service time / per-batch run time (seeded
-        #: pessimistically low so the first batches are never shed on a
-        #: figure nobody measured)
+        #: EWMA of per-row service time / per-batch run time. Zero until
+        #: the first measurement — but the admission estimate no longer
+        #: reads that zero as "service is free": estimated_wait_ms
+        #: prices unmeasured queues at the cost model's conservative
+        #: ``cost.prior_row_ms`` prior, so even the FIRST overload burst
+        #: sheds. The per-(kernel, shape) surface in obs/cost.py refines
+        #: these scalars as real timings land.
         self._ewma_row_ms = 0.0
         self._ewma_run_ms = 0.0
         self._wake = threading.Event()
@@ -233,26 +237,57 @@ class SearchCoalescer:
         return (sum(b.rows() for b in self._pending.values())
                 + sum(b.rows() for _, b in self._ready))
 
-    def estimated_wait_ms(self, extra_rows: int = 0) -> float:
-        """Admission estimate: rows ahead x measured per-row service time
-        plus one batch run (the one possibly in flight). Zero until the
-        first batch has been measured."""
-        if self._ewma_row_ms <= 0:
-            return 0.0
+    def estimated_wait_ms(self, extra_rows: int = 0,
+                          key: Any = None) -> float:
+        """Admission estimate: rows ahead priced by the per-shape cost
+        model (obs/cost.py) when this key's kernel has been measured,
+        the scalar per-row EWMA otherwise, plus one batch run (the one
+        possibly in flight). Before ANY sample has landed the estimate
+        is the conservative ``cost.prior_row_ms`` prior — never 0 — so
+        the first overload burst sheds instead of riding in on a figure
+        nobody measured (the old cold-start hole). Cost model off +
+        nothing measured keeps the legacy 0.0 answer."""
         with self._lock:
             rows = self._queued_rows()
-        return (rows + extra_rows) * self._ewma_row_ms + self._ewma_run_ms
+        total = rows + extra_rows
+        try:
+            from dingo_tpu.obs import cost as _cost
+        except ImportError:  # pragma: no cover — obs always present
+            _cost = None
+        if _cost is not None and _cost.cost_enabled():
+            kid = _cost.kernel_id(key) if key is not None else None
+            if _cost.COST.has_model(kid):
+                return (_cost.COST.estimate_run_ms(kid, total)
+                        + self._ewma_run_ms)
+            if self._ewma_row_ms <= 0:
+                return total * _cost.prior_row_ms()
+        if self._ewma_row_ms <= 0:
+            return 0.0
+        return total * self._ewma_row_ms + self._ewma_run_ms
 
-    def _est_run_ms(self, rows: int) -> float:
-        """Expected run time for a batch of `rows`: the per-batch EWMA
-        floor (fixed dispatch overhead) scaled up by the per-row cost for
-        batches larger than recent history — a 256-row batch must not be
-        judged by the run time of the 8-row batches that preceded it."""
+    def _est_run_ms(self, rows: int, key: Any = None) -> float:
+        """Expected run time for a batch of `rows`: the key's measured
+        per-shape surface when the cost model has one; otherwise the
+        per-batch EWMA floor (fixed dispatch overhead) scaled up by the
+        per-row cost for batches larger than recent history — a 256-row
+        batch must not be judged by the run time of the 8-row batches
+        that preceded it."""
+        if key is not None:
+            try:
+                from dingo_tpu.obs import cost as _cost
+
+                if _cost.cost_enabled():
+                    kid = _cost.kernel_id(key)
+                    if _cost.COST.has_model(kid):
+                        return _cost.COST.estimate_run_ms(kid, rows)
+            except ImportError:  # pragma: no cover
+                pass
         if self._ewma_row_ms <= 0:
             return self._ewma_run_ms
         return max(self._ewma_run_ms, rows * self._ewma_row_ms)
 
-    def _note_run(self, rows: int, run_ms: float) -> None:
+    def _note_run(self, rows: int, run_ms: float,
+                  key: Any = None) -> None:
         if rows <= 0 or run_ms <= 0:
             return
         row_ms = run_ms / rows
@@ -261,8 +296,17 @@ class SearchCoalescer:
                              else a * row_ms + (1 - a) * self._ewma_row_ms)
         self._ewma_run_ms = (run_ms if self._ewma_run_ms == 0
                              else a * run_ms + (1 - a) * self._ewma_run_ms)
+        if key is not None:
+            try:
+                from dingo_tpu.obs import cost as _cost
 
-    def _admission_reject(self, budget, n_rows: int, region_id: int):
+                _cost.COST.note(_cost.kernel_id(key), rows, run_ms,
+                                region_id=_cost.kernel_region(key))
+            except ImportError:  # pragma: no cover
+                pass
+
+    def _admission_reject(self, budget, n_rows: int, region_id: int,
+                          key: Any = None):
         """QoS admission decision for one submit. Returns an exception to
         set on the future (after counting it), or None = admit. Called
         OUTSIDE the queue lock — only estimates are read here."""
@@ -289,7 +333,7 @@ class SearchCoalescer:
                     f"tenant {budget.tenant} over queue cap "
                     f"({queued}+{n_rows} > {tenant_cap} rows)"
                 )
-        est_ms = self.estimated_wait_ms(extra_rows=n_rows)
+        est_ms = self.estimated_wait_ms(extra_rows=n_rows, key=key)
         if budget is not None and budget.deadline_ms > 0 \
                 and est_ms > budget.remaining_ms():
             # hopeless: it would expire in queue — serving it late only
@@ -342,7 +386,7 @@ class SearchCoalescer:
             pass
         if qos:
             rejection = self._admission_reject(budget, len(queries),
-                                               region_id)
+                                               region_id, key=key)
             if rejection is not None:
                 wait_span.end()
                 fut.set_exception(rejection)
@@ -423,7 +467,7 @@ class SearchCoalescer:
                     self._tenant_rows.pop(e.tenant, None)
 
     def _expire_dead(self, entries: List[_Entry], region_id: int,
-                     now: float) -> List[_Entry]:
+                     now: float, key: Any = None) -> List[_Entry]:
         """Expiry before dispatch: fail entries that died in queue (or
         whose remaining budget cannot cover the estimated run — they
         WOULD die mid-flight) and return the survivors."""
@@ -449,7 +493,7 @@ class SearchCoalescer:
                     rows = deduped_rows(entries)
             except ImportError:  # pragma: no cover
                 pass
-        est_run = _EXPIRY_RUN_MARGIN * self._est_run_ms(rows)
+        est_run = _EXPIRY_RUN_MARGIN * self._est_run_ms(rows, key=key)
         live: List[_Entry] = []
         for e in entries:
             if e.budget is None or e.budget.deadline_ms <= 0:
@@ -514,7 +558,8 @@ class SearchCoalescer:
                 PRESSURE.observe_wait(e.region_id, waits_ms[id(e)],
                                       e.budget)
         if qos:
-            entries = self._expire_dead(entries, region_id, flush_t0)
+            entries = self._expire_dead(entries, region_id, flush_t0,
+                                        key=key)
             if not entries:
                 # a batch of only dead requests dispatches NO kernel
                 if run_span is not NOOP_SPAN:
@@ -619,7 +664,7 @@ class SearchCoalescer:
             else:
                 results = self.run_fn(key, stacked)
             run_ms = (time.monotonic() - run_t0) * 1000.0
-            self._note_run(len(stacked), run_ms)
+            self._note_run(len(stacked), run_ms, key=key)
             self._fan_out(entries, results, plan)
             if qos:
                 self._account_stages(entries, waits_ms, form_ms, run_ms,
@@ -859,7 +904,8 @@ class _Handoff:
             results = self.thunk()
             resolve_ms = (time.monotonic() - t0) * 1000.0
             rows = self.rows or sum(len(e.queries) for e in self.entries)
-            c._note_run(rows, self.dispatch_ms + resolve_ms)
+            c._note_run(rows, self.dispatch_ms + resolve_ms,
+                        key=self.key)
             kernel_ms, rerank_ms = resolve_ms, 0.0
             if self.stage_us:
                 k = self.stage_us.get("search_us", 0) / 1000.0

@@ -465,6 +465,48 @@ FLAGS.define("cache_tenant_share", 0.5, mutable=True,
                    "cache.max_bytes any single tenant's entries may "
                    "occupy (its own inserts evict its own LRU tail past "
                    "the share). <= 0 or >= 1 disables the bound")
+FLAGS.define("heat_enabled", False, mutable=True,
+             help_="workload-heat plane (obs/heat.py): per-region "
+                   "exponential-decay access sketches fed from data the "
+                   "resolve paths already hold on host (probed IVF "
+                   "buckets, FLAT/HNSW result slot ranges) — zero new "
+                   "device syncs — plus the derived working-set "
+                   "estimator. Off = observe nothing, allocate nothing "
+                   "(the quality-plane sampling discipline)")
+FLAGS.define("heat_decay_s", 300.0, mutable=True,
+             help_="e-folding time constant of the heat sketches: a "
+                   "unit untouched for this long keeps 1/e of its mass. "
+                   "~5 min tracks traffic shifts faster than the "
+                   "coordinator acts on them while riding out "
+                   "second-scale burstiness")
+FLAGS.define("heat_max_entries", 4096, mutable=True,
+             help_="bound on live sketch entries per region: past it the "
+                   "coldest units are evicted (their mass is the least "
+                   "informative). Memory per region stays O(max_entries)")
+FLAGS.define("cost_enabled", True, mutable=True,
+             help_="per-(kernel, padded-shape-ladder-point) dispatch "
+                   "cost model (obs/cost.py) learned from the completion "
+                   "lane's stage timings; consulted by QoS "
+                   "estimated_wait_ms and the SLO tuner's latency "
+                   "budget. Off = the coalescer falls back to its single "
+                   "scalar per-row EWMA")
+FLAGS.define("cost_prior_row_ms", 0.5, mutable=True,
+             help_="conservative per-row service-time prior the wait "
+                   "estimator sheds on before the first measured sample "
+                   "lands — the first overload burst must not ride in on "
+                   "a 0ms estimate (pessimistic on purpose: over-shedding "
+                   "a cold store beats serving it into collapse)")
+FLAGS.define("capacity_advise", True, mutable=True,
+             help_="coordinator capacity plane: roll per-store HBM "
+                   "headroom vs heartbeat working-set demand and emit "
+                   "ADVISORY-ONLY tier/split recommendations "
+                   "(capacity.* metrics, cluster capacity table). Never "
+                   "actuates — tiering and split are roadmap items 1-2")
+FLAGS.define("capacity_headroom_target", 0.2, mutable=True,
+             help_="fraction of a store's HBM the capacity plane wants "
+                   "free: below it the coldest region (most resident "
+                   "bytes outside its working set) draws a demote "
+                   "advisory")
 FLAGS.define("vector_blocked_layout", "auto", mutable=True,
              help_="maintain a dimension-blocked ([n_blocks, capacity, "
                    "block_d]) scan mirror + per-block norms in float/sq8 "

@@ -127,6 +127,15 @@ class CoordinatorControl:
         #: indices (state-integrity plane): region_id -> evidence dict.
         #: In-memory like store_metrics — re-derived from every beat
         self.integrity_diverged: Dict[int, Dict] = {}
+        #: capacity plane (coordinator/capacity.py): per-store plan
+        #: re-derived from every beat's heat rollups. ADVISORY ONLY —
+        #: tiering/split actuation is roadmap items 1-2. In-memory like
+        #: store_metrics
+        self.capacity_plans: Dict[str, Dict] = {}
+        #: (store, region, kind) advisories already counted — the
+        #: capacity.advisories counter ticks on NEW advice, not on every
+        #: beat that re-derives the same one
+        self._capacity_advised: set = set()
         self.jobs: List[RegionCmd] = []
         self._next_region_id = 1000
         self._next_cmd_id = 1
@@ -305,6 +314,10 @@ class CoordinatorControl:
         # neither belongs under the coordinator's global lock
         if metrics is not None:
             self._check_integrity(store_id, metrics)
+            # capacity rollups ride the same beat: headroom vs working-
+            # set demand + advisory tier/split recommendations. Same
+            # outside-the-lock, never-raises stance as _check_integrity
+            self._update_capacity(store_id, metrics)
         return pending
 
     def reset_sent_cmds(self) -> int:
@@ -458,6 +471,66 @@ class CoordinatorControl:
     def diverged_regions(self) -> List[int]:
         with self._lock:
             return sorted(self.integrity_diverged)
+
+    # ---------------- capacity plane (advisory only) ------------------------
+    def _update_capacity(self, store_id: str, metrics) -> None:
+        """Re-derive the arriving store's capacity plan from its beat's
+        heat rollups (coordinator/capacity.py): HBM headroom vs p99
+        working-set demand + advisory tier/split recommendations.
+        ADVISORY ONLY — nothing here creates region commands; actuation
+        is roadmap items 1-2. Runs OUTSIDE the coordinator lock (takes
+        it briefly to store the plan); never raises."""
+        try:
+            self._update_capacity_inner(store_id, metrics)
+        except Exception:  # noqa: BLE001 — telemetry must not kill beats
+            _log.exception("capacity planning failed")
+
+    def _update_capacity_inner(self, store_id: str, metrics) -> None:
+        from dingo_tpu.common.metrics import METRICS
+        from dingo_tpu.coordinator import capacity as cap
+
+        if not cap.capacity_advise_enabled():
+            with self._lock:
+                self.capacity_plans.pop(store_id, None)
+            return
+        plan = cap.plan_store(metrics)
+        plan["store_id"] = plan["store_id"] or store_id
+        with self._lock:
+            self.capacity_plans[store_id] = plan
+            live = {(store_id, a.region_id, a.kind)
+                    for a in plan["advice"]}
+            fresh = live - self._capacity_advised
+            # retire memo entries whose advice lapsed so a recurrence
+            # counts again (this store's keys only)
+            self._capacity_advised = {
+                k for k in self._capacity_advised if k[0] != store_id
+            } | live
+        g = METRICS.gauge
+        labels = {"store": store_id}
+        g("capacity.headroom_bytes", labels=labels).set(
+            plan["headroom_bytes"])
+        g("capacity.headroom_fraction", labels=labels).set(
+            round(plan["headroom_frac"], 6))
+        g("capacity.demand_p99_bytes", labels=labels).set(
+            plan["demand_p99_bytes"])
+        g("capacity.resident_bytes", labels=labels).set(
+            plan["resident_bytes"])
+        g("capacity.advice_count", labels=labels).set(
+            len(plan["advice"]))
+        for _sid, rid, kind in fresh:
+            METRICS.counter("capacity.advisories", region_id=rid,
+                            labels={"kind": kind}).add(1)
+            region_log(_log, rid).info(
+                "capacity advisory (%s): %s", kind,
+                next(a.reason for a in plan["advice"]
+                     if a.region_id == rid and a.kind == kind))
+
+    def capacity_report(self) -> List[Dict]:
+        """Per-store capacity plans, store-id ordered (DebugService /
+        tests). Each plan is the plan_store dict — advice included."""
+        with self._lock:
+            return [self.capacity_plans[sid]
+                    for sid in sorted(self.capacity_plans)]
 
     # ---------------- metrics aggregation -----------------------------------
     def get_store_metrics(self, store_id: str = "", *,

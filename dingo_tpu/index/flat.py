@@ -397,12 +397,22 @@ class _SlotStoreIndex(VectorIndex):
         # trace hook OUTSIDE the device lock: a sampled request blocks for
         # a true kernel-time span without stalling concurrent searches
         device_wait_span("flat_scan", (dists, slots))
+        from dingo_tpu.obs.heat import HEAT, heat_enabled
+
+        heat_on = heat_enabled()
+        if heat_on:
+            HEAT.register_layout(self.id, "slot", self._heat_layout)
         def resolve() -> List[SearchResult]:
             try:
                 fetched = jax.device_get(fetch)
                 dists_h, slots_h = fetched[0], fetched[1]
                 if stats is not None:
                     self._note_prune_stats(fetched[2][:b])
+                if heat_on:
+                    # result slots -> slot-block heat units, from the
+                    # array this resolve ALREADY fetched (no new sync;
+                    # -1 padding filtered on the heat worker)
+                    HEAT.observe(self.id, "slot", slots_h[:b])
                 ids = store.ids_of_slots(slots_h[:b])
                 dists_h = self._convert_distances(dists_h)
                 # head-sampled shadow scoring (async lane; noop at rate 0);
@@ -422,6 +432,20 @@ class _SlotStoreIndex(VectorIndex):
         """Kernel-score -> wire-distance hook (identity for float metrics;
         binary hamming converts from the cached-pm1 IP score)."""
         return dists
+
+    def _heat_layout(self) -> dict:
+        """Heat-plane layout provider: FLAT heat units are fixed
+        SLOT_BLOCK slot ranges, priced at this tier's bytes/row (heat
+        worker thread)."""
+        from dingo_tpu.obs.heat import SLOT_BLOCK, TIER_BYTES
+
+        tier = getattr(self, "_precision", "fp32")
+        return {
+            "rows_per_unit": SLOT_BLOCK,
+            "row_bytes": self.dimension * TIER_BYTES.get(tier, 4.0),
+            "tier": tier,
+            "dim": self.dimension,
+        }
 
     def _run_search_kernel(self, qpad, mask, k):
         """Kernel crossover for the whole-store scan; returns (dists,

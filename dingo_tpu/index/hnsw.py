@@ -456,6 +456,11 @@ class TpuHnsw(_SlotStoreIndex):
         from dingo_tpu.ops.distance import device_wait_span
 
         device_wait_span("beam_search", (dists, out_slots))
+        from dingo_tpu.obs.heat import HEAT, heat_enabled
+
+        heat_on = heat_enabled()
+        if heat_on:
+            HEAT.register_layout(self.id, "slot", self._heat_layout)
 
         def resolve() -> List[SearchResult]:
             try:
@@ -465,6 +470,13 @@ class TpuHnsw(_SlotStoreIndex):
                 self._note_walk_stats(
                     hops_h[:b], vc_h[:b], occ_h[:b], cap, beam
                 )
+                if heat_on:
+                    # result slots mark the graph neighborhoods the walk
+                    # landed in; the per-query visited count weights the
+                    # touch by how much of the graph the walk crossed.
+                    # Both arrays were ALREADY in this fetch group.
+                    w = float(max(1.0, np.mean(vc_h[:b]) / max(1, beam)))
+                    HEAT.observe(self.id, "slot", slots_h[:b], weight=w)
                 ids = store.ids_of_slots(slots_h[:b])
                 # head-sampled shadow scoring, attributed to the beam
                 # bucket the walk ran with (async lane; noop at rate 0)
@@ -531,10 +543,17 @@ class TpuHnsw(_SlotStoreIndex):
         from dingo_tpu.ops.topk import begin_host_fetch
 
         fetch = begin_host_fetch(dists, out_slots)
+        from dingo_tpu.obs.heat import HEAT, heat_enabled
+
+        heat_on = heat_enabled()
+        if heat_on:
+            HEAT.register_layout(self.id, "slot", self._heat_layout)
 
         def resolve() -> List[SearchResult]:
             try:
                 dists_h, slots_h = jax.device_get(fetch)
+                if heat_on:
+                    HEAT.observe(self.id, "slot", slots_h[:b])
                 ids = store.ids_of_slots(slots_h[:b])
                 from dingo_tpu.obs.quality import QUALITY
 
@@ -603,6 +622,20 @@ class TpuHnsw(_SlotStoreIndex):
         METRICS.gauge("hnsw.beam_occupancy", region_id=self.id).set(
             float(np.mean(occ)) / max(1, beam) if len(occ) else 0.0
         )
+
+    def _heat_layout(self) -> dict:
+        """Heat-plane layout provider: HNSW heat units are SLOT_BLOCK
+        slot ranges of the backing store (graph adjacency bytes ride
+        with the rows they index), priced at this tier's bytes/row."""
+        from dingo_tpu.obs.heat import SLOT_BLOCK, TIER_BYTES
+
+        tier = getattr(self, "_precision", "fp32")
+        return {
+            "rows_per_unit": SLOT_BLOCK,
+            "row_bytes": self.dimension * TIER_BYTES.get(tier, 4.0),
+            "tier": tier,
+            "dim": self.dimension,
+        }
 
     def warmup(self, batches=(1, 8, 64), topk: int = 10,
                ef: Optional[int] = None) -> int:

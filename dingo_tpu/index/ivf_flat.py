@@ -389,6 +389,26 @@ class IvfViewMaintenance:
             out.update(self._view.stats())
         return out
 
+    def _heat_layout(self) -> Optional[dict]:
+        """Heat-plane layout provider: rows per IVF bucket from the host
+        assignment array, priced at this tier's bytes/row. Invoked on
+        the heat plane's WORKER thread (<= once per layout TTL), so the
+        bincount never rides a serving thread."""
+        assign = self._assign_h
+        if assign is None:
+            return None
+        from dingo_tpu.obs.heat import TIER_BYTES
+
+        rows = np.bincount(assign[assign >= 0].astype(np.int64),
+                           minlength=self.nlist)
+        return {
+            "unit_rows": rows,
+            "row_bytes": self.dimension * TIER_BYTES.get(
+                self._precision, 4.0),
+            "tier": self._precision,
+            "dim": self.dimension,
+        }
+
     # -- state-integrity: bucket-assignment artifact -----------------------
     def _integrity_assign(self, ids: np.ndarray, assign: np.ndarray) -> None:
         """Fold a write batch's coarse-list assignments into the
@@ -916,8 +936,16 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
             device_wait_span("rerank", (dists, slots))
         store = self.store
         # one-sync epilogue: the whole reply (prune stats included) joins
-        # a single D2H copy group; resolve device_gets it exactly once
-        fetch = begin_host_fetch(dists, slots, stats)
+        # a single D2H copy group; resolve device_gets it exactly once.
+        # The heat plane's probed-bucket ids ride the SAME group — the
+        # access sketch costs zero extra syncs (resolve-sync contract)
+        from dingo_tpu.obs.heat import HEAT, heat_enabled
+
+        heat_on = heat_enabled()
+        if heat_on:
+            HEAT.register_layout(self.id, "ivf", self._heat_layout)
+        fetch = begin_host_fetch(dists, slots, stats,
+                                 probes if heat_on else None)
         def resolve() -> List[SearchResult]:
             try:
                 fetched = jax.device_get(fetch)
@@ -926,6 +954,10 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
                     # pruned-fraction observability rides the result
                     # fetch — no extra sync on the dispatch path
                     self._note_prune_stats(fetched[2][:b])
+                if heat_on:
+                    # probed bucket ids = which partitions this batch
+                    # actually read (bounded enqueue; folds async)
+                    HEAT.observe(self.id, "ivf", fetched[-1][:b])
                 # shape bucketing may have run a larger k; slice back
                 ids = store.ids_of_slots(slots_h[:b, :topk])
                 dists_h = self._convert_distances(dists_h[:b, :topk])

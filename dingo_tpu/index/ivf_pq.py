@@ -699,11 +699,23 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
             lease.release()
             raise
         from dingo_tpu.ops.topk import begin_host_fetch
+        from dingo_tpu.obs.heat import HEAT, heat_enabled
 
-        fetch = begin_host_fetch(dists, slots)
+        # probed-bucket ids ride the reply's one D2H group (zero extra
+        # syncs), same as ivf_flat's heat hook
+        heat_on = heat_enabled()
+        if heat_on:
+            HEAT.register_layout(self.id, "ivf", self._heat_layout)
+        fetch = begin_host_fetch(dists, slots,
+                                 probes if heat_on else None)
 
         def resolve() -> List[SearchResult]:
             try:
+                fetched = jax.device_get(fetch)
+                if heat_on:
+                    # fetch tuple is positional over non-None members:
+                    # probes joined LAST, so [-1] is safe in both arms
+                    HEAT.observe(self.id, "ivf", fetched[-1][:b])
                 if rerank:
                     # ADC was a prune; the exact rows sit in host memory
                     # (host_vectors mode), so rerank at RESOLVE time — the
@@ -714,13 +726,13 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
                     # even start, and the rerank's output is a second
                     # device round-trip (adjudicated resolve-sync
                     # exception — see dingolint baseline).
-                    cand = np.asarray(jax.device_get(fetch)[1])[:b]
+                    cand = np.asarray(fetched[1])[:b]
                     d_r, s_r = _exact_rerank_host(
                         store, qpad[:b], cand, int(topk), self.metric
                     )
                     dists_h, slots_h = jax.device_get((d_r, s_r))
                 else:
-                    dists_h, slots_h = jax.device_get(fetch)
+                    dists_h, slots_h = fetched[0], fetched[1]
                 # shape bucketing may have run a larger k; slice back
                 ids = store.ids_of_slots(slots_h[:b, : int(topk)])
                 # head-sampled shadow scoring (async lane; noop at rate 0)
@@ -740,6 +752,27 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
                 lease.release()
 
         return resolve
+
+    def _heat_layout(self) -> Optional[dict]:
+        """Heat-plane layout provider: rows per coarse bucket from the
+        host assignment array. A resident PQ row costs its codes (m
+        bytes) plus the store rows kept for rerank (heat worker
+        thread)."""
+        assign = self._assign_h
+        if assign is None:
+            return None
+        from dingo_tpu.obs.heat import TIER_BYTES
+
+        rows = np.bincount(assign[assign >= 0].astype(np.int64),
+                           minlength=self.nlist)
+        tier = self._precision
+        return {
+            "unit_rows": rows,
+            "row_bytes": self.m + self.dimension * TIER_BYTES.get(
+                tier, 4.0),
+            "tier": tier,
+            "dim": self.dimension,
+        }
 
     # -- lifecycle -----------------------------------------------------------
     def save(self, path: str) -> None:

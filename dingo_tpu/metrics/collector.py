@@ -209,6 +209,21 @@ class StoreMetricsCollector:
         rm.cache_hits = int(cs["hits"])
         rm.cache_misses = int(cs["misses"])
         rm.cache_entries = int(cs["entries"])
+        # workload-heat rollup (obs/heat.py): traffic concentration and
+        # the working-set curve at the region's own tier — the capacity
+        # plane's demand signal (touches == 0 => no evidence)
+        from dingo_tpu.obs.cost import COST
+        from dingo_tpu.obs.heat import HEAT
+
+        hs = HEAT.region_stats(region.id)
+        if hs is not None:
+            rm.heat_hot_fraction = float(hs["hot_fraction"])
+            rm.heat_gini = float(hs["gini"])
+            rm.heat_working_set_p50 = int(hs["ws_bytes"][50])
+            rm.heat_working_set_p90 = int(hs["ws_bytes"][90])
+            rm.heat_working_set_p99 = int(hs["ws_bytes"][99])
+            rm.heat_touches = int(hs["touches"])
+        rm.cost_row_us = float(COST.region_row_us(region.id))
         last = INTEGRITY.last_verified_ms(region.id)
         self.registry.gauge(
             "consistency.digest_age_s", region.id
@@ -252,6 +267,11 @@ class StoreMetricsCollector:
             INTEGRITY.forget_region(rid)
             CACHE.forget_region(rid)
             CODECS.forget_region(rid)
+            from dingo_tpu.obs.cost import COST
+            from dingo_tpu.obs.heat import HEAT
+
+            HEAT.forget_region(rid)
+            COST.forget_region(rid)
         self._published_regions = current
         g = self.registry.gauge
         g("store.device.bytes_in_use").set(snap.device_bytes_in_use)

@@ -74,6 +74,22 @@ class RegionMetricsSnapshot:
     cache_hits: int = 0
     cache_misses: int = 0
     cache_entries: int = 0
+    #: workload-heat plane rollup (obs/heat.py): traffic concentration
+    #: (hot_fraction = mass on the hottest 10% of heat units, gini over
+    #: unit masses), working-set bytes to serve {50,90,99}% of traffic
+    #: at the region's OWN precision tier, and cumulative sketch
+    #: touches. touches == 0 means the other fields are meaningless
+    #: (plane off or no traffic) — renderers show '-'. The coordinator's
+    #: capacity plane rolls these against the store's HBM ledger
+    heat_hot_fraction: float = 0.0
+    heat_gini: float = 0.0
+    heat_working_set_p50: int = 0
+    heat_working_set_p90: int = 0
+    heat_working_set_p99: int = 0
+    heat_touches: int = 0
+    #: per-shape cost model (obs/cost.py): the region's EWMA per-row
+    #: dispatch cost in µs (0.0 = unmeasured)
+    cost_row_us: float = 0.0
 
 
 @persist.register

@@ -25,9 +25,18 @@ observability"):
   ``qos.*`` metrics family (queue depth/wait watermarks, per-stage
   budget fractions, goodput vs throughput, shed/expired counters), and
   the graduated shed controller extending the tuner's knob ladder.
+- ``heat`` — workload-heat plane: per-region exponential-decay access
+  sketches (IVF buckets / slot blocks) fed with zero new device syncs,
+  plus the {50,90,99}% working-set estimator per precision tier
+  (``heat.*`` family) — the sensor layer for memory tiering and split.
+- ``cost`` — per-(kernel, pad-ladder-point) dispatch cost model learned
+  from completion-lane timings (``cost.*`` family); prices QoS wait
+  estimates and the SLO tuner's latency budget per shape.
 """
 
+from dingo_tpu.obs.cost import COST, CostModel  # noqa: F401
 from dingo_tpu.obs.flight import FLIGHT, FlightRecorder  # noqa: F401
+from dingo_tpu.obs.heat import HEAT, HeatPlane  # noqa: F401
 from dingo_tpu.obs.hbm import HBM, HbmLedger, looks_like_oom  # noqa: F401
 from dingo_tpu.obs.integrity import (  # noqa: F401
     INTEGRITY,
@@ -55,11 +64,15 @@ from dingo_tpu.obs.tuner import QualityTunerRunner, SloTuner  # noqa: F401
 
 __all__ = [
     "Budget",
+    "COST",
+    "CostModel",
     "DeadlineExceeded",
     "FLIGHT",
     "FlightRecorder",
     "HBM",
+    "HEAT",
     "HbmLedger",
+    "HeatPlane",
     "INTEGRITY",
     "IntegrityPlane",
     "IntegrityScrubRunner",

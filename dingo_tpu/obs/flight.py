@@ -131,9 +131,15 @@ class FlightRecorder:
         knob positions — was the store trading recall when the incident
         hit?), qos.* (queue depth/wait, shed/expired counters, degrade
         level — was the store under pressure, and what had admission
-        already given up on?), and cache.* (hit/miss/dedupe/stale/
+        already given up on?), cache.* (hit/miss/dedupe/stale/
         semantic counters, resident bytes — was the serving-edge cache
-        absorbing the skewed traffic or churning?)."""
+        absorbing the skewed traffic or churning?), heat.* (traffic
+        concentration + working-set bytes — was the incident load skewed
+        onto a hot core, and how much of the region did it actually
+        touch?), cost.* (learned per-kernel dispatch costs — what did
+        the coalescer believe a row cost when it made its admission
+        calls?), and capacity.* (coordinator headroom/advisory rollups
+        when the bundle fires coordinator-side)."""
         return {k: v for k, v in now_flat.items() if k.startswith(prefix)}
 
     @staticmethod
@@ -295,6 +301,9 @@ class FlightRecorder:
             "qos": self._family_state(now_flat, "qos."),
             "consistency": self._family_state(now_flat, "consistency."),
             "cache": self._family_state(now_flat, "cache."),
+            "heat": self._family_state(now_flat, "heat."),
+            "cost": self._family_state(now_flat, "cost."),
+            "capacity": self._family_state(now_flat, "capacity."),
             "integrity": self._integrity_state(),
             "config": config,
         }

@@ -322,6 +322,18 @@ class QualityTunerRunner:
             est = QUALITY.region_estimate(region.id)
             st = METRICS.latency("vector_search", region.id).stats()
             p99_ms = st["p99_us"] / 1000.0 if st["count"] else None
+            # the per-shape cost model is a latency FLOOR: a region
+            # whose typical dispatch alone cannot fit the budget is
+            # over-budget evidence even before (or between) measured
+            # p99 samples — the tuner must not walk recall knobs UP
+            # into a latency wall the cost surface already predicts
+            from dingo_tpu.obs.cost import COST, cost_enabled
+
+            if cost_enabled():
+                typical = COST.region_typical_ms(region.id)
+                if typical is not None:
+                    p99_ms = typical if p99_ms is None \
+                        else max(p99_ms, typical)
             if self.tuner.step_index(index, est, p99_ms=p99_ms) is not None:
                 steps += 1
         return steps

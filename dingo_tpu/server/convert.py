@@ -265,6 +265,13 @@ _REGION_METRIC_FIELDS = (
     "device_degraded",
     # serving-edge cache (dingo_tpu/cache/): hit/miss rollup + entries
     "cache_hits", "cache_misses", "cache_entries",
+    # workload-heat plane (obs/heat.py): traffic concentration + the
+    # {50,90,99}% working-set bytes at the region's own tier; touches
+    # == 0 means no evidence. Feeds the coordinator's capacity rollups
+    "heat_hot_fraction", "heat_gini", "heat_working_set_p50",
+    "heat_working_set_p90", "heat_working_set_p99", "heat_touches",
+    # per-shape cost model (obs/cost.py): EWMA per-row dispatch cost µs
+    "cost_row_us",
 )
 
 _STORE_METRIC_FIELDS = (

@@ -1,0 +1,541 @@
+"""Workload-heat & capacity plane (ISSUE 17): access-heat sketches,
+working-set estimation, the per-shape kernel cost model, and the
+coordinator's advisory-only capacity rollups.
+
+Acceptance: the decay sketch loses mass at the configured e-folding
+rate and stays bounded at heat.max_entries; skewed vs uniform traffic
+separates cleanly in hot_fraction/gini; the working-set estimator
+matches an exact replay of the access stream; the per-shape cost model
+beats the scalar-EWMA wait estimate by >70% under mixed kernel shapes;
+observing a live IVF region adds zero steady-state recompiles and is
+inert with the flag off; the heat_* rollups round-trip through the
+heartbeat pb; plan_store fires demote/split advisories exactly at
+their thresholds; and `cluster capacity` / `cluster top` render the
+evidence (with '-' when there is none).
+"""
+
+import math
+import time
+
+import numpy as np
+import pytest
+
+from dingo_tpu.common.config import FLAGS
+from dingo_tpu.common.metrics import METRICS, MetricsRegistry
+from dingo_tpu.obs.heat import (
+    HEAT,
+    SLOT_BLOCK,
+    TIER_BYTES,
+    HeatPlane,
+    _RegionHeat,
+    gini,
+    hot_fraction,
+    working_set_rows,
+)
+from dingo_tpu.obs.cost import COST, CostModel, kernel_id, kernel_region
+
+
+@pytest.fixture()
+def heat_env():
+    """Clean heat/cost state + restored flags."""
+    saved = {k: FLAGS.get(k) for k in (
+        "heat_enabled", "heat_decay_s", "heat_max_entries",
+        "cost_enabled", "cost_prior_row_ms",
+        "capacity_advise", "capacity_headroom_target",
+    )}
+    HEAT.reset()
+    COST.reset()
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            FLAGS.set(k, v)
+        HEAT.reset()
+        COST.reset()
+
+
+# ---------------------------------------------------------------------------
+# sketch math
+# ---------------------------------------------------------------------------
+
+def test_sketch_decays_at_the_configured_rate():
+    """A unit untouched for n decay constants keeps e^-n of its mass
+    relative to a fresh touch (the time-warp basis must be invisible)."""
+    tau = 10.0
+    rh = _RegionHeat(0.0)
+    rh.fold("ivf", np.array([1]), 1.0, 0.0, tau, 4096)
+    rh.fold("ivf", np.array([2]), 1.0, 3.0 * tau, tau, 4096)
+    scale = math.exp((rh.t0 - 3.0 * tau) / tau)   # warp -> true mass
+    m1 = rh.mass[("ivf", 1)] * scale
+    m2 = rh.mass[("ivf", 2)] * scale
+    assert m2 == pytest.approx(1.0)
+    assert m1 / m2 == pytest.approx(math.exp(-3.0), rel=1e-6)
+
+
+def test_sketch_rebases_without_changing_relative_mass():
+    """Past _REBASE_WARP the warped floats are renormalized; the
+    relative masses (all any consumer reads) must not move."""
+    tau = 1.0
+    rh = _RegionHeat(0.0)
+    rh.fold("ivf", np.array([1, 1, 1]), 1.0, 0.0, tau, 4096)
+    rh.fold("ivf", np.array([2]), 1.0, 5.0, tau, 4096)
+    before = rh.mass[("ivf", 1)] / rh.mass[("ivf", 2)]
+    rh.fold("ivf", np.array([3]), 1.0, 40.0, tau, 4096)  # forces rebase
+    assert rh.t0 == 40.0
+    after = rh.mass[("ivf", 1)] / rh.mass[("ivf", 2)]
+    assert after == pytest.approx(before, rel=1e-9)
+    for v in rh.mass.values():
+        assert np.isfinite(v)
+
+
+def test_sketch_memory_is_bounded_and_keeps_the_hottest():
+    """Folding more distinct units than the cap evicts the coldest;
+    a repeatedly-touched unit must survive."""
+    rh = _RegionHeat(0.0)
+    cap = 64
+    rh.fold("slot", np.full(50, 7), 1.0, 0.0, 10.0, cap)   # hot unit 7
+    for start in range(0, 500, 100):
+        rh.fold("slot", np.arange(start + 100, start + 200), 1.0,
+                0.0, 10.0, cap)
+    assert len(rh.mass) <= cap
+    assert ("slot", 7) in rh.mass
+
+
+def test_hot_fraction_separates_skewed_from_uniform():
+    uniform = np.ones(100)
+    zipf = 1.0 / np.arange(1, 101) ** 1.5
+    assert hot_fraction(uniform) == pytest.approx(0.1)
+    assert hot_fraction(zipf) > 0.7
+    assert gini(uniform) == pytest.approx(0.0, abs=1e-9)
+    assert gini(zipf) > 0.6
+    assert gini(np.array([])) == 0.0 and hot_fraction(np.array([])) == 0.0
+
+
+def test_working_set_matches_exact_replay():
+    """The estimator's rows-to-serve-p% must equal an exact replay of
+    the access stream (same counts, no decay -> identical math)."""
+    rng = np.random.default_rng(5)
+    units = rng.zipf(1.3, 20_000) % 200           # skewed unit stream
+    counts = np.bincount(units, minlength=200).astype(np.float64)
+    rows = np.full(200, 32.0)
+    est = working_set_rows(counts, rows, (50, 90, 99))
+    # exact replay: hottest-first cumulative coverage of the raw stream
+    order = np.argsort(counts)[::-1]
+    cum = np.cumsum(counts[order]) / counts.sum()
+    for p in (50, 90, 99):
+        exact_units = int(np.searchsorted(cum, p / 100.0)) + 1
+        assert est[p] == exact_units * 32
+
+
+# ---------------------------------------------------------------------------
+# the async plane
+# ---------------------------------------------------------------------------
+
+def test_plane_folds_off_thread_and_derives_stats(heat_env):
+    FLAGS.set("heat_enabled", True)
+    plane = HeatPlane(MetricsRegistry())
+    rng = np.random.default_rng(11)
+    # region 1: skewed; region 2: uniform over the same unit count
+    for _ in range(20):
+        plane.observe(1, "ivf", rng.zipf(1.5, 256) % 64)
+        plane.observe(2, "ivf", rng.integers(0, 64, 256))
+    assert plane.flush(timeout=30.0)
+    s1, s2 = plane.region_stats(1), plane.region_stats(2)
+    assert s1 is not None and s2 is not None
+    assert s1["touches"] == s2["touches"] == 20 * 256
+    assert s1["hot_fraction"] > s2["hot_fraction"] + 0.2
+    assert s1["gini"] > s2["gini"] + 0.2
+    plane.forget_region(1)
+    assert plane.region_stats(1) is None
+
+
+def test_slot_kind_maps_to_blocks_and_filters_padding(heat_env):
+    """FLAT/HNSW feed raw result slots: -1 padding must be dropped and
+    slots collapse to SLOT_BLOCK-sized units on the worker."""
+    FLAGS.set("heat_enabled", True)
+    plane = HeatPlane(MetricsRegistry())
+    slots = np.array([0, 5, SLOT_BLOCK + 1, -1, -1, 3 * SLOT_BLOCK])
+    plane.observe(9, "slot", slots)
+    assert plane.flush()
+    masses = plane.unit_masses(9, "slot")
+    assert set(masses) == {("slot", 0), ("slot", 1), ("slot", 3)}
+    st = plane.region_stats(9)
+    assert st["touches"] == 4                      # -1s never counted
+
+
+def test_working_set_prices_the_layout_tier(heat_env):
+    FLAGS.set("heat_enabled", True)
+    plane = HeatPlane(MetricsRegistry())
+    rows = np.full(8, 100.0)
+
+    def layout():
+        return {"unit_rows": rows, "row_bytes": 64 * TIER_BYTES["sq8"],
+                "tier": "sq8", "dim": 64}
+
+    plane.register_layout(3, "ivf", layout)
+    plane.observe(3, "ivf", np.repeat(np.arange(8), [80, 5, 5, 2, 2, 2,
+                                                     2, 2]))
+    assert plane.flush()
+    st = plane.region_stats(3)
+    assert st["tier"] == "sq8"
+    # p50 of the traffic sits on one 100-row unit at 64 B/row
+    assert st["ws_bytes"][50] == 100 * 64
+    # the fp32 what-if prices the same rows at 4 bytes/coordinate
+    assert st["ws_bytes_tier"]["fp32"][50] == 100 * 64 * 4
+
+
+def test_flag_off_is_inert(heat_env):
+    """heat_enabled off: call sites never reach observe(); even direct
+    enqueue on a fresh plane is the only state — the global HEAT stays
+    empty after an index search (wired-path check in the e2e test)."""
+    FLAGS.set("heat_enabled", False)
+    from dingo_tpu.obs.heat import heat_enabled
+
+    assert not heat_enabled()
+    assert HEAT.unit_masses(123) == {}
+    assert HEAT.region_stats(123) is None
+
+
+def test_overflow_drops_and_counts(heat_env):
+    reg = MetricsRegistry()
+    plane = HeatPlane(reg)
+    # stall the worker by not starting it: enqueue past QUEUE_MAX
+    from dingo_tpu.obs import heat as heat_mod
+
+    with plane._cond:                  # hold the lock so nothing drains
+        pass
+    for _ in range(heat_mod.QUEUE_MAX + 10):
+        with plane._cond:
+            if len(plane._queue) >= heat_mod.QUEUE_MAX:
+                break
+            plane._queue.append((1, "ivf", np.array([1]), 1.0,
+                                 time.time()))
+    plane.observe(1, "ivf", np.array([2]))        # queue is full -> drop
+    assert reg.counter("heat.dropped", region_id=1).get() >= 1
+
+
+# ---------------------------------------------------------------------------
+# cost model
+# ---------------------------------------------------------------------------
+
+def test_kernel_id_shapes():
+    key = (7, 10, (("nprobe", 8),))
+    kid = kernel_id(key)
+    assert kid.startswith("r7:k10:") and len(kid) == len("r7:k10:") + 8
+    assert kernel_id((7, 10)) == "r7:k10"
+    assert kernel_region(key) == 7
+    assert kernel_region("opaque") is None
+
+
+def test_cost_model_beats_scalar_ewma_under_mixed_shapes(heat_env):
+    """Two kernel families with 50x different per-row costs, mixed
+    batch shapes: the per-(kernel, ladder-point) model's wait estimates
+    must cut the scalar-EWMA baseline's error by >70% (<30% of it)."""
+    FLAGS.set("cost_enabled", True)
+    model = CostModel(MetricsRegistry())
+    alpha = 0.3
+    scalar_row = 0.0
+    seen = 0
+
+    def true_ms(kind, rows):
+        pad = 1
+        while pad < rows:
+            pad *= 2
+        return (0.05 + 0.01 * pad) if kind == "cheap" else (2.0 + 0.5 * pad)
+
+    rng = np.random.default_rng(3)
+    for _ in range(200):
+        kind = "cheap" if rng.random() < 0.5 else "wide"
+        rows = int(rng.choice([4, 8, 32, 64]))
+        ms = true_ms(kind, rows)
+        model.note(kind, rows, ms)
+        # the old coalescer discipline: ONE per-row EWMA over everything
+        per_row = ms / rows
+        scalar_row = per_row if seen == 0 else (
+            (1.0 - alpha) * scalar_row + alpha * per_row)
+        seen += 1
+    probes = [("cheap", 4), ("cheap", 64), ("wide", 8), ("wide", 64)]
+    model_err = sum(abs(model.estimate_run_ms(k, r) - true_ms(k, r))
+                    for k, r in probes)
+    scalar_err = sum(abs(scalar_row * r - true_ms(k, r))
+                     for k, r in probes)
+    assert model_err < 0.3 * scalar_err, (model_err, scalar_err)
+
+
+def test_cost_model_interpolates_and_clamps(heat_env):
+    FLAGS.set("cost_enabled", True)
+    model = CostModel(MetricsRegistry())
+    for _ in range(5):
+        model.note("k", 32, 3.2)                   # one measured point
+    assert model.estimate_run_ms("k", 32) == pytest.approx(3.2)
+    # larger than support: scaled up, never below the measured point
+    assert model.estimate_run_ms("k", 64) >= 3.2
+    # smaller than support: never above the measured larger dispatch
+    assert model.estimate_run_ms("k", 8) <= 3.2
+    # unmeasured kernel: the conservative prior
+    FLAGS.set("cost_prior_row_ms", 0.5)
+    assert model.estimate_run_ms("other", 10) == pytest.approx(5.0)
+
+
+def test_cost_forget_region_drops_prefixed_kernels(heat_env):
+    model = CostModel(MetricsRegistry())
+    model.note(kernel_id((7, 10)), 8, 1.0, region_id=7)
+    model.note(kernel_id((8, 10)), 8, 1.0, region_id=8)
+    assert model.region_row_us(7) > 0.0
+    model.forget_region(7)
+    assert model.region_row_us(7) == 0.0
+    assert not model.has_model("r7:k10")
+    assert model.has_model("r8:k10")
+
+
+def test_coalescer_cold_start_sheds_on_the_prior(heat_env):
+    """Satellite fix: before ANY sample lands, estimated_wait_ms must
+    answer the conservative prior, not 0 — and the legacy 0.0 only
+    survives with the cost model explicitly off."""
+    from dingo_tpu.common.coalescer import SearchCoalescer
+
+    co = SearchCoalescer(lambda key, q: [[] for _ in q], window_ms=1.0)
+    try:
+        FLAGS.set("cost_enabled", True)
+        FLAGS.set("cost_prior_row_ms", 0.5)
+        assert co.estimated_wait_ms(8) == pytest.approx(8 * 0.5)
+        FLAGS.set("cost_enabled", False)
+        assert co.estimated_wait_ms(8) == 0.0     # old behavior, opt-out
+    finally:
+        co.stop()
+
+
+def test_coalescer_feeds_the_cost_model(heat_env):
+    """A dispatched batch's completion must land in COST under the
+    kernel id derived from the coalescer key, and estimated_wait_ms
+    must then answer from the model for that key."""
+    from dingo_tpu.common.coalescer import SearchCoalescer
+
+    FLAGS.set("cost_enabled", True)
+    key = (41, 10, (("nprobe", 4),))
+    co = SearchCoalescer(
+        lambda k, q: (time.sleep(0.01), [[] for _ in q])[1],
+        window_ms=1.0)
+    try:
+        co.submit(key, np.zeros((4, 8), np.float32)).result(timeout=30)
+        kid = kernel_id(key)
+        deadline = time.monotonic() + 10.0
+        while not COST.has_model(kid) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert COST.has_model(kid)
+        assert COST.estimate_run_ms(kid, 4) >= 5.0   # the 10ms sleep
+        assert COST.region_row_us(41) > 0.0
+    finally:
+        co.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through a live index
+# ---------------------------------------------------------------------------
+
+def test_ivf_heat_end_to_end_zero_recompiles(heat_env):
+    """Heat on a live IVF region: probed buckets land in the sketch
+    with NO extra kernel shapes (zero steady-state recompiles across
+    heat off -> on) and the flag-off arm leaves the plane untouched."""
+    from dingo_tpu.index import IndexParameter, IndexType, new_index
+
+    n, d, nlist, nprobe, k = 2000, 32, 8, 4, 5
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    idx = new_index(71, IndexParameter(
+        index_type=IndexType.IVF_FLAT, dimension=d, ncentroids=nlist,
+        default_nprobe=nprobe,
+    ))
+    idx.store.reserve(n)
+    idx.upsert(np.arange(n, dtype=np.int64), x)
+    idx.train()
+    q = x[:16] + 0.01
+    FLAGS.set("heat_enabled", False)
+    idx.search(q, k, nprobe=nprobe)               # warm the shape
+    assert HEAT.region_stats(71) is None          # off = inert
+    recomp = METRICS.counter("xla.recompiles")
+    before = recomp.get()
+    FLAGS.set("heat_enabled", True)
+    for _ in range(5):
+        idx.search(q, k, nprobe=nprobe)
+    assert HEAT.flush()
+    assert recomp.get() == before                 # same programs only
+    st = HEAT.region_stats(71)
+    assert st is not None and st["touches"] >= 5 * 16 * nprobe
+    masses = HEAT.unit_masses(71, "ivf")
+    assert masses and all(0 <= u < nlist for (_, u) in masses)
+    assert st["ws_bytes"][99] > 0                 # layout provider wired
+
+
+# ---------------------------------------------------------------------------
+# heartbeats, capacity plane, CLI
+# ---------------------------------------------------------------------------
+
+def test_heat_rollups_round_trip_heartbeat_pb():
+    from dingo_tpu.metrics.snapshot import RegionMetricsSnapshot
+    from dingo_tpu.server import convert
+    from dingo_tpu.server import dingo_pb2 as pb
+
+    rm = RegionMetricsSnapshot(region_id=5)
+    rm.heat_hot_fraction = 0.875
+    rm.heat_gini = 0.62
+    rm.heat_working_set_p50 = 1 << 20
+    rm.heat_working_set_p90 = 5 << 20
+    rm.heat_working_set_p99 = 9 << 20
+    rm.heat_touches = 12345
+    rm.cost_row_us = 17.25
+    wire = convert.region_metrics_to_pb(rm).SerializeToString()
+    parsed = pb.RegionMetrics()
+    parsed.ParseFromString(wire)
+    back = convert.region_metrics_from_pb(parsed)
+    assert back.heat_hot_fraction == pytest.approx(0.875)
+    assert back.heat_gini == pytest.approx(0.62)
+    assert (back.heat_working_set_p50, back.heat_working_set_p90,
+            back.heat_working_set_p99) == (1 << 20, 5 << 20, 9 << 20)
+    assert back.heat_touches == 12345
+    assert back.cost_row_us == pytest.approx(17.25)
+
+
+def _region(rid, resident, ws99, touches, hot):
+    from dingo_tpu.metrics.snapshot import RegionMetricsSnapshot
+
+    rm = RegionMetricsSnapshot(region_id=rid)
+    rm.device_memory_bytes = resident
+    rm.heat_working_set_p99 = ws99
+    rm.heat_touches = touches
+    rm.heat_hot_fraction = hot
+    return rm
+
+
+def _store(store_id, limit, in_use, regions):
+    from dingo_tpu.metrics.snapshot import StoreMetricsSnapshot
+
+    snap = StoreMetricsSnapshot(store_id=store_id)
+    snap.device_bytes_limit = limit
+    snap.device_bytes_in_use = in_use
+    snap.regions = regions
+    return snap
+
+
+def test_plan_store_demote_threshold():
+    from dingo_tpu.coordinator import capacity as cap
+
+    cold = _region(1, 100 << 20, 10 << 20, 5000, 0.2)
+    # under the headroom target with a touch-qualified cold region
+    plan = cap.plan_store(
+        _store("s1", 256 << 20, 246 << 20, [cold]), target=0.2)
+    kinds = [a.kind for a in plan["advice"]]
+    assert kinds == ["demote"]
+    a = plan["advice"][0]
+    assert a.region_id == 1 and a.bytes_at_stake == 90 << 20
+    # comfortable headroom: no demote
+    plan = cap.plan_store(
+        _store("s1", 256 << 20, 100 << 20, [cold]), target=0.2)
+    assert plan["advice"] == []
+    # under target but the sketch has no evidence: no demote
+    fresh = _region(1, 100 << 20, 10 << 20, cap.MIN_TOUCHES - 1, 0.2)
+    plan = cap.plan_store(
+        _store("s1", 256 << 20, 246 << 20, [fresh]), target=0.2)
+    assert plan["advice"] == []
+
+
+def test_plan_store_split_threshold():
+    from dingo_tpu.coordinator import capacity as cap
+
+    hot = _region(1, 10 << 20, 8 << 20, 9000, 0.7)
+    warm = _region(2, 10 << 20, 8 << 20, 1000, 0.7)
+    plan = cap.plan_store(
+        _store("s1", 256 << 20, 20 << 20, [hot, warm]), target=0.2)
+    assert [a.kind for a in plan["advice"]] == ["split"]
+    assert plan["advice"][0].region_id == 1
+    # below the hot-core bar: concentration alone is not enough
+    mild = _region(1, 10 << 20, 8 << 20, 9000,
+                   cap.SPLIT_HOT_FRACTION - 0.01)
+    plan = cap.plan_store(
+        _store("s1", 256 << 20, 20 << 20, [mild, warm]), target=0.2)
+    assert plan["advice"] == []
+    # below the traffic-share bar: hot but not dominant
+    a = _region(1, 10 << 20, 8 << 20, 4000, 0.9)
+    b = _region(2, 10 << 20, 8 << 20, 6000, 0.2)
+    plan = cap.plan_store(
+        _store("s1", 256 << 20, 20 << 20, [a, b]), target=0.2)
+    assert plan["advice"] == []
+
+
+def test_coordinator_capacity_hook_and_advisory_dedupe(heat_env):
+    from dingo_tpu.coordinator.control import CoordinatorControl
+    from dingo_tpu.engine.raw_engine import MemEngine
+
+    FLAGS.set("capacity_advise", True)
+    FLAGS.set("capacity_headroom_target", 0.2)
+    coord = CoordinatorControl(MemEngine(), replication=1)
+    coord.register_store("s1")
+    snap = _store("s1", 256 << 20, 250 << 20,
+                  [_region(3, 200 << 20, 4 << 20, 8000, 0.9)])
+    c = METRICS.counter("capacity.advisories", region_id=3,
+                        labels={"kind": "demote"})
+    before = c.get()
+    coord.store_heartbeat("s1", region_ids=[3], metrics=snap)
+    coord.store_heartbeat("s1", region_ids=[3], metrics=snap)
+    plans = coord.capacity_report()
+    assert len(plans) == 1 and plans[0]["store_id"] == "s1"
+    assert {a.kind for a in plans[0]["advice"]} == {"demote", "split"}
+    # repeated beats with the same advice tick the counter ONCE
+    assert c.get() == before + 1
+    # advisory plane off: plans retract, nothing breaks
+    FLAGS.set("capacity_advise", False)
+    coord.store_heartbeat("s1", region_ids=[3], metrics=snap)
+    assert coord.capacity_report() == []
+
+
+def test_cluster_capacity_render():
+    from dingo_tpu.client.cli import format_cluster_capacity
+    from dingo_tpu.server import dingo_pb2 as pb
+
+    resp = pb.GetStoreMetricsResponse()
+    e = resp.stores.add()
+    e.store_id = "s1"
+    e.metrics.store_id = "s1"
+    e.metrics.device_bytes_limit = 256 << 20
+    e.metrics.device_bytes_in_use = 250 << 20
+    r = e.metrics.regions.add()
+    r.region_id = 3
+    r.device_memory_bytes = 200 << 20
+    r.heat_working_set_p99 = 4 << 20
+    r.heat_touches = 8000
+    r.heat_hot_fraction = 0.9
+    out = format_cluster_capacity(resp)
+    assert "HEADROOM" in out and "DEMAND-P99" in out
+    assert "demote" in out and "split" in out
+    assert "s1" in out and "4.0MB" in out
+    # a store with no heat evidence renders '-' demand, no advisories
+    resp2 = pb.GetStoreMetricsResponse()
+    e2 = resp2.stores.add()
+    e2.store_id = "s2"
+    e2.metrics.store_id = "s2"
+    e2.metrics.device_bytes_limit = 256 << 20
+    e2.metrics.device_bytes_in_use = 10 << 20
+    out2 = format_cluster_capacity(resp2)
+    assert "no capacity advisories" in out2
+
+
+def test_cluster_top_heat_columns():
+    from dingo_tpu.client.cli import format_cluster_top
+    from dingo_tpu.server import dingo_pb2 as pb
+
+    resp = pb.GetStoreMetricsResponse()
+    e = resp.stores.add()
+    e.store_id = "s1"
+    r = e.metrics.regions.add()
+    r.region_id = 4
+    r.heat_hot_fraction = 0.91
+    r.heat_working_set_p99 = 10 << 20
+    r.heat_touches = 500
+    cold = e.metrics.regions.add()
+    cold.region_id = 5                    # no sketch evidence
+    out = format_cluster_top(resp)
+    assert "HEAT" in out and "WSET" in out
+    assert "0.91" in out and "10.0MB" in out
+    row5 = next(ln for ln in out.splitlines()
+                if ln.startswith("5 "))
+    assert "-" in row5                    # no evidence renders '-'

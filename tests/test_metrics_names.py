@@ -118,6 +118,53 @@ def test_checker_curates_consistency_family(tmp_path):
     assert "consistency" in problems[0][1]
 
 
+def test_checker_curates_heat_family(tmp_path):
+    """The workload-heat plane's heat.* series are curated: declared
+    names pass, additions must be explicit in FAMILY_NAMES."""
+    f = tmp_path / "heat.py"
+    f.write_text(
+        "from dingo_tpu.common.metrics import METRICS\n"
+        "METRICS.counter('heat.touches').add(64)\n"            # declared
+        "METRICS.gauge('heat.hot_fraction').set(0.8)\n"        # declared
+        "METRICS.gauge('heat.working_set_bytes').set(4096)\n"  # declared
+        "METRICS.counter('heat.dropped').add(1)\n"             # declared
+        "METRICS.gauge('heat.mystery_series').set(1)\n"        # undeclared
+    )
+    problems = checker.check_file(str(f))
+    assert [p[0] for p in problems] == [6], problems
+    assert "heat" in problems[0][1]
+
+
+def test_checker_curates_cost_family(tmp_path):
+    """The kernel cost model's cost.* series are curated."""
+    f = tmp_path / "cost.py"
+    f.write_text(
+        "from dingo_tpu.common.metrics import METRICS\n"
+        "METRICS.gauge('cost.run_ms').set(1.5)\n"              # declared
+        "METRICS.gauge('cost.row_us').set(12.0)\n"             # declared
+        "METRICS.counter('cost.samples').add(1)\n"             # declared
+        "METRICS.counter('cost.overruns').add(1)\n"            # undeclared
+    )
+    problems = checker.check_file(str(f))
+    assert [p[0] for p in problems] == [5], problems
+    assert "cost" in problems[0][1]
+
+
+def test_checker_curates_capacity_family(tmp_path):
+    """The coordinator capacity plane's capacity.* series are curated."""
+    f = tmp_path / "capacity.py"
+    f.write_text(
+        "from dingo_tpu.common.metrics import METRICS\n"
+        "METRICS.gauge('capacity.headroom_bytes').set(1024)\n"   # declared
+        "METRICS.gauge('capacity.demand_p99_bytes').set(512)\n"  # declared
+        "METRICS.counter('capacity.advisories').add(1)\n"        # declared
+        "METRICS.counter('capacity.evictions').add(1)\n"         # undeclared
+    )
+    problems = checker.check_file(str(f))
+    assert [p[0] for p in problems] == [5], problems
+    assert "capacity" in problems[0][1]
+
+
 def test_registry_name_rule_matches_lint():
     from dingo_tpu.common.metrics import valid_metric_name
 

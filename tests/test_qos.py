@@ -249,8 +249,14 @@ def test_pipelined_dispatch_stage_accounted(qos_flags):
 
 def test_admission_shed_hopeless_and_priority_pressure(qos_flags):
     co = SearchCoalescer(lambda k, q: list(range(len(q))), window_ms=5.0)
+    saved_cost = FLAGS.get("cost_enabled")
     try:
-        # fabricate a measured service rate: ~100ms estimated wait/run
+        # fabricate a measured service rate: ~100ms estimated wait/run.
+        # The per-shape cost model (obs/cost.py) would override these
+        # fabricated scalars with REAL measurements of the toy run_fn
+        # (microseconds), so pin the legacy scalar-EWMA estimator —
+        # priority-tier shed semantics are what's under test here
+        FLAGS.set("cost_enabled", False)
         co._ewma_row_ms = 50.0
         co._ewma_run_ms = 50.0
         FLAGS.set("qos_max_queue_ms", 80.0)
@@ -299,6 +305,7 @@ def test_admission_shed_hopeless_and_priority_pressure(qos_flags):
             detach_budget(token)
         assert len(fut.result(timeout=5)) == 1
     finally:
+        FLAGS.set("cost_enabled", saved_cost)
         co.stop()
 
 

@@ -108,6 +108,11 @@ def classify(path: str, summary: Optional[dict] = None) -> Optional[str]:
         # wall-clock on a shared CI host, so the gate is coarse — only a
         # large relative blow-up signals a real recovery-path regression
         return "recovery"
+    if "working_set" in low:
+        # heat_skew's working-set estimate measures the PLANTED traffic
+        # pattern (bytes the skewed stream needed resident), not code
+        # quality — the bytes-suffix rule below would false-flag it
+        return None
     if "hbm" in low or low.endswith("bytes") or low.endswith(
             "bytes_per_vector"):
         return "bytes"

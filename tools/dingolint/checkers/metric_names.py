@@ -194,6 +194,35 @@ FAMILY_NAMES = {
         "cache.bytes",              # store-wide resident bytes (gauge)
         "cache.entries",            # live entries per region (gauge)
     },
+    "heat": {
+        # workload-heat plane (obs/heat.py): per-region exponential-
+        # decay access sketches fed from resolve-path host data
+        "heat.touches",             # folded unit touches (counter)
+        "heat.bucket_gini",         # traffic-mass Gini over heat units
+        "heat.hot_fraction",        # mass on the hottest 10% of units
+        "heat.entries",             # live sketch entries (bounded gauge)
+        "heat.working_set_bytes",   # bytes to serve {pct}% of traffic,
+                                    # by {pct, tier} (what-if tiers too)
+        "heat.dropped",             # async-lane overflow drops
+    },
+    "cost": {
+        # per-(kernel, padded-shape) dispatch cost model (obs/cost.py)
+        "cost.run_ms",              # EWMA run time per ladder point,
+                                    # by {kernel, rows}
+        "cost.row_us",              # EWMA per-row cost, by {kernel}
+        "cost.samples",             # completion-lane timings folded
+    },
+    "capacity": {
+        # coordinator capacity plane (coordinator/capacity.py +
+        # control._update_capacity) — advisory only, never actuates
+        "capacity.headroom_bytes",  # HBM limit - in-use, by {store}
+        "capacity.headroom_fraction",
+        "capacity.demand_p99_bytes",  # sum of regions' p99 working sets
+        "capacity.resident_bytes",  # sum of regions' device residency
+        "capacity.advice_count",    # live advisories per store (gauge)
+        "capacity.advisories",      # NEW advisories seen (counter, by
+                                    # region + {kind}: demote / split)
+    },
     "fault": {
         # fault-domain hardening (PR 14): injection planes, the client
         # resilience policy, and the device-failure recovery ladder

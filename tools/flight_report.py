@@ -321,6 +321,133 @@ def _cache_section(cache: Dict[str, Any]) -> List[str]:
     return out
 
 
+def _heat_section(heat: Dict[str, Any]) -> List[str]:
+    """Workload-heat state at capture time (absolute heat.* series): was
+    the incident traffic skewed onto a hot core (gini / hot_fraction),
+    and how many bytes did it actually need resident (working-set rows
+    per percentile, one row per tier the sketch priced)."""
+    per: Dict[str, Dict[str, float]] = {}
+    ws: Dict[str, Dict[str, Dict[str, float]]] = {}
+    for key, val in heat.items():
+        name, labels = _series_labels(key)
+        region = labels.get("region", "-")
+        if name == "heat.working_set_bytes":
+            ws.setdefault(region, {}).setdefault(
+                labels.get("tier", "?"), {}
+            )[labels.get("pct", "?")] = val
+        elif name.startswith("heat."):
+            field = name[len("heat."):]
+            agg = per.setdefault(region, {})
+            agg[field] = agg.get(field, 0.0) + val
+    out = [f"-- workload heat ({len(heat)} series)"]
+    rows = []
+    for region in sorted(set(per) | set(ws)):
+        st = per.get(region, {})
+        tiers = ws.get(region, {"-": {}})
+        for tier in sorted(tiers):
+            pcts = tiers[tier]
+            rows.append([
+                region, tier,
+                f"{st.get('touches', 0):.0f}",
+                f"{st.get('bucket_gini', 0):.3f}",
+                f"{st.get('hot_fraction', 0):.3f}",
+                f"{st.get('entries', 0):.0f}",
+                _fmt_bytes(pcts.get("50", 0)),
+                _fmt_bytes(pcts.get("90", 0)),
+                _fmt_bytes(pcts.get("99", 0)),
+                f"{st.get('dropped', 0):.0f}",
+            ])
+    if rows:
+        out.extend(_table(
+            ["REGION", "TIER", "TOUCHES", "GINI", "HOT10%", "ENTRIES",
+             "WS50", "WS90", "WS99", "DROPPED"], rows
+        ))
+    else:
+        out.append("  (no heat series)")
+    return out
+
+
+def _cost_section(cost: Dict[str, Any]) -> List[str]:
+    """Learned kernel dispatch costs at capture time (absolute cost.*
+    series): what the coalescer believed a row cost — per kernel, the
+    EWMA per-row cost plus the per-shape-ladder-point run times the
+    estimates interpolate between."""
+    row_us: Dict[str, float] = {}
+    points: Dict[str, List] = {}
+    samples = 0.0
+    for key, val in cost.items():
+        name, labels = _series_labels(key)
+        if name == "cost.row_us":
+            row_us[labels.get("kernel", "?")] = val
+        elif name == "cost.run_ms":
+            points.setdefault(labels.get("kernel", "?"), []).append(
+                (int(labels.get("rows", 0) or 0), val))
+        elif name == "cost.samples":
+            samples += val
+    out = [f"-- kernel cost model ({len(cost)} series, "
+           f"{samples:.0f} samples)"]
+    rows = []
+    for kernel in sorted(set(row_us) | set(points)):
+        pts = sorted(points.get(kernel, []))
+        ladder = " ".join(f"{r}:{ms:.2f}" for r, ms in pts[:6])
+        if len(pts) > 6:
+            ladder += f" (+{len(pts) - 6})"
+        rows.append([
+            kernel,
+            f"{row_us.get(kernel, 0.0):.1f}",
+            str(len(pts)),
+            ladder or "-",
+        ])
+    if rows:
+        out.extend(_table(
+            ["KERNEL", "ROW_US", "POINTS", "ROWS:MS"], rows))
+    else:
+        out.append("  (no cost series)")
+    return out
+
+
+def _capacity_section(capacity: Dict[str, Any]) -> List[str]:
+    """Coordinator capacity rollups at capture time (absolute
+    capacity.* series, present when the bundle fires coordinator-side):
+    HBM headroom vs measured working-set demand per store, plus the
+    advisory counters per region."""
+    per: Dict[str, Dict[str, float]] = {}
+    advised: Dict[str, Dict[str, float]] = {}
+    for key, val in capacity.items():
+        name, labels = _series_labels(key)
+        if name == "capacity.advisories":
+            advised.setdefault(labels.get("region", "-"), {})[
+                labels.get("kind", "?")] = val
+        elif name.startswith("capacity."):
+            per.setdefault(labels.get("store", "-"), {})[
+                name[len("capacity."):]] = val
+    out = [f"-- capacity plane ({len(capacity)} series)"]
+    rows = []
+    for store in sorted(per):
+        st = per[store]
+        rows.append([
+            store,
+            _fmt_bytes(st.get("headroom_bytes", 0)),
+            f"{st.get('headroom_fraction', 0):.0%}",
+            _fmt_bytes(st.get("demand_p99_bytes", 0)),
+            _fmt_bytes(st.get("resident_bytes", 0)),
+            f"{st.get('advice_count', 0):.0f}",
+        ])
+    if rows:
+        out.extend(_table(
+            ["STORE", "HEADROOM", "FREE%", "DEMAND-P99", "RESIDENT",
+             "ADVICE"], rows))
+    else:
+        out.append("  (no capacity series)")
+    arows = [[region, kind, f"{n:.0f}"]
+             for region in sorted(advised)
+             for kind, n in sorted(advised[region].items())]
+    if arows:
+        out.append("")
+        out.extend(_table(["REGION", "KIND", "ADVISORIES"], arows))
+    return out
+
+
 def _consistency_section(consistency: Dict[str, Any],
                          integrity: Dict[str, Any]) -> List[str]:
     """State-integrity view at capture time: the consistency.* counters
@@ -491,6 +618,21 @@ def render(bundle: Dict[str, Any]) -> str:
     if cache:
         out.append("")
         out.extend(_cache_section(cache))
+
+    heat = bundle.get("heat") or {}
+    if heat:
+        out.append("")
+        out.extend(_heat_section(heat))
+
+    cost = bundle.get("cost") or {}
+    if cost:
+        out.append("")
+        out.extend(_cost_section(cost))
+
+    capacity = bundle.get("capacity") or {}
+    if capacity:
+        out.append("")
+        out.extend(_capacity_section(capacity))
 
     consistency = bundle.get("consistency") or {}
     integrity = bundle.get("integrity") or {}

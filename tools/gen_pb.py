@@ -87,6 +87,20 @@ NEW_MESSAGES = {
         ("cache_hits", 29, T.TYPE_INT64, None, False),
         ("cache_misses", 30, T.TYPE_INT64, None, False),
         ("cache_entries", 31, T.TYPE_INT64, None, False),
+        # workload-heat plane (obs/heat.py): traffic concentration
+        # (hot_fraction / gini over heat units) and bytes to serve
+        # {50,90,99}% of traffic at the region's own precision tier;
+        # heat_touches = cumulative sketch touches (0 = no evidence).
+        # The coordinator's capacity plane rolls these against the HBM
+        # ledger for advisory tier/split recommendations
+        ("heat_hot_fraction", 32, T.TYPE_DOUBLE, None, False),
+        ("heat_gini", 33, T.TYPE_DOUBLE, None, False),
+        ("heat_working_set_p50", 34, T.TYPE_INT64, None, False),
+        ("heat_working_set_p90", 35, T.TYPE_INT64, None, False),
+        ("heat_working_set_p99", 36, T.TYPE_INT64, None, False),
+        ("heat_touches", 37, T.TYPE_INT64, None, False),
+        # per-shape cost model (obs/cost.py): EWMA per-row dispatch µs
+        ("cost_row_us", 38, T.TYPE_DOUBLE, None, False),
     ],
     # whole-store snapshot (process device gauges + per-region list)
     "StoreMetrics": [

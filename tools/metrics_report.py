@@ -39,6 +39,80 @@ def _fmt(v: float) -> str:
     return f"{v:.2f}".rstrip("0").rstrip(".") if isinstance(v, float) else str(v)
 
 
+def _series_labels(key: str) -> Tuple[str, Dict[str, str]]:
+    """`name{k=v,...}` -> (name, labels) for the flattened dump keys."""
+    if "{" not in key:
+        return key, {}
+    name, _, rest = key.partition("{")
+    body = rest.rstrip("}")
+    return name, dict(
+        pair.split("=", 1) for pair in body.split(",") if "=" in pair
+    )
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def heat_capacity_section(scalars: Dict[str, float]) -> str:
+    """Workload-heat + capacity STATE at t1 (absolute gauges, not
+    deltas): per-region traffic concentration and working-set bytes per
+    percentile/tier, and — when the dump is coordinator-side — per-store
+    headroom vs demand. Rendered only when the families exist so dumps
+    from builds without the heat plane stay unchanged."""
+    heat: Dict[str, Dict[str, float]] = {}
+    ws: Dict[Tuple[str, str], Dict[str, float]] = {}
+    cap: Dict[str, Dict[str, float]] = {}
+    for key, val in scalars.items():
+        name, labels = _series_labels(key)
+        if name == "heat.working_set_bytes":
+            ws.setdefault(
+                (labels.get("region", "-"), labels.get("tier", "?")), {}
+            )[labels.get("pct", "?")] = val
+        elif name.startswith("heat."):
+            agg = heat.setdefault(labels.get("region", "-"), {})
+            field = name[len("heat."):]
+            agg[field] = agg.get(field, 0.0) + val
+        elif name.startswith("capacity.") and "store" in labels:
+            cap.setdefault(labels["store"], {})[
+                name[len("capacity."):]] = val
+    lines = []
+    if heat or ws:
+        lines.append("== workload heat at t1 ==")
+        keys = set(ws) | {(r, "-") for r in heat
+                          if not any(k[0] == r for k in ws)}
+        for region, tier in sorted(keys):
+            st = heat.get(region, {})
+            pcts = ws.get((region, tier), {})
+            lines.append(
+                f"region={region} tier={tier} "
+                f"touches={st.get('touches', 0):.0f} "
+                f"gini={st.get('bucket_gini', 0):.3f} "
+                f"hot10%={st.get('hot_fraction', 0):.3f} "
+                f"ws50={_fmt_bytes(pcts.get('50', 0))} "
+                f"ws90={_fmt_bytes(pcts.get('90', 0))} "
+                f"ws99={_fmt_bytes(pcts.get('99', 0))}"
+            )
+    if cap:
+        lines.append("")
+        lines.append("== capacity plane at t1 ==")
+        for store in sorted(cap):
+            st = cap[store]
+            lines.append(
+                f"store={store} "
+                f"headroom={_fmt_bytes(st.get('headroom_bytes', 0))} "
+                f"({st.get('headroom_fraction', 0):.0%} free) "
+                f"demand_p99={_fmt_bytes(st.get('demand_p99_bytes', 0))} "
+                f"resident={_fmt_bytes(st.get('resident_bytes', 0))} "
+                f"advice={st.get('advice_count', 0):.0f}"
+            )
+    return "\n".join(lines)
+
+
 def report(before: Dict, after: Dict, seconds: float,
            min_rate: float = 0.0) -> str:
     s0, c0, _ = _flatten(before)
@@ -84,6 +158,11 @@ def report(before: Dict, after: Dict, seconds: float,
                 f"{key.ljust(w)}  calls={d:<10} rate={rate:<10} "
                 f"p50_us={p50:<10} p99_us={p99}"
             )
+    hc = heat_capacity_section(s1)
+    if hc:
+        if lines:
+            lines.append("")
+        lines.append(hc)
     return "\n".join(lines) if lines else "(no movement between dumps)"
 
 
