@@ -1921,6 +1921,121 @@ def pipeline_sweep(platform):
     return result
 
 
+def build_throughput(platform):
+    """ISSUE 18: device-side bulk HNSW construction vs the host insert
+    loop on one config — build rows/s per arm plus the gates that make
+    the device arm trustworthy.
+
+    The host arm is the oracle: the sequential native insert loop
+    (`hnsw.device_build=False`) is the topology every prior PR
+    validated. The device arm streams the same rows through the bulk
+    session (batched beam candidate discovery + occlusion + reverse
+    edges, all on device). Three HARD gates, platform-independent:
+
+      recall parity — searching the device-built graph (device walk,
+        equal ef) reaches >= host-built recall - 0.02 on exact ground
+        truth;
+      determinism  — a second device build over the same rows produces
+        a byte-identical adjacency and entry slot;
+      recompiles   — that second build compiles NOTHING (the insert
+        ladder is shape-stable; steady-state rebuilds are free).
+
+    The rows/s comparison itself is informational on CPU (the MXU
+    batch-vs-loop crossover is the TPU story; interpreted JAX on host
+    can lose to native C++) — bench_diff tracks both arms' `_qps` keys
+    so a regression in either arm is caught on every platform."""
+    import time as _time
+
+    from dingo_tpu.common.config import FLAGS
+    from dingo_tpu.common.metrics import METRICS
+    from dingo_tpu.index import IndexParameter, IndexType, new_index
+
+    n = int(os.environ.get("DINGO_BENCH_BUILD_N", 6_000))
+    d = 64
+    k, ef, chunk = 10, 128, 1024
+    rng = np.random.default_rng(18)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    ids = np.arange(n, dtype=np.int64)
+    queries = x[rng.choice(n, 32, replace=False)] \
+        + 0.01 * rng.standard_normal((32, d)).astype(np.float32)
+    score = -(((queries[:, None, :] - x[None, :, :]) ** 2).sum(-1))
+    want = ids[np.argsort(-score, axis=1)[:, :k]]
+
+    def param():
+        return IndexParameter(index_type=IndexType.HNSW, dimension=d,
+                              nlinks=16, efconstruction=64)
+
+    def srecall(idx):
+        FLAGS.set("hnsw_device_search", True)
+        res = idx.search(queries, k, ef=ef)
+        return float(np.mean([len(set(r.ids) & set(w)) / k
+                              for r, w in zip(res, want)]))
+
+    def host_arm(rid):
+        FLAGS.set("hnsw_device_build", False)
+        idx = new_index(rid, param())
+        idx.store.reserve(n)
+        t0 = _time.perf_counter()
+        for s in range(0, n, chunk):
+            idx.upsert(ids[s:s + chunk], x[s:s + chunk])
+        wall = _time.perf_counter() - t0
+        return idx, wall
+
+    def device_arm(rid):
+        FLAGS.set("hnsw_device_build", True)
+        idx = new_index(rid, param())
+        t0 = _time.perf_counter()
+        sess = idx.bulk_builder(expect_rows=n)
+        for s in range(0, n, chunk):
+            sess.add(ids[s:s + chunk], x[s:s + chunk])
+        sess.finish()
+        wall = _time.perf_counter() - t0
+        return idx, wall
+
+    try:
+        hidx, host_wall = host_arm(1800)
+        didx, dev_wall = device_arm(1801)
+        # determinism + steady-state-recompile gates ride build #2: same
+        # rows, same conf -> bit-identical adjacency from a fully warm
+        # jit cache
+        recompiles_c = METRICS.counter("xla.recompiles")
+        recompiles0 = recompiles_c.get()
+        didx2, dev_wall2 = device_arm(1802)
+        recompiles = recompiles_c.get() - recompiles0
+        identical = bool(
+            np.array_equal(np.asarray(didx.store.adj),
+                           np.asarray(didx2.store.adj))
+            and didx._entry_slot == didx2._entry_slot)
+        r_host = srecall(hidx)
+        r_dev = srecall(didx)
+    finally:
+        FLAGS.set("hnsw_device_build", "auto")
+        FLAGS.set("hnsw_device_search", "auto")
+    result = {
+        "n": n, "d": d, "nlinks": 16, "efconstruction": 64,
+        "host_wall_s": round(host_wall, 3),
+        "device_wall_s": round(dev_wall, 3),
+        # steady-state rebuild cost: warm caches, the remat/rebuild case
+        "device_rebuild_wall_s": round(dev_wall2, 3),
+        "host_rows_qps": round(n / host_wall, 1),
+        "device_rows_qps": round(n / dev_wall, 1),
+        "device_speedup": round(host_wall / dev_wall, 2),
+        "recall_host_built": round(r_host, 4),
+        "recall_device_built": round(r_dev, 4),
+        "steady_state_recompiles": int(recompiles),
+        # hard gates (all platforms)
+        "recall_parity_gate": bool(r_dev >= r_host - 0.02),
+        "determinism_gate": identical,
+        "recompile_gate": bool(recompiles == 0),
+    }
+    log(f"build: host={result['host_rows_qps']:,.0f} rows/s, "
+        f"device={result['device_rows_qps']:,.0f} rows/s "
+        f"({result['device_speedup']}x), recall "
+        f"host={r_host:.3f}/dev={r_dev:.3f}, "
+        f"rebuild={dev_wall2:.2f}s, recompiles={recompiles}")
+    return result
+
+
 def main():
     # With a cached TPU result on hand a short probe suffices; without one,
     # keep the generous window — a live run is strictly better than a cache.
@@ -2153,6 +2268,10 @@ def main():
     #     (ISSUE 17) ---
     heat = heat_skew(platform)
 
+    # --- device bulk index construction: host insert loop vs batched
+    #     device build, parity/determinism/recompile gates (ISSUE 18) ---
+    build = build_throughput(platform)
+
     # --- state integrity: digest ledger + corruption scrub on vs off
     #     (ISSUE 11) ---
     integ = integrity_scrub(platform)
@@ -2282,6 +2401,11 @@ def main():
         # must add zero recompiles (the touches ride the existing
         # fetch group)
         "heat_skew": heat,
+        # device bulk construction (ISSUE 18): host insert loop vs the
+        # batched device build — rows/s per arm (bench_diff-tracked),
+        # recall-parity vs the host oracle, byte-identical second build,
+        # and zero steady-state recompiles across a warm rebuild
+        "build_throughput": build,
         # state-integrity plane (ISSUE 11): mixed r/w p99 with the digest
         # ledger + concurrent scrub on vs off (< 5% overhead gate, zero
         # recompiles — the ledger is host hashing only) and the
@@ -2355,6 +2479,18 @@ if __name__ == "__main__":
         print(json.dumps({"heat_skew": out}))
         sys.exit(0 if out["hot_mass_gate"] and out["recompile_gate"]
                  else 1)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--build":
+        # standalone: just the bulk-construction arms (acceptance
+        # smoke); exits non-zero when the device-built graph missed
+        # host-built recall, rebuilt non-deterministically, or the warm
+        # rebuild recompiled anything
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        out = build_throughput("cpu")
+        print(json.dumps({"build_throughput": out}))
+        sys.exit(0 if out["recall_parity_gate"] and out["determinism_gate"]
+                 and out["recompile_gate"] else 1)
     if len(sys.argv) >= 2 and sys.argv[1] == "--pipeline":
         # standalone: just the stall-free pipeline sweep (acceptance
         # smoke); exits non-zero if any depth broke byte-identity

@@ -286,6 +286,39 @@ FLAGS.define("hnsw_max_iters", 48, mutable=True,
                    "one hop). The walk exits earlier once every query's "
                    "beam has converged; the cap bounds worst-case latency "
                    "on adversarial graphs")
+FLAGS.define("hnsw_device_build", "auto", mutable=True,
+             help_="build bulk HNSW graphs on the device "
+                   "(ops/graph_build.py): pow2 insert batches walk the "
+                   "partially-built adjacency with the lockstep beam "
+                   "kernel, occlusion-prune neighbors as masked top-k "
+                   "over the candidate score matrix, and install reverse "
+                   "edges with degree-clamped re-pruning; the native "
+                   "graph back-fills lazily on first host-path use. "
+                   "'auto' (default) = TPU-only — MXU batch throughput "
+                   "is the whole point; the host insert loop stays the "
+                   "CPU arm and the parity oracle. True/False force")
+FLAGS.define("hnsw_build_batch", 256, mutable=True,
+             help_="rows per device bulk-build insert batch (rounded up "
+                   "to a power of two; the final partial batch pads with "
+                   "dropped lanes). Larger batches amortize more MXU "
+                   "work per dispatch but discover neighbors against a "
+                   "staler partial graph")
+FLAGS.define("hnsw_build_alpha", 1.0, mutable=True,
+             help_="occlusion-pruning diversification factor of the "
+                   "device bulk build (DiskANN's alpha): a candidate is "
+                   "pruned once it scores closer to an already-kept "
+                   "neighbor than to the inserted point, with the kept "
+                   "score scaled by alpha^2. >1 keeps longer edges "
+                   "(denser graph, better recall on clustered data)")
+FLAGS.define("train_sample_rows", 65536, mutable=True,
+             help_="train-sample row cap shared by every k-means/PQ "
+                   "train path (IVF coarse quantizer, PQ codebooks, the "
+                   "sharded plane's seeding sample). Trainers gather at "
+                   "most this many stored rows — on device when the rows "
+                   "live there, so only the sample (or just centroids) "
+                   "ever crosses to the host. 0 = full corpus: every "
+                   "live row feeds training and derived caps "
+                   "(max_points_per_centroid * nlist) are lifted too")
 FLAGS.define("quality_sample_rate", 0.0, mutable=True,
              help_="fraction of live searches re-answered EXACTLY by the "
                    "shadow scan and scored for recall/RBO/score-gap "
@@ -584,6 +617,29 @@ def hnsw_device_enabled() -> bool:
     if v is None:
         return _on_tpu()
     return v
+
+
+def hnsw_device_build_enabled() -> bool:
+    """Tri-state hnsw.device_build: 'auto' keeps bulk device construction
+    TPU-only — the batched beam walks and masked top-k selection rounds
+    need MXU throughput to beat the native C++ insert loop; the host
+    build stays the CPU arm and the parity oracle. True/False force."""
+    v = _parse_tri(FLAGS.get("hnsw_device_build"))
+    if v is None:
+        return _on_tpu()
+    return v
+
+
+def train_sample_rows() -> int:
+    """Row cap shared by every train path (conf train.sample_rows,
+    floor 0). 0 = full corpus: trainers feed every live row and lift
+    their derived caps (an explicit opt-in — full-corpus Lloyd over a
+    blocked device layout is exactly what the chunked kmeans_fit scan
+    compiles to one program for)."""
+    try:
+        return max(0, int(FLAGS.get("train_sample_rows")))
+    except (TypeError, ValueError):
+        return 65536
 
 
 def serving_pipeline_enabled() -> bool:

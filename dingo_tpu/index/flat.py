@@ -105,6 +105,24 @@ def integrity_mutation(fn):
     return wrapped
 
 
+def _resolve_train_cap(derived: int) -> int:
+    """Effective train-sample row cap: the shared conf cap
+    (train.sample_rows) meets the caller's derived cap (e.g.
+    max_points_per_centroid * nlist). 0 from conf = full corpus — an
+    explicit opt-in that lifts the derived cap too (ISSUE 18b: chunked
+    device Lloyd makes full-corpus training one compiled scan, the Faiss
+    derive-from-corpus stance instead of a fixed host-sample ceiling).
+    Returns 0 for uncapped."""
+    from dingo_tpu.common.config import train_sample_rows
+
+    conf = train_sample_rows()
+    if conf == 0:
+        return 0
+    if derived <= 0:
+        return conf
+    return min(conf, derived)
+
+
 def _pad_batch(q: np.ndarray) -> np.ndarray:
     b = q.shape[0]
     bb = _next_pow2(max(1, b))
@@ -188,6 +206,23 @@ class _SlotStoreIndex(VectorIndex):
             k=topk,
             metric=self.metric,
         )
+
+    # -- train sampling (device-resident, ISSUE 18b) -----------------------
+    def _train_rows_device(self, derived_cap: int = 0):
+        """Live stored rows for implicit training, as a DEVICE f32 array:
+        samples slot INDICES host-side (cheap ints, seeded by index id so
+        retrains are reproducible) and gathers the rows on device via
+        store.rows_device — the corpus never materializes on the host the
+        way the old to_host() path did. `derived_cap` is the caller's own
+        ceiling (0 = none); conf train.sample_rows=0 lifts both."""
+        live = np.flatnonzero(self.store.ids_by_slot >= 0)
+        cap = _resolve_train_cap(derived_cap)
+        if cap and len(live) > cap:
+            sel = np.random.default_rng(self.id).choice(
+                len(live), cap, replace=False
+            )
+            live = np.sort(live[sel])   # ascending gather, stable order
+        return self.store.rows_device(live)
 
     # -- state-integrity ledger hooks (obs/integrity.py) -------------------
     def _integrity_begin(self) -> None:

@@ -69,6 +69,10 @@ _LIB = None
 #: distinct live filter shapes per region are few)
 FILTER_CACHE_SIZE = 16
 
+#: rows replayed per native back-fill chunk after a device bulk build
+#: (O(chunk) host memory, the streaming-rebuild discipline)
+BACKFILL_CHUNK = 8192
+
 
 def _lib():
     global _LIB
@@ -103,6 +107,10 @@ class TpuHnsw(_SlotStoreIndex):
         #: adjacency mirror was built against; None = never built
         self._graph_key = None
         self._entry_slot = -1
+        #: device bulk build installed an adjacency the native graph does
+        #: not hold yet — the first host-path use (write, host search,
+        #: save) back-fills it (ISSUE 18 tentpole a)
+        self._native_pending = False
         #: fingerprint -> (store version, numpy mask, device mask or None)
         self._filter_cache: dict = {}
 
@@ -146,6 +154,7 @@ class TpuHnsw(_SlotStoreIndex):
 
     @integrity_mutation
     def upsert(self, ids: np.ndarray, vectors: np.ndarray) -> None:
+        self._ensure_native_graph()
         vectors = self._prep_vectors(vectors)
         ids = np.ascontiguousarray(ids, np.int64)
         if len(ids) != len(vectors):
@@ -168,6 +177,7 @@ class TpuHnsw(_SlotStoreIndex):
 
     @integrity_mutation
     def delete(self, ids: np.ndarray) -> None:
+        self._ensure_native_graph()
         ids = np.ascontiguousarray(ids, np.int64)
         slots = self.store.remove_slots(ids)
         removed = int((slots >= 0).sum())
@@ -295,6 +305,118 @@ class TpuHnsw(_SlotStoreIndex):
                 self.store.mutation_version,
             )
         )
+
+    # -- device bulk build (ISSUE 18) ----------------------------------------
+    def bulk_builder(self, expect_rows: int = 0):
+        """Bulk-construction session (manager.build_index feeds scan
+        chunks through it): rows stream into the SlotStore and the level-0
+        graph builds on device in pow2 batches (ops/graph_build.py),
+        batches-of-rows MXU work instead of one native insert at a time.
+
+        Returns None when the crossover gate says host (``hnsw.device_build``
+        auto = TPU-only — the host insert loop stays the CPU arm and the
+        parity oracle) or when the index already holds rows (bulk build
+        constructs from empty; incremental inserts keep the native path).
+        """
+        from dingo_tpu.common.config import hnsw_device_build_enabled
+
+        if not hnsw_device_build_enabled():
+            return None
+        if len(self.store) or int(_lib().hnsw_total_count(self._graph)):
+            return None
+        return _HnswBulkSession(self, expect_rows)
+
+    @integrity_mutation
+    def _bulk_put(self, ids: np.ndarray, vectors: np.ndarray) -> np.ndarray:
+        """upsert() minus the native ``hnsw_add``: store put + rerank offer
+        + quality/integrity ledgers. The graph edge work happens in the
+        bulk session's device builder; the native graph back-fills lazily
+        via _ensure_native_graph()."""
+        vectors = self._prep_vectors(vectors)
+        ids = np.ascontiguousarray(ids, np.int64)
+        if len(ids) != len(vectors):
+            raise InvalidParameter("ids/vectors length mismatch")
+        slots = self.store.put(ids, vectors)
+        self._offer_rerank(slots, vectors)
+        from dingo_tpu.obs.quality import QUALITY
+
+        QUALITY.observe_write(self, ids, vectors)
+        self._integrity_write(ids, vectors)
+        self.write_count_since_save += len(ids)
+        return slots
+
+    def _install_built_adjacency(self, adj, entry_slot: int) -> None:
+        """Install a device-built [capacity, deg] adjacency as THE graph:
+        the mirror serves device searches immediately, `_graph_key` pins it
+        against the lazy native re-export (which would clobber it with an
+        empty graph), and `_native_pending` arms the back-fill. Integrity-
+        bracketed like _install_adjacency — same mirror-swap semantics."""
+        self._integrity_begin()
+        try:
+            store = self.store
+            with store.device_lock:
+                store.set_graph(adj, self._graph_deg)
+                entry = int(entry_slot)
+                if entry < 0 or not store.valid_h[entry]:
+                    live_slots = np.flatnonzero(store.valid_h)
+                    entry = int(live_slots[0]) if len(live_slots) else -1
+                self._entry_slot = entry
+                self._graph_key = (
+                    int(_lib().hnsw_graph_version(self._graph)),
+                    store.mutation_version,
+                )
+                self._native_pending = True
+            n = len(store)
+            METRICS.gauge("hnsw.graph_nodes", region_id=self.id).set(
+                float(n)
+            )
+            from dingo_tpu.obs.integrity import INTEGRITY
+
+            if INTEGRITY.tracking(self):
+                full = np.asarray(adj)
+                INTEGRITY.reset_artifact(self, "adjacency")
+                live_slots = np.flatnonzero(store.ids_by_slot >= 0)
+                if len(live_slots):
+                    INTEGRITY.note_write(
+                        self, "adjacency", store.ids_by_slot[live_slots],
+                        store.ids_of_slots(full[live_slots]),
+                    )
+        finally:
+            self._integrity_end()
+
+    def _ensure_native_graph(self) -> None:
+        """Replay the store's rows into the native graph after a device
+        bulk build — triggered by the first host-path use (write, host
+        search, save), not by the build itself: a device-served region
+        never pays it. Streams BACKFILL_CHUNK rows per native add call
+        (O(chunk) host memory); quantized tiers replay the decoded
+        surrogate, the store's tier semantics. The handover COMPLETES
+        here: once the native graph holds the rows, its level-0 export
+        re-installs as the device mirror (one ordinary lazy re-export),
+        so every representation — device walk, host beam, snapshot,
+        integrity adjacency digest — describes the same topology from
+        this point on."""
+        if not self._native_pending:
+            return
+        self._native_pending = False
+        store = self.store
+        live = np.flatnonzero(store.valid_h)
+        ids = store.ids_by_slot[live]
+        for s in range(0, len(ids), BACKFILL_CHUNK):
+            chunk = np.ascontiguousarray(ids[s:s + BACKFILL_CHUNK],
+                                         np.int64)
+            _, rows = store.gather(chunk)
+            rows = np.ascontiguousarray(rows, np.float32)
+            _lib().hnsw_add(
+                self._graph,
+                len(chunk),
+                chunk.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                rows.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            )
+        self._graph_key = None
+        with store.device_lock:
+            self._ensure_device_graph()
+        METRICS.counter("build.backfills", region_id=self.id).add(1)
 
     # -- filter-mask cache ---------------------------------------------------
     def _prep_filter(self, filter_spec: Optional[FilterSpec]):
@@ -495,6 +617,7 @@ class TpuHnsw(_SlotStoreIndex):
 
     def _host_search_async(self, queries, b, topk, filter_spec, ef,
                            staged=None):
+        self._ensure_native_graph()
         METRICS.counter("hnsw.host_searches", region_id=self.id).add(1)
         # 1) CPU graph: over-fetched candidate labels per query.
         cand_labels = np.empty((b, ef), np.int64)
@@ -681,6 +804,7 @@ class TpuHnsw(_SlotStoreIndex):
         return meta
 
     def save(self, path: str) -> None:
+        self._ensure_native_graph()
         os.makedirs(path, exist_ok=True)
         if self._precision == "sq8" and self.store.sq_params is not None:
             snap = self.store.codes_to_host()
@@ -752,6 +876,7 @@ class TpuHnsw(_SlotStoreIndex):
         self._filter_cache.clear()
         self._graph_key = None
         self._entry_slot = -1
+        self._native_pending = False   # the loaded blob IS the graph
         adj_path = os.path.join(path, "hnsw_adj.npz")
         graph_meta = meta.get("hnsw_graph")
         if graph_meta and os.path.exists(adj_path) \
@@ -770,3 +895,43 @@ class TpuHnsw(_SlotStoreIndex):
         self.apply_log_id = meta["apply_log_id"]
         self.write_count_since_save = 0
         self._integrity_on_restore(meta)
+
+
+class _HnswBulkSession:
+    """One bulk construction: rows in via add(), graph installed by
+    finish(). Owns a BulkGraphBuilder over the index's SlotStore;
+    index-level bookkeeping (ledgers, rerank offers, native back-fill
+    arming) stays in TpuHnsw."""
+
+    def __init__(self, index: TpuHnsw, expect_rows: int = 0):
+        from dingo_tpu.common.config import FLAGS
+        from dingo_tpu.ops.graph_build import BulkGraphBuilder
+
+        self.index = index
+        if expect_rows > 0:
+            # one reservation = one compiled ladder: growth mid-build
+            # would re-specialize the insert program per pow2 step
+            index.store.reserve(expect_rows)
+        self._builder = BulkGraphBuilder(
+            index.store,
+            index._graph_deg,
+            index._kernel_metric,
+            sq=(index._precision == "sq8"),
+            batch_rows=int(FLAGS.get("hnsw_build_batch")),
+            beam=index._beam_width(index.parameter.efconstruction, 1),
+            max_iters=max(1, int(FLAGS.get("hnsw_max_iters"))),
+            alpha=float(FLAGS.get("hnsw_build_alpha")),
+            region_id=index.id,
+        )
+
+    def add(self, ids: np.ndarray, vectors: np.ndarray) -> None:
+        slots = self.index._bulk_put(ids, vectors)
+        self._builder.add_slots(np.asarray(slots, np.int32))
+
+    def finish(self) -> dict:
+        adj, entry, stats = self._builder.finish()
+        self.index._install_built_adjacency(adj, entry)
+        METRICS.counter(
+            "build.device_builds", region_id=self.index.id
+        ).add(1)
+        return stats

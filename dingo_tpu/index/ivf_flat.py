@@ -66,6 +66,7 @@ from dingo_tpu.index.flat import (
     BinaryPm1Mixin,
     _SlotStoreIndex,
     _pad_batch,
+    _resolve_train_cap,
     integrity_mutation,
 )
 from dingo_tpu.index.ivf_layout import (
@@ -636,35 +637,57 @@ class TpuIvfFlat(IvfViewMaintenance, _SlotStoreIndex):
         the stored vectors (VectorIndexManager::TrainForBuild samples the
         region, vector_index_manager.cc:1365)."""
         if vectors is None:
-            snap = self.store.to_host()   # SqSlotStore decodes here
-            vectors = snap["vectors"]
-        elif self._precision == "sq8":
-            # an explicit train set reaches the codec BEFORE any encode
-            # happened — per-dim min/max from the true distribution beats
-            # first-batch lazy training
-            self.store.maybe_train(self._prep_vectors(vectors))
-        vectors = np.asarray(vectors, np.float32)
-        if len(vectors) < self.nlist:
-            raise NotTrained(
-                f"need >= {self.nlist} train vectors, have {len(vectors)}"
+            # implicit path (ISSUE 18b): sample slot indices host-side,
+            # gather + decode + normalize on DEVICE — only centroids ever
+            # come back to the host. Conf train.sample_rows caps the
+            # sample (0 = full corpus, lifting the derived cap too).
+            dv = self._train_rows_device(
+                MAX_POINTS_PER_CENTROID * self.nlist
             )
-        if self.metric is Metric.COSINE:
-            vectors = np_normalize(vectors)
-        cap = MAX_POINTS_PER_CENTROID * self.nlist
-        if len(vectors) > cap:
-            sel = np.random.default_rng(self.id).choice(
-                len(vectors), cap, replace=False
+            if int(dv.shape[0]) < self.nlist:
+                raise NotTrained(
+                    f"need >= {self.nlist} train vectors, "
+                    f"have {int(dv.shape[0])}"
+                )
+            if self.metric is Metric.COSINE:
+                # stored rows are prep-normalized; quantized tiers decode
+                # with drift, so renormalize (the old host path did too)
+                dv = dv * jax.lax.rsqrt(jnp.maximum(
+                    jnp.sum(dv * dv, axis=1, keepdims=True), 1e-30
+                ))
+            self.centroids, _ = train_kmeans(
+                dv, k=self.nlist, iters=10, seed=self.id
             )
-            vectors = vectors[sel]
-        self.centroids, _ = train_kmeans(
-            jnp.asarray(vectors), k=self.nlist, iters=10, seed=self.id
-        )
+        else:
+            if self._precision == "sq8":
+                # an explicit train set reaches the codec BEFORE any
+                # encode happened — per-dim min/max from the true
+                # distribution beats first-batch lazy training
+                self.store.maybe_train(self._prep_vectors(vectors))
+            vectors = np.asarray(vectors, np.float32)
+            if len(vectors) < self.nlist:
+                raise NotTrained(
+                    f"need >= {self.nlist} train vectors, "
+                    f"have {len(vectors)}"
+                )
+            if self.metric is Metric.COSINE:
+                vectors = np_normalize(vectors)
+            cap = _resolve_train_cap(MAX_POINTS_PER_CENTROID * self.nlist)
+            if cap and len(vectors) > cap:
+                sel = np.random.default_rng(self.id).choice(
+                    len(vectors), cap, replace=False
+                )
+                vectors = vectors[sel]
+            self.centroids, _ = train_kmeans(
+                jnp.asarray(vectors), k=self.nlist, iters=10, seed=self.id
+            )
         self._c_sqnorm = squared_norms(self.centroids)
-        # (re)assign everything currently stored
+        # (re)assign everything currently stored — device gather, one
+        # assign kernel, host copy of the int32 labels only
         live = np.flatnonzero(self.store.ids_by_slot >= 0)
         if len(live):
-            _, vecs = self.store.gather(self.store.ids_by_slot[live])
-            assign = np.asarray(kmeans_assign(jnp.asarray(vecs), self.centroids))
+            vecs = self.store.rows_device(live)
+            assign = np.asarray(kmeans_assign(vecs, self.centroids))
             self._assign_h[live] = assign
         self._integrity_reset_assign()
         self._invalidate_view()

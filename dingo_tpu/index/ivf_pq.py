@@ -46,6 +46,7 @@ from dingo_tpu.index.flat import (
     _SlotStoreIndex,
     _flat_search_kernel,
     _pad_batch,
+    _resolve_train_cap,
     integrity_mutation,
 )
 from dingo_tpu.index.ivf_flat import IvfViewMaintenance, _probe_lists
@@ -436,28 +437,40 @@ class TpuIvfPq(IvfViewMaintenance, _SlotStoreIndex):
         # re-encodes every stored row into _codes chunk by chunk — a
         # scrub overlapping that must classify as raced, not corruption
         # (the decorator's bracket covers the whole method)
-        cap = MAX_POINTS_PER_CENTROID * self.nlist
+        cap = _resolve_train_cap(MAX_POINTS_PER_CENTROID * self.nlist)
         rng = np.random.default_rng(self.id)
+        min_train = max(self.nlist, self.ksub)
         if vectors is None:
-            # sample slots instead of materializing every live row (the
-            # host-vectors mode exists precisely because all rows at once
-            # do not fit anywhere fast)
+            # sample SLOTS instead of materializing every live row, and
+            # gather them straight to device (ISSUE 18b): device stores
+            # never round-trip rows at all, host stores upload only the
+            # sample. Conf train.sample_rows=0 lifts the cap entirely —
+            # full-corpus training as one chunked device Lloyd.
             live = np.flatnonzero(self.store.ids_by_slot >= 0)
-            sel = live if len(live) <= cap else np.sort(
+            sel = live if (not cap or len(live) <= cap) else np.sort(
                 rng.choice(live, cap, replace=False)
             )
-            vectors = self._rows_at_slots(sel)
-        vectors = np.asarray(vectors, np.float32)
-        min_train = max(self.nlist, self.ksub)
-        if len(vectors) < min_train:
-            raise NotTrained(
-                f"need >= {min_train} train vectors, have {len(vectors)}"
-            )
-        if self.metric is Metric.COSINE:
-            vectors = np_normalize(vectors)
-        if len(vectors) > cap:
-            vectors = vectors[rng.choice(len(vectors), cap, replace=False)]
-        dv = jnp.asarray(vectors)
+            if len(sel) < min_train:
+                raise NotTrained(
+                    f"need >= {min_train} train vectors, have {len(sel)}"
+                )
+            dv = self.store.rows_device(sel)
+            if self.metric is Metric.COSINE:
+                dv = normalize(dv)
+        else:
+            vectors = np.asarray(vectors, np.float32)
+            if len(vectors) < min_train:
+                raise NotTrained(
+                    f"need >= {min_train} train vectors, "
+                    f"have {len(vectors)}"
+                )
+            if self.metric is Metric.COSINE:
+                vectors = np_normalize(vectors)
+            if cap and len(vectors) > cap:
+                vectors = vectors[
+                    rng.choice(len(vectors), cap, replace=False)
+                ]
+            dv = jnp.asarray(vectors)
         self.centroids, _ = train_kmeans(dv, k=self.nlist, iters=10, seed=self.id)
         self._c_sqnorm = squared_norms(self.centroids)
         assign = kmeans_assign(dv, self.centroids)

@@ -23,6 +23,7 @@ from typing import Optional
 import numpy as np
 
 from dingo_tpu.common.log import get_logger, region_log
+from dingo_tpu.common.metrics import METRICS
 from dingo_tpu.engine import write_data as wd
 from dingo_tpu.engine.raw_engine import RawEngine
 from dingo_tpu.index.base import IndexParameter, VectorIndex
@@ -76,36 +77,59 @@ class VectorIndexManager:
         index = new_index(region.id, param)
         reader = self._reader(region)
 
-        ids_batch, vec_batch = [], []
-
-        def flush():
-            if ids_batch:
-                index.upsert(
-                    np.asarray(ids_batch, np.int64), np.stack(vec_batch)
-                )
-                ids_batch.clear()
-                vec_batch.clear()
-
         with TRACER.start_span("index.build") as span:
-            rows = reader.vector_scan_query(
-                0, limit=1 << 62, with_vector_data=True)
-            if index.need_train():
-                # TrainForBuild (:1365): train on the scanned sample first
-                sample = [r.vector for r in rows]
-                if sample:
-                    try:
-                        index.train(np.stack(sample))
-                    except Exception:
-                        pass  # too little data: stays untrained (fallback)
-            for r in rows:
-                ids_batch.append(r.id)
-                vec_batch.append(r.vector)
-                if len(ids_batch) >= BUILD_BATCH:
-                    flush()
-            flush()
+            # streaming scan (ISSUE 18c): BUILD_BATCH-row pages feed the
+            # index directly — peak host memory is O(chunk), not O(corpus)
+            # (the old path materialized the full row list AND a second
+            # full copy for the train sample). Indexes exposing a bulk
+            # session (TpuHnsw behind the hnsw.device_build crossover)
+            # construct their graph on device from the same chunks.
+            mk = getattr(index, "bulk_builder", None)
+            bulk = mk() if mk is not None else None
+            total = 0
+            for ids, vecs in self._scan_chunks(reader):
+                total += len(ids)
+                if bulk is not None:
+                    bulk.add(ids, vecs)
+                else:
+                    index.upsert(ids, vecs)
+            if bulk is not None:
+                bulk.finish()
+            if index.need_train() and total:
+                # TrainForBuild (:1365) — now AFTER ingest: trainable
+                # tiers buffer pre-train rows in their store and the
+                # implicit train() samples them on device (ISSUE 18b),
+                # so the corpus never gets a second host copy
+                try:
+                    index.train()
+                except Exception as e:  # noqa: BLE001
+                    METRICS.counter(
+                        "build.train_failures", region_id=region.id
+                    ).add(1)
+                    region_log(_log, region.id).warning(
+                        "index train failed; serving untrained "
+                        "fallback: %s", e)
             span.set_attr("region_id", region.id)
-            span.set_attr("rows", len(rows))
+            span.set_attr("rows", total)
+            span.set_attr("device_build", bulk is not None)
         return index
+
+    def _scan_chunks(self, reader: VectorReader):
+        """Page the region data CF ascending in BUILD_BATCH-row chunks,
+        yielding (ids int64, vectors) per page. The cursor is the last
+        page's max id + 1 — the engine scan is id-ordered, so no row is
+        skipped or repeated."""
+        start = 0
+        while True:
+            rows = reader.vector_scan_query(
+                start, limit=BUILD_BATCH, with_vector_data=True)
+            if not rows:
+                return
+            yield (np.asarray([r.id for r in rows], np.int64),
+                   np.stack([r.vector for r in rows]))
+            if len(rows) < BUILD_BATCH:
+                return
+            start = rows[-1].id + 1
 
     # ---------------- catch-up + switch ----------------
     def _catch_up_and_install(self, wrapper, index, region: Region,

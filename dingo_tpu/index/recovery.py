@@ -244,6 +244,10 @@ class DeviceRecoveryPlane:
         if not ok:
             return False
         self._reg.counter("fault.rematerializations").add(1)
+        # remat rides the streaming bulk-build arm (ISSUE 18c): repair
+        # time IS degraded-serving time, so the build plane counts remats
+        # next to its rows/batches series
+        self._reg.counter("build.remat_rebuilds", region_id=rid).add(1)
         self.clear_degraded(rid)
         region_log(_log, rid).info(
             "re-materialized from engine at precision=%s — degraded "

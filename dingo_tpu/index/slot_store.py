@@ -462,6 +462,16 @@ class SlotStore:
             )
         return found, vecs
 
+    def rows_device(self, slots: np.ndarray) -> jax.Array:
+        """Decoded f32 rows at `slots` as a DEVICE array — the train-path
+        gather (ISSUE 18b): samplers pick slot indices host-side (cheap
+        ints) and the rows themselves never round-trip; only centroids
+        come back. One take per call; quantized stores decode in-device."""
+        with self.device_lock:   # vecs reference is donatable
+            return jnp.take(
+                self.vecs, jnp.asarray(slots, jnp.int32), axis=0
+            ).astype(jnp.float32)
+
     def to_host(self) -> dict:
         """Compacted host snapshot {ids, vectors} of live rows (save path)."""
         live = self.ids_by_slot >= 0
@@ -548,6 +558,12 @@ class HostSlotStore(SlotStore):
         found = slots >= 0
         safe = np.where(found, slots, 0)
         return found, self.vecs[safe]
+
+    def rows_device(self, slots: np.ndarray) -> jax.Array:
+        # rows live in host RAM: the gather itself is the upload
+        rows = np.asarray(self.vecs[np.asarray(slots, np.int64)],
+                          np.float32)
+        return jnp.asarray(rows)
 
     def memory_size(self) -> int:
         # host bytes; device footprint is the caller's codes/centroids
@@ -679,6 +695,19 @@ class SqSlotStore(SlotStore):
     def gather(self, ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         found, codes = super().gather(ids)
         return found, self.decode(np.asarray(codes, np.uint8))
+
+    def rows_device(self, slots: np.ndarray) -> jax.Array:
+        from dingo_tpu.ops.sq import sq_decode_device
+
+        with self.device_lock:
+            codes = jnp.take(
+                self.vecs, jnp.asarray(slots, jnp.int32), axis=0
+            )
+            if self.sq_params is None:   # no writes yet: nothing to decode
+                return codes.astype(jnp.float32)
+            return sq_decode_device(
+                codes, self.sq_vmin_d, self.sq_scale_d, dtype=jnp.float32
+            )
 
     def to_host(self) -> dict:
         """Decoded float snapshot — the safe default for callers that mean

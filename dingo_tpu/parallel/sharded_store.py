@@ -381,14 +381,20 @@ class ShardedFlatStore:
     # -- distributed k-means --------------------------------------------------
     def train_kmeans(self, k: int, iters: int = 10, seed: int = 0):
         """Distributed Lloyd iterations; returns (centroids [k, d], counts)."""
+        from dingo_tpu.common.config import train_sample_rows
+
         rng = np.random.default_rng(seed)
         live = np.flatnonzero(self.ids_by_gslot >= 0)
         # Farthest-first seeding on a host sample (random seeds collapse when
         # a dense blob draws several — same fix as ops/kmeans.py). The sample
-        # rows gather ON DEVICE: only [<=65536, d] crosses to the host.
+        # rows gather ON DEVICE: only [<=train.sample_rows, d] crosses to
+        # the host. Note the Lloyd iterations below ALWAYS scan the full
+        # sharded corpus — the conf cap (0 = uncapped) bounds only this
+        # seeding sample.
+        cap = train_sample_rows()
         sample_idx = (
-            live if len(live) <= 65536
-            else rng.choice(live, 65536, replace=False)
+            live if (not cap or len(live) <= cap)
+            else rng.choice(live, cap, replace=False)
         )
         sample = np.asarray(jax.device_get(self._sample_jit(
             self.vecs, jnp.asarray(np.sort(sample_idx), jnp.int32)

@@ -27,6 +27,11 @@ key name, so the tool keeps working as bench grows scenarios:
                on purpose — recovery is bounded, not benchmarked).
                Chaos `goodput` keys ride the qps rule.
 
+build_throughput (ISSUE 18) names its per-arm rates `host_rows_qps` /
+`device_rows_qps` deliberately: build rows/s ride the qps rule, its
+recall_*_built keys the recall rule, and steady_state_recompiles the
+recompiles rule — no bespoke classifier needed.
+
 Exit status: 0 = no regressions, 1 = regressions found (CI-gateable),
 2 = usage/file errors. All human output goes to stdout; --json emits the
 machine-readable comparison instead.

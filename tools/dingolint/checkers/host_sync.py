@@ -55,6 +55,14 @@ _ROOT_BASENAMES = {"search", "search_async"}
 #: defs named search)
 _ADMISSION_MODULE_PREFIXES = ("dingo_tpu.cache.",)
 
+#: bulk-build plane (ISSUE 18): construction is off the serving path,
+#: but its own throughput contract is the same shape — insert_batch and
+#: every per-batch helper must dispatch without waiting, so the pow2
+#: insert ladder pipelines; the ONE sanctioned sync is finish() (read
+#: the entry slot + drop counters once per whole build), which belongs
+#: in the baseline with that rationale, exactly like resolve()
+_BUILD_MODULE_PREFIXES = ("dingo_tpu.ops.graph_build",)
+
 #: traversal never descends into these (their own discipline applies)
 _SKIP_MODULE_PREFIXES = ("dingo_tpu.obs.", "dingo_tpu.trace.",
                          "dingo_tpu.metrics.")
@@ -113,6 +121,7 @@ class HostSyncChecker(Checker):
             if (q.rsplit(".", 1)[-1] in _ROOT_BASENAMES
                 and info.module.name.startswith(_ROOT_MODULE_PREFIXES))
             or info.module.name.startswith(_ADMISSION_MODULE_PREFIXES)
+            or info.module.name.startswith(_BUILD_MODULE_PREFIXES)
         ]
 
         def skip(qual: str) -> bool:

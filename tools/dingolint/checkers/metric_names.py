@@ -248,6 +248,21 @@ FAMILY_NAMES = {
                                     # engine
         "fault.recovery_ms",        # ladder wall-time recorder (us)
     },
+    "build": {
+        # device-side bulk index construction (ISSUE 18):
+        # ops/graph_build.py + index/hnsw.py bulk session + manager arm
+        "build.rows",               # rows fed through insert_batch
+        "build.batches",            # insert_batch dispatches
+        "build.reverse_dropped",    # degree-clamped reverse edges dropped
+                                    # (device fold, read once at finish)
+        "build.device_builds",      # completed bulk sessions per region
+        "build.backfills",          # native-graph replays on first
+                                    # host-path use after a bulk build
+        "build.train_failures",     # manager train() raised; untrained
+                                    # fallback installed (was silent)
+        "build.remat_rebuilds",     # PR 13 re-materializations riding
+                                    # the streaming bulk-build arm
+    },
 }
 
 
