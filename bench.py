@@ -1759,6 +1759,186 @@ def heat_skew(platform):
     return result
 
 
+def memory_pressure(platform):
+    """ISSUE 19: memory-tiered indexes under a shrinking synthetic HBM
+    budget — the resident-fraction vs QPS/recall curve.
+
+    One store, three FLAT regions through the real cluster plane
+    (tools/chaos.py harness). TierManager.budget_override stands in for
+    the allocator watermark: each pressure step shrinks the budget, runs
+    policy ticks until the ladder settles, then measures resident
+    fraction (device share of index bytes), p50 batch QPS across the
+    regions, recall@10 vs the exact fp32 oracle, presence of EVERY
+    acked id, and the steady-state recompile delta. A forced-mmap step
+    exercises the bottom rung (policy alone stops at host RAM — there
+    is no host-RAM pressure model here), and the final leg raises the
+    budget back and lets the POLICY promote the traffic-bearing regions
+    home on their windowed QPS.
+
+    Gates: all acked rows searchable at every pressure point; the
+    demote->promote round trip answers byte-identically to the
+    never-demoted baseline; zero steady-state recompiles once each
+    step's transitions settle."""
+    import sys as _sys
+    import time as _time
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from dingo_tpu.common.config import FLAGS
+    from dingo_tpu.index.tiering import TIERING
+    from tools.chaos import DIM, _steady_recompiles, cluster
+
+    n_regions, n, k = 3, 384, 10
+    old_enabled = FLAGS.get("tier_enabled")
+    old_promote = FLAGS.get("tier_promote_qps")
+    FLAGS.set("tier_enabled", True)
+    TIERING.reset()
+    curve = []
+    all_searchable = True
+    recompiles_total = 0
+    try:
+        with cluster(1, replication=1, seed=19) as c:
+            rids = [c.create_region(part=i) for i in range(n_regions)]
+            _sid, node = c.wait_leader(rids[0])
+            regions, corpora, oracles = {}, {}, {}
+            rng = np.random.default_rng(19)
+            for rid in rids:
+                region = node.get_region(rid)
+                ids = np.arange(1, n + 1, dtype=np.int64)
+                x = rng.standard_normal((n, DIM)).astype(np.float32)
+                for lo in range(0, n, 64):
+                    node.storage.vector_add(
+                        region, ids[lo:lo + 64], x[lo:lo + 64])
+                q = x[rng.choice(n, 16, replace=False)] + 0.05 * (
+                    rng.standard_normal((16, DIM)).astype(np.float32))
+                cd = ((q ** 2).sum(1)[:, None] - 2.0 * q @ x.T
+                      + (x ** 2).sum(1)[None, :])
+                regions[rid] = region
+                corpora[rid] = (ids, x, q)
+                oracles[rid] = ids[np.argsort(cd, axis=1)[:, :k]]
+
+            def measure():
+                """(p50_qps, recall@10, all-acked-present) across regions."""
+                lats, hits, total, present = [], 0, 0, True
+                for rid, region in regions.items():
+                    ids, _x, q = corpora[rid]
+                    got = node.storage.vector_batch_query(
+                        region, [int(i) for i in ids])
+                    present &= all(
+                        v is not None and v.vector is not None for v in got)
+                    for _ in range(4):
+                        t0 = _time.perf_counter()
+                        res = node.storage.vector_batch_search(region, q, k)
+                        lats.append(_time.perf_counter() - t0)
+                    for row, gt in zip(res, oracles[rid]):
+                        hits += len({r.id for r in row} & set(gt.tolist()))
+                        total += k
+                lats.sort()
+                p50 = lats[len(lats) // 2]
+                return (round(len(q) / p50, 1) if p50 else 0.0,
+                        round(hits / total, 4) if total else 0.0, present)
+
+            def baseline_topk():
+                out = {}
+                for rid, region in regions.items():
+                    _ids, _x, q = corpora[rid]
+                    res = node.storage.vector_batch_search(region, q, k)
+                    out[rid] = [[(r.id, r.distance) for r in row]
+                                for row in res]
+                return out
+
+            def settle(max_ticks=24):
+                for _ in range(max_ticks):
+                    rep = TIERING.tick(node)
+                    if not rep or "idle" in rep:
+                        return
+                    if not rep.get("ok", True):
+                        return   # refused transition: stop, report as-is
+
+            def step(label, budget_frac=None):
+                nonlocal all_searchable, recompiles_total
+                settle()
+                qps, recall, present = measure()
+                all_searchable &= present
+                rec = sum(
+                    _steady_recompiles(node, regions[rid],
+                                       corpora[rid][2][:4], reps=2)
+                    for rid in rids)
+                recompiles_total += rec
+                rungs = {rid: s["rung"]
+                         for rid, s in TIERING.state().items()}
+                point = {
+                    "label": label,
+                    "resident_fraction": round(
+                        TIERING.resident_fraction(node), 4),
+                    "p50_qps": qps,
+                    "recall_at_10": recall,
+                    "all_acked_searchable": present,
+                    "steady_recompiles": rec,
+                    "tiers": {str(r): rungs.get(r, "hbm") for r in rids},
+                }
+                if budget_frac is not None:
+                    point["budget_frac"] = budget_frac
+                curve.append(point)
+                log(f"memory_pressure[{label}]: resident="
+                    f"{point['resident_fraction']:.2f} qps={qps} "
+                    f"recall={recall} recompiles={rec}")
+
+            # keep policy promotion out of the squeeze (it re-enters in
+            # the final leg on its own QPS evidence)
+            FLAGS.set("tier_promote_qps", 1e18)
+            TIERING.budget_override = 1 << 60
+            _limit, in_use0 = TIERING._headroom(node)
+            baseline = baseline_topk()
+            step("unpressured", budget_frac=1.2)
+            for frac in (0.6, 0.35, 0.12, 0.02):
+                TIERING.budget_override = max(1, int(in_use0 * frac))
+                step(f"budget_{frac:g}", budget_frac=frac)
+            # policy stops at host RAM; force the bottom rung once
+            for rid in rids:
+                while TIERING.state().get(rid, {}).get("rung") != "mmap_sq8":
+                    if not TIERING.demote(node, regions[rid])["ok"]:
+                        break
+            step("mmap_forced")
+
+            # release the squeeze: any windowed traffic now qualifies,
+            # and the policy walks the hot regions back up rung by rung
+            TIERING.budget_override = 1 << 60
+            FLAGS.set("tier_promote_qps", 0.0)
+            for _ in range(4 * n_regions + 4):
+                for rid, region in regions.items():   # keep windows warm
+                    node.storage.vector_batch_search(
+                        region, corpora[rid][2][:2], k)
+                rep = TIERING.tick(node)
+                if not rep or "idle" in rep:
+                    break
+            promoted_home = all(
+                s["rung"] == s["base"] for s in TIERING.state().values())
+            step("promoted_back")
+            round_trip_identical = baseline_topk() == baseline
+    finally:
+        FLAGS.set("tier_enabled", old_enabled)
+        FLAGS.set("tier_promote_qps", old_promote)
+        TIERING.reset()
+
+    result = {
+        "config": f"memory_pressure_{n_regions}r_{n}x{DIM}_flat_fp32",
+        "curve": curve,
+        "promoted_home_by_policy": bool(promoted_home),
+        "round_trip_identical": bool(round_trip_identical),
+        "all_acked_searchable": bool(all_searchable),
+        "steady_state_recompiles": int(recompiles_total),
+        # acceptance gates
+        "searchable_gate": bool(all_searchable),
+        "round_trip_gate": bool(round_trip_identical),
+        "recompile_gate": bool(recompiles_total == 0),
+    }
+    log(f"memory_pressure: searchable={all_searchable} "
+        f"round_trip_identical={round_trip_identical} "
+        f"promoted_home={promoted_home} "
+        f"recompiles={recompiles_total} ({len(curve)} curve points)")
+    return result
+
+
 def pipeline_sweep(platform):
     """ISSUE 15: stall-free serving pipeline — closed-loop saturation
     through the coalescer's overlapped-dispatch arm at staging depth
@@ -2279,6 +2459,10 @@ def main():
     # --- chaos: deterministic fault scenarios with gates (ISSUE 14) ---
     cha = chaos(platform)
 
+    # --- memory-tiered indexes under a shrinking synthetic HBM budget:
+    #     the resident-fraction vs QPS/recall curve (ISSUE 19) ---
+    mem = memory_pressure(platform)
+
     # --- CPU baseline: numpy/OpenBLAS IVF-flat with same layout ---
     centroids = np.asarray(idx.centroids)
     assign = idx._assign_h[np.asarray(idx.store.slots_of(ids))]
@@ -2417,6 +2601,12 @@ def main():
         # on zero acked-write loss (digest-verified), bounded recovery,
         # the goodput floor, and zero steady-state recompiles
         "chaos": cha,
+        # memory-tier ladder (ISSUE 19): policy demotions under a
+        # shrinking synthetic budget — every acked row searchable at
+        # every pressure point, demote->promote round trip byte-
+        # identical, zero steady-state recompiles, and the
+        # resident-fraction vs QPS/recall curve
+        "memory_pressure": mem,
     }
     if platform == "tpu":
         result["measured_at"] = time.time()
@@ -2479,6 +2669,19 @@ if __name__ == "__main__":
         print(json.dumps({"heat_skew": out}))
         sys.exit(0 if out["hot_mass_gate"] and out["recompile_gate"]
                  else 1)
+    if len(sys.argv) >= 2 and sys.argv[1] in ("memory_pressure",
+                                              "--memory-pressure"):
+        # standalone: the memory-tier pressure ladder (acceptance
+        # smoke); exits non-zero when any acked row went unsearchable,
+        # the round trip was not byte-identical, or a settled step
+        # recompiled anything
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        out = memory_pressure("cpu")
+        print(json.dumps({"memory_pressure": out}))
+        sys.exit(0 if out["searchable_gate"] and out["round_trip_gate"]
+                 and out["recompile_gate"] else 1)
     if len(sys.argv) >= 2 and sys.argv[1] == "--build":
         # standalone: just the bulk-construction arms (acceptance
         # smoke); exits non-zero when the device-built graph missed

@@ -154,6 +154,9 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
                 str(r.apply_lag),
                 f"{r.search_qps:.1f}",
                 _recall_cell(r.quality_recall, r.quality_samples),
+                # memory-tier ladder rung serving this region's reads
+                # ("" from pre-tiering stores renders as '-')
+                getattr(r, "serving_tier", "") or "-",
                 _heat_cell(r.heat_hot_fraction, r.heat_touches),
                 _wset_cell(r.heat_working_set_p99, r.heat_touches),
                 str(r.qos_queue_depth),
@@ -173,8 +176,8 @@ def format_cluster_top(resp, region_id: int = 0) -> str:
         "",
         _render_table(
             ["REGION", "STORE", "ROLE", "KEYS", "VECTORS", "MEM", "DEVMEM",
-             "DEVPEAK", "LAG", "QPS", "RECALL", "HEAT", "WSET", "QDEPTH",
-             "PRESS", "SHED", "CACHE", "FLAGS"],
+             "DEVPEAK", "LAG", "QPS", "RECALL", "TIER", "HEAT", "WSET",
+             "QDEPTH", "PRESS", "SHED", "CACHE", "FLAGS"],
             region_rows,
         ),
     ]
@@ -186,8 +189,10 @@ def format_cluster_capacity(resp, store_id: str = "") -> str:
     advisory list, rendered from a GetStoreMetricsResponse. The plan is
     recomputed client-side with the SAME pure functions the coordinator
     heartbeat hook runs (coordinator/capacity.plan_store, duck-typed
-    over pb messages) — no second RPC, no divergent math. Advisories
-    are recommendations only; nothing in this path actuates."""
+    over pb messages) — no second RPC, no divergent math. Demote
+    advisories actuate through the coordinator's TIER_DEMOTE handshake
+    when the store runs with tier.enabled (index/tiering.py); this
+    rendering path itself never actuates."""
     from dingo_tpu.coordinator import capacity as cap
 
     store_rows = []

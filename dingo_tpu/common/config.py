@@ -540,6 +540,38 @@ FLAGS.define("capacity_headroom_target", 0.2, mutable=True,
                    "free: below it the coldest region (most resident "
                    "bytes outside its working set) draws a demote "
                    "advisory")
+FLAGS.define("tier_enabled", False, mutable=True,
+             help_="memory-tier ladder (index/tiering.py): a store-local "
+                   "policy loop demotes cold regions along HBM-fp32/bf16 "
+                   "-> HBM-sq8 -> host-RAM sq8 -> mmap'd sq8 codes and "
+                   "promotes them back on re-warm, every transition "
+                   "digest-gated against the state-integrity ledger. "
+                   "Policy inputs are the existing planes: capacity "
+                   "demote advisories, heat working-set bytes vs HBM "
+                   "headroom, windowed search QPS. Off = regions stay at "
+                   "their declared tier (today's behavior)")
+FLAGS.define("tier_demote_headroom", 0.15, mutable=True,
+             help_="free-HBM fraction below which the tier loop demotes "
+                   "the coldest resident region one rung (a tighter "
+                   "store-local tripwire under the capacity plane's "
+                   "capacity_headroom_target advisory threshold, so "
+                   "actuation fires before the allocator does)")
+FLAGS.define("tier_promote_qps", 5.0, mutable=True,
+             help_="sustained windowed vector-search QPS above which a "
+                   "demoted region promotes one rung back toward its "
+                   "declared tier (given HBM headroom to fit it); the "
+                   "same metrics-plane window the shed controller reads")
+FLAGS.define("tier_mmap_dir", "", mutable=True,
+             help_="directory for the mmap rung's code files (one "
+                   "region_<id>.codes per demoted region); empty = a "
+                   "per-process temp directory. Local SSD recommended — "
+                   "the paged exact scan's latency is this device's "
+                   "read bandwidth")
+FLAGS.define("tier_interval_s", 30.0, mutable=True,
+             help_="tier policy tick cadence (server crontab): each tick "
+                   "applies at most one transition per store — demotions "
+                   "and promotions are full-region copies, so pacing them "
+                   "keeps the build/copy bandwidth bounded")
 FLAGS.define("vector_blocked_layout", "auto", mutable=True,
              help_="maintain a dimension-blocked ([n_blocks, capacity, "
                    "block_d]) scan mirror + per-block norms in float/sq8 "

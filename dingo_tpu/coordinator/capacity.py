@@ -1,5 +1,5 @@
 """Coordinator capacity plane: HBM headroom vs measured working-set
-demand, with ADVISORY-ONLY tier/split recommendations.
+demand, with tier/split recommendations.
 
 Every store heartbeat now carries the workload-heat rollup each region's
 sketch derived on the store (RegionMetrics.heat_* — bytes to serve
@@ -22,10 +22,14 @@ snapshot into a capacity view:
     ``SPLIT_TRAFFIC_SHARE``) onto a hot core (hot_fraction ≥
     ``SPLIT_HOT_FRACTION``) — a hotspot that splitting would spread.
 
-**Contract with ROADMAP items 1–2:** this plane never actuates. Memory
-tiering (item 1) and device-aware split/merge (item 2) are the
-consumers; until they land, the advisories exist so operators (and the
-future planners) see what the heat evidence already supports —
+**Contract with ROADMAP items 1–2:** this plane itself never actuates —
+it computes. The memory-tier ladder (item 1, index/tiering.py) is now a
+live consumer: control.py turns each FRESH ``demote`` advisory into a
+TIER_DEMOTE region command, and the advised store's ladder flags the
+region for its own policy tick (the store still picks the moment, the
+rung, and may decline when local evidence disagrees). ``split`` advice
+stays observational until device-aware split/merge (item 2) lands.
+Either way the advisories surface what the heat evidence supports —
 ``capacity.*`` metrics, ``cluster capacity``, flight bundles. The same
 pure functions run coordinator-side (heartbeat hook in control.py) and
 client-side (cli.py renders the identical plan from GetStoreMetrics),

@@ -68,6 +68,11 @@ class RegionCmdType(enum.Enum):
     STOP = "stop"
     HOLD_VECTOR_INDEX = "hold_vector_index"
     SNAPSHOT_VECTOR_INDEX = "snapshot_vector_index"
+    #: capacity-plane demote advisory -> store actuation handshake: the
+    #: store flags the region for its memory-tier ladder (index/tiering)
+    #: and the LOCAL policy tick picks the moment — the coordinator never
+    #: forces a copy mid-burst
+    TIER_DEMOTE = "tier_demote"
 
 
 @persist.register
@@ -472,14 +477,18 @@ class CoordinatorControl:
         with self._lock:
             return sorted(self.integrity_diverged)
 
-    # ---------------- capacity plane (advisory only) ------------------------
+    # ---------------- capacity plane ----------------------------------------
     def _update_capacity(self, store_id: str, metrics) -> None:
         """Re-derive the arriving store's capacity plan from its beat's
         heat rollups (coordinator/capacity.py): HBM headroom vs p99
-        working-set demand + advisory tier/split recommendations.
-        ADVISORY ONLY — nothing here creates region commands; actuation
-        is roadmap items 1-2. Runs OUTSIDE the coordinator lock (takes
-        it briefly to store the plan); never raises."""
+        working-set demand + tier/split recommendations. Fresh DEMOTE
+        advisories close the loop through a TIER_DEMOTE region command —
+        the store acks it by flagging the region for its memory-tier
+        ladder (index/tiering.py), which actuates on its own policy tick
+        (a disabled ladder acks and ignores, so the command can't poison
+        the queue). Split advice stays advisory. Runs OUTSIDE the
+        coordinator lock (takes it briefly to store the plan); never
+        raises."""
         try:
             self._update_capacity_inner(store_id, metrics)
         except Exception:  # noqa: BLE001 — telemetry must not kill beats
@@ -505,6 +514,17 @@ class CoordinatorControl:
             self._capacity_advised = {
                 k for k in self._capacity_advised if k[0] != store_id
             } | live
+            # advisory -> actuation handshake: each FRESH demote advisory
+            # becomes one TIER_DEMOTE command to the advised store (the
+            # dedupe memo above already rate-limits recurrences to
+            # re-advise only after the advice lapses and returns)
+            for _sid, rid, kind in sorted(fresh):
+                if kind != "demote":
+                    continue
+                self._queue_cmd(store_id, RegionCmd(
+                    cmd_id=self._next_cmd(), region_id=rid,
+                    cmd_type=RegionCmdType.TIER_DEMOTE,
+                ))
         g = METRICS.gauge
         labels = {"store": store_id}
         g("capacity.headroom_bytes", labels=labels).set(

@@ -48,6 +48,25 @@ class StaleSnapshot(RuntimeError):
     compacted past snapshot_log_id + 1); installing it would lose writes."""
 
 
+def precision_override(param: Optional[IndexParameter],
+                       target: Optional[str]) -> Optional[IndexParameter]:
+    """Build parameter for a precision-narrowed resident rebuild: `param`
+    with its precision replaced by `target`, or `param` itself (same
+    object) when there is nothing to change. The region DEFINITION is
+    never touched — the declared parameter stays the tier an ordinary
+    rebuild returns to. The ONE precision-override helper shared by the
+    OOM-remat emergency path (index/recovery.py) and the deliberate tier
+    ladder (index/tiering.py)."""
+    if param is None or not target:
+        return param
+    current = getattr(param, "precision", "") or ""
+    if current == target:
+        return param
+    import dataclasses
+
+    return dataclasses.replace(param, precision=target)
+
+
 class VectorIndexManager:
     def __init__(self, engine: RawEngine, snapshot_root: Optional[str] = None):
         self.engine = engine
@@ -206,6 +225,21 @@ class VectorIndexManager:
             with self._lock:
                 self._rebuilding.discard(region.id)
                 self.rebuild_running -= 1
+
+    def rebuild_at_precision(self, region: Region,
+                             raft_log: Optional[RaftLog] = None,
+                             precision: Optional[str] = None) -> bool:
+        """The shared precision-override rebuild arm: full engine scan ->
+        fresh index at `precision` (None/empty/equal = the declared tier)
+        -> WAL catch-up -> atomic switch. Both deliberate tier moves
+        (index/tiering.py demote-to-sq8, promote-to-declared) and the
+        device-OOM re-materialization (index/recovery.py) land here, so
+        there is exactly one copy of the narrow-then-rebuild logic."""
+        override = precision_override(
+            region.definition.index_parameter, precision
+        )
+        return self.rebuild(region, raft_log=raft_log,
+                            param_override=override)
 
     def replay_wal(self, index: VectorIndex, region: Region,
                    raft_log: RaftLog, start: int, end: int) -> int:

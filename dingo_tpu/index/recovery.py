@@ -35,7 +35,6 @@ from the engine — rebuild-from-truth, same mechanism, no precision drop.
 
 from __future__ import annotations
 
-import dataclasses
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
@@ -218,26 +217,29 @@ class DeviceRecoveryPlane:
     def remat_parameter(param):
         """The advisory-lower-precision build parameter for a degraded
         region's re-materialization. The region definition is untouched —
-        this narrows only the resident rebuild."""
+        this narrows only the resident rebuild. Thin shim over the ONE
+        shared precision-override helper (index/manager.py
+        precision_override, also the tier ladder's arm)."""
         from dingo_tpu.common.config import FLAGS
+        from dingo_tpu.index.manager import precision_override
 
         target = str(FLAGS.get("device_recovery_remat_precision"))
-        current = getattr(param, "precision", "") or ""
-        if not target or current == target:
-            return param
-        return dataclasses.replace(param, precision=target)
+        return precision_override(param, target)
 
     def rematerialize(self, manager, region, raft_log=None) -> bool:
         """Rebuild a degraded region's index from the engine (source of
         truth) at the advisory-lower precision, then exit degraded mode.
         Returns False when a rebuild is already in flight (retried by the
-        next maintenance tick)."""
+        next maintenance tick). Rides manager.rebuild_at_precision — the
+        same arm the deliberate tier ladder uses — so the emergency path
+        has no private rebuild copy."""
+        from dingo_tpu.common.config import FLAGS
+
         rid = region.id
-        param = region.definition.index_parameter
-        override = self.remat_parameter(param) if param is not None else None
+        target = str(FLAGS.get("device_recovery_remat_precision"))
         try:
-            ok = manager.rebuild(region, raft_log=raft_log,
-                                 param_override=override)
+            ok = manager.rebuild_at_precision(region, raft_log=raft_log,
+                                              precision=target)
         except Exception:
             region_log(_log, rid).exception("re-materialization failed")
             return False
@@ -251,8 +253,7 @@ class DeviceRecoveryPlane:
         self.clear_degraded(rid)
         region_log(_log, rid).info(
             "re-materialized from engine at precision=%s — degraded "
-            "mode cleared",
-            getattr(override, "precision", None) or "default")
+            "mode cleared", target or "default")
         return True
 
     def run_rematerializations(self, node) -> int:

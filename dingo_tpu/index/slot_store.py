@@ -185,6 +185,12 @@ class SlotStore:
         # reference uses a per-index RWLock, vector_index_flat.h:129).
         # Held only across dispatch, never across device execution.
         self.device_lock = threading.RLock()
+        # H2D hook for the write programs' row upload: default is a plain
+        # jnp.asarray; the tier ladder's promotion path temporarily swaps
+        # in a staging-ring uploader (common/pipeline.StagingRing) so bulk
+        # code ingest overlaps the previous chunk's donated write program
+        # instead of serializing copy-then-dispatch (index/tiering.py).
+        self._upload = jnp.asarray
 
     # -- storage hooks (HostSlotStore overrides with numpy) ----------------
     def _blocked_dtype_ok(self) -> bool:
@@ -336,7 +342,7 @@ class SlotStore:
         self.vecs, self.sqnorm = _write_run(
             self.vecs,
             self.sqnorm,
-            jnp.asarray(padded),
+            self._upload(padded),
             jnp.int32(win_start),
             jnp.int32(lo),
             jnp.int32(lo + chunk),
@@ -681,7 +687,7 @@ class SqSlotStore(SlotStore):
         self.vecs, self.sqnorm = _write_run_presq(
             self.vecs,
             self.sqnorm,
-            jnp.asarray(padded),
+            self._upload(padded),
             jnp.asarray(row_sq),
             jnp.int32(win_start),
             jnp.int32(lo),
@@ -727,3 +733,130 @@ class SqSlotStore(SlotStore):
         """Compacted {ids, codes} of live rows (save path; 1 byte/dim)."""
         snap = super().to_host()   # base returns raw device rows = codes
         return {"ids": snap["ids"], "codes": snap["vectors"]}
+
+
+class HostSqSlotStore(SqSlotStore):
+    """SqSlotStore variant keeping the uint8 codes in HOST RAM.
+
+    The host rung of the memory-tier ladder (index/tiering.py): a demoted
+    region's codes leave HBM entirely, the serving arm becomes a paged
+    exact decoded scan on the host, and the device footprint drops to
+    zero. Same float-facing contract as SqSlotStore — put() encodes,
+    gather() decodes — and canonical_rows() still digests CODES, so the
+    state-integrity ledger's 'rows' artifact is byte-comparable across
+    the HBM-sq8 / host-sq8 / mmap-sq8 rungs (the digest gate that
+    tier transitions verify before swapping)."""
+
+    def _blocked_dtype_ok(self) -> bool:
+        return False   # codes live host-side; no device scan mirror
+
+    def _alloc_storage(self, capacity: int):
+        return (
+            np.zeros((capacity, self.dim), np.uint8),
+            np.zeros((capacity,), np.float32),
+        )
+
+    def _grow_storage(self, pad: int):
+        return (
+            np.concatenate(
+                [np.asarray(self.vecs),
+                 np.zeros((pad, self.dim), np.uint8)]
+            ),
+            np.concatenate([self.sqnorm, np.zeros((pad,), np.float32)]),
+        )
+
+    def _write_segment(self, start: int, rows: np.ndarray) -> None:
+        # rows arrive as CODES (SqSlotStore.put encodes before super().put);
+        # sqnorm caches the decoded-surrogate norms, same convention as the
+        # device store so tier moves never change what a scan accumulates
+        n = rows.shape[0]
+        codes = np.asarray(rows, np.uint8)
+        self.vecs[start:start + n] = codes
+        deq = self.decode(codes)
+        self.sqnorm[start:start + n] = \
+            np.einsum("ld,ld->l", deq, deq).astype(np.float32)
+
+    def gather(self, ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        slots = self.slots_of(ids)
+        found = slots >= 0
+        safe = np.where(found, slots, 0)
+        codes = np.asarray(self.vecs[safe], np.uint8)
+        if self.sq_params is None:
+            return found, codes.astype(np.float32)
+        return found, self.decode(codes)
+
+    def rows_device(self, slots: np.ndarray) -> jax.Array:
+        codes = np.asarray(self.vecs[np.asarray(slots, np.int64)], np.uint8)
+        if self.sq_params is None:
+            return jnp.asarray(codes.astype(np.float32))
+        return jnp.asarray(self.decode(codes))
+
+    def memory_size(self) -> int:
+        # host bytes; this store holds nothing on device
+        return int(np.asarray(self.vecs).nbytes + self.sqnorm.nbytes)
+
+
+class MmapSqSlotStore(HostSqSlotStore):
+    """HostSqSlotStore whose code array is an np.memmap on disk.
+
+    The bottom rung of the tier ladder: codes page in on demand under the
+    paged exact scan, so a fully-cold region's steady-state RAM cost is
+    the bookkeeping arrays (~13 bytes/slot), not the corpus. The file
+    layout is the raw [capacity, dim] uint8 code matrix — identical bytes
+    to the host rung's array, which keeps the digest-gated tier copy a
+    straight transcription."""
+
+    def __init__(self, dim: int, path: str, dtype=jnp.uint8,
+                 capacity: int = MIN_CAPACITY,
+                 blocked: Optional[bool] = None):
+        # the storage hooks run inside super().__init__ — path first
+        self._mmap_path = path
+        super().__init__(dim, dtype, capacity, blocked=blocked)
+
+    def _alloc_storage(self, capacity: int):
+        import os
+
+        os.makedirs(os.path.dirname(self._mmap_path) or ".", exist_ok=True)
+        return (
+            np.memmap(self._mmap_path, dtype=np.uint8, mode="w+",
+                      shape=(capacity, self.dim)),
+            np.zeros((capacity,), np.float32),
+        )
+
+    def _grow_storage(self, pad: int):
+        new_cap = self.capacity + pad
+        self.vecs.flush()
+        with open(self._mmap_path, "r+b") as f:
+            f.truncate(new_cap * self.dim)
+        return (
+            np.memmap(self._mmap_path, dtype=np.uint8, mode="r+",
+                      shape=(new_cap, self.dim)),
+            np.concatenate([self.sqnorm, np.zeros((pad,), np.float32)]),
+        )
+
+    def disk_bytes(self) -> int:
+        return int(self.capacity) * int(self.dim)
+
+    def memory_size(self) -> int:
+        # the codes are disk-resident; RAM cost is the norm cache (+ the
+        # base bookkeeping the caller already accounts per slot)
+        return int(self.sqnorm.nbytes)
+
+    def close(self, unlink: bool = True) -> None:
+        """Release the mapping (promotion/retirement): flush, drop the
+        mmap reference, optionally unlink the backing file."""
+        import os
+
+        with self.device_lock:
+            try:
+                self.vecs.flush()
+            except (ValueError, OSError):
+                pass
+            # replace with a zero-row array so a straggling reader fails
+            # loudly instead of touching an unmapped page
+            self.vecs = np.zeros((0, self.dim), np.uint8)
+        if unlink:
+            try:
+                os.unlink(self._mmap_path)
+            except OSError:
+                pass

@@ -224,6 +224,13 @@ class StoreMetricsCollector:
             rm.heat_working_set_p99 = int(hs["ws_bytes"][99])
             rm.heat_touches = int(hs["touches"])
         rm.cost_row_us = float(COST.region_row_us(region.id))
+        # memory-tier ladder (index/tiering.py): the rung serving reads —
+        # untracked regions report their resident precision's base rung
+        from dingo_tpu.index.tiering import TIERING
+
+        rm.serving_tier = TIERING.region_tier(
+            region.id, getattr(own, "_precision", "") if own else ""
+        )
         last = INTEGRITY.last_verified_ms(region.id)
         self.registry.gauge(
             "consistency.digest_age_s", region.id

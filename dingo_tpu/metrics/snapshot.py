@@ -90,6 +90,10 @@ class RegionMetricsSnapshot:
     #: per-shape cost model (obs/cost.py): the region's EWMA per-row
     #: dispatch cost in µs (0.0 = unmeasured)
     cost_row_us: float = 0.0
+    #: memory-tier ladder (index/tiering.py): the rung serving this
+    #: region's reads — hbm / hbm_sq8 / host_sq8 / mmap_sq8 ("" before
+    #: the first collection; `cluster top` TIER column)
+    serving_tier: str = ""
 
 
 @persist.register

@@ -272,6 +272,8 @@ _REGION_METRIC_FIELDS = (
     "heat_working_set_p90", "heat_working_set_p99", "heat_touches",
     # per-shape cost model (obs/cost.py): EWMA per-row dispatch cost µs
     "cost_row_us",
+    # memory-tier ladder (index/tiering.py): serving rung name
+    "serving_tier",
 )
 
 _STORE_METRIC_FIELDS = (

@@ -305,6 +305,19 @@ def serve_store(args) -> None:
         float(FLAGS.get("integrity_scrub_interval_s")),
         IntegrityScrubRunner(node, crontab=crontab).tick,
     )
+    # memory-tier ladder (index/tiering.py): one policy pass per tick —
+    # demote the coldest region under HBM pressure / coordinator
+    # advisory, promote a sustained-hot demoted one. Hot-gated on
+    # tier.enabled per tick; transitions are full-region copies, so the
+    # tick body runs on its own worker (the consistency_scrub pattern)
+    # and never stalls the shared crontab thread
+    from dingo_tpu.index.tiering import TierRunner
+
+    crontab.add(
+        "memory_tier",
+        float(FLAGS.get("tier_interval_s")),
+        TierRunner(node, crontab=crontab).tick,
+    )
     # device-runtime observability: process HBM watermark poll (per-region
     # owner ledgers refresh with each store_metrics pass) + region/index
     # config snapshots for flight-recorder bundles

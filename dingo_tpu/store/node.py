@@ -504,6 +504,17 @@ class StoreNode:
             region = self.meta.get_region(cmd.region_id)
             if region is not None:
                 self.index_manager.save_index(region)
+        elif t is RegionCmdType.TIER_DEMOTE:
+            # capacity-plane handshake (index/tiering.py): flag the
+            # region for the LOCAL memory-tier policy tick — the ladder
+            # picks the moment and the rung, the coordinator only says
+            # "this one first". Acked even with tiering disabled: a
+            # command the store will never act on must not cycle through
+            # the coordinator's retry budget as a failure
+            from dingo_tpu.index.tiering import TIERING
+
+            if TIERING.enabled():
+                TIERING.note_advisory(cmd.region_id)
         elif t in (RegionCmdType.STOP, RegionCmdType.PURGE):
             self.engine.stop_node(cmd.region_id)
         else:

@@ -32,6 +32,12 @@ build_throughput (ISSUE 18) names its per-arm rates `host_rows_qps` /
 recall_*_built keys the recall rule, and steady_state_recompiles the
 recompiles rule — no bespoke classifier needed.
 
+memory_pressure (ISSUE 19) rides the same rules per curve point
+(p50_qps / recall_at_10 / steady_recompiles), while its curve AXES —
+`budget_frac` and `resident_fraction` — are excluded: they describe the
+synthetic pressure schedule and the tier placement it forces, which are
+scenario design, not code under test.
+
 Exit status: 0 = no regressions, 1 = regressions found (CI-gateable),
 2 = usage/file errors. All human output goes to stdout; --json emits the
 machine-readable comparison instead.
@@ -117,6 +123,12 @@ def classify(path: str, summary: Optional[dict] = None) -> Optional[str]:
         # heat_skew's working-set estimate measures the PLANTED traffic
         # pattern (bytes the skewed stream needed resident), not code
         # quality — the bytes-suffix rule below would false-flag it
+        return None
+    if "resident_fraction" in low or low == "budget_frac":
+        # memory_pressure's curve axes: the synthetic budget step and
+        # the device-resident share it forces are scenario DESIGN, not
+        # code quality — the per-point p50_qps / recall_at_10 /
+        # steady_recompiles keys carry the regression signal
         return None
     if "hbm" in low or low.endswith("bytes") or low.endswith(
             "bytes_per_vector"):

@@ -97,7 +97,10 @@ class Cluster:
                 raw_engine=raw, raft_kw={"seed": seed + i})
 
     def create_region(self, index_type=None, precision: str = "",
-                      **param_kw):
+                      part: int = 0, **param_kw):
+        """One region over partition `part`'s whole id range — pass
+        distinct parts to host several regions on one store (ranges may
+        not overlap)."""
         from dingo_tpu.index import codec as vcodec
         from dingo_tpu.index.base import IndexParameter, IndexType
         from dingo_tpu.store.region import RegionType
@@ -106,8 +109,9 @@ class Cluster:
             index_type=index_type or IndexType.FLAT, dimension=DIM,
             precision=precision, **param_kw)
         d = self.coord.create_region(
-            start_key=vcodec.encode_vector_key(0, 0),
-            end_key=vcodec.encode_vector_key(0, 1 << 40),
+            start_key=vcodec.encode_vector_key(part, 0),
+            end_key=vcodec.encode_vector_key(part, 1 << 40),
+            partition_id=part,
             region_type=RegionType.INDEX,
             index_parameter=param,
         )
@@ -165,6 +169,7 @@ class Cluster:
 
     def close(self) -> None:
         from dingo_tpu.index.recovery import RECOVERY
+        from dingo_tpu.index.tiering import TIERING
         from dingo_tpu.obs.integrity import INTEGRITY
 
         for n in self.nodes.values():
@@ -175,6 +180,7 @@ class Cluster:
         # scenario (or the surrounding test process) starts clean
         RECOVERY.clear()
         INTEGRITY.clear()
+        TIERING.reset()
 
 
 @contextlib.contextmanager
@@ -589,12 +595,116 @@ def scenario_bitflip(seed: int) -> Dict[str, Any]:
             })
 
 
+class _TierKill(RuntimeError):
+    """Sentinel the tier-transition test hook raises after the in-proc
+    SIGKILL so the interrupted transition unwinds like the dying process
+    would have."""
+
+
+def scenario_tier_kill(seed: int) -> Dict[str, Any]:
+    """Process kill MID-TIER-TRANSITION (ISSUE 19): once between the
+    verified destination copy and the swap of a demotion, once inside a
+    promotion. The ladder's crash story is that every transition is a
+    copy + digest-gated swap over state the WAL already owns, so a kill
+    at the worst moment costs nothing: restart rebuilds at the DECLARED
+    tier from the engine and every acked write answers. Gates: zero
+    acked-write loss after each restart, digest-clean scrub, bounded
+    recovery, still writable, zero steady-state recompiles."""
+    from dingo_tpu.index.tiering import RUNG_HOST_SQ8, TIERING
+
+    with cluster(1, replication=1, seed=seed, durable=True) as c:
+        rid = c.create_region()
+        _sid, node = c.wait_leader(rid)
+        region = node.get_region(rid)
+        ids, x = _corpus(seed, 96)
+        acked: Dict[int, np.ndarray] = {}
+        for lo in range(0, 64, 8):
+            sl = slice(lo, lo + 8)
+            node.storage.vector_add(region, ids[sl], x[sl])
+            for i in range(lo, lo + 8):
+                acked[int(ids[i])] = x[i]
+
+        # reach the device-sq8 rung, then die inside the hbm_sq8 ->
+        # host_sq8 transcription: after the digest verify, before the swap
+        assert TIERING.demote(node, region)["ok"]
+
+        def kill_at(stage_name):
+            def hook(stage, _ctx=None):
+                if stage == stage_name:
+                    c.kill("s0")
+                    raise _TierKill(stage)
+            return hook
+
+        TIERING.test_hook = kill_at("mid_demote")
+        try:
+            TIERING.demote(node, region)
+            raise AssertionError("demotion survived the kill hook")
+        except _TierKill:
+            pass
+        finally:
+            TIERING.test_hook = None
+
+        t0 = time.perf_counter()
+        node2 = c.restart("s0")
+        c.wait_leader(rid)
+        region2 = node2.get_region(rid)
+        node2.storage.vector_batch_search(region2, x[:1], 3)
+        recovery1_ms = (time.perf_counter() - t0) * 1e3
+        TIERING.reset()   # in-proc restart: a real process loses this too
+        lost1 = _acked_lost(node2, region2, acked)
+        clean1 = _digest_clean(node2)
+
+        # walk the survivor down to host RAM, then die mid-PROMOTION
+        assert TIERING.demote(node2, region2)["ok"]
+        assert TIERING.demote(node2, region2)["ok"]
+        assert TIERING.state()[rid]["rung"] == "host_sq8"
+        assert TIERING._regions[rid].rung == RUNG_HOST_SQ8
+        TIERING.test_hook = kill_at("mid_promote")
+        try:
+            TIERING.promote(node2, region2)
+            raise AssertionError("promotion survived the kill hook")
+        except _TierKill:
+            pass
+        finally:
+            TIERING.test_hook = None
+
+        t0 = time.perf_counter()
+        node3 = c.restart("s0", seed_offset=200)
+        c.wait_leader(rid)
+        region3 = node3.get_region(rid)
+        node3.storage.vector_batch_search(region3, x[:1], 3)
+        recovery2_ms = (time.perf_counter() - t0) * 1e3
+        TIERING.reset()
+        lost2 = _acked_lost(node3, region3, acked)
+        clean2 = _digest_clean(node3)
+        node3.storage.vector_add(region3, ids[64:72], x[64:72])
+        got = node3.storage.vector_batch_query(region3, [int(ids[64])])
+        writable = got[0] is not None
+        recompiles = _steady_recompiles(node3, region3, x[:4])
+        recovery_ms = max(recovery1_ms, recovery2_ms)
+        return _result(
+            "tier_kill", seed,
+            acked=len(acked), lost=len(lost1) + len(lost2),
+            lost_ids=(lost1 + lost2)[:8],
+            recovery_ms=round(recovery_ms, 1),
+            recovery_bound_ms=RECOVERY_BOUND_S * 1e3,
+            steady_recompiles=recompiles,
+            gates={
+                "zero_acked_loss": not lost1 and not lost2,
+                "digest_clean": clean1 and clean2,
+                "recovery_bounded": recovery_ms <= RECOVERY_BOUND_S * 1e3,
+                "writable_after_recovery": writable,
+                "zero_steady_recompiles": recompiles == 0,
+            })
+
+
 SCENARIOS: Dict[str, Callable[[int], Dict[str, Any]]] = {
     "kill_restart": scenario_kill_restart,
     "leader_failover": scenario_leader_failover,
     "partition_heal": scenario_partition_heal,
     "oom_storm": scenario_oom_storm,
     "bitflip": scenario_bitflip,
+    "tier_kill": scenario_tier_kill,
 }
 
 

@@ -212,9 +212,25 @@ FAMILY_NAMES = {
         "cost.row_us",              # EWMA per-row cost, by {kernel}
         "cost.samples",             # completion-lane timings folded
     },
+    "tier": {
+        # memory-tier ladder (index/tiering.py): policy-driven rung
+        # moves along HBM -> HBM-sq8 -> host-RAM-sq8 -> mmap-sq8
+        "tier.current",             # region's serving rung (gauge,
+                                    # ladder index 0-3)
+        "tier.demotions",           # completed down-moves, by {to} rung
+        "tier.promotions",          # completed up-moves, by {to} rung
+        "tier.digest_refusals",     # destination copies vetoed by the
+                                    # rows-digest gate before the swap
+        "tier.advisories",          # coordinator TIER_DEMOTE commands
+                                    # acknowledged per region
+        "tier.transition_ms",       # rung-move wall-time recorder (us)
+        "tier.mmap_bytes",          # rung-3 on-disk code bytes (gauge)
+    },
     "capacity": {
         # coordinator capacity plane (coordinator/capacity.py +
-        # control._update_capacity) — advisory only, never actuates
+        # control._update_capacity) — demote advisories actuate through
+        # the TIER_DEMOTE handshake when tier.enabled (index/tiering.py);
+        # the series themselves stay observational
         "capacity.headroom_bytes",  # HBM limit - in-use, by {store}
         "capacity.headroom_fraction",
         "capacity.demand_p99_bytes",  # sum of regions' p99 working sets

@@ -101,6 +101,8 @@ NEW_MESSAGES = {
         ("heat_touches", 37, T.TYPE_INT64, None, False),
         # per-shape cost model (obs/cost.py): EWMA per-row dispatch µs
         ("cost_row_us", 38, T.TYPE_DOUBLE, None, False),
+        # memory-tier ladder (index/tiering.py): serving rung name
+        ("serving_tier", 39, T.TYPE_STRING, None, False),
     ],
     # whole-store snapshot (process device gauges + per-region list)
     "StoreMetrics": [
