@@ -39,6 +39,12 @@ def dedupe_enabled() -> bool:
     return cache_enabled()
 
 
+#: region_id -> stale bound currently engaged. Transition memo so the
+#: event ledger records WHEN stale serving engaged/disengaged, not every
+#: per-query gate read (stale_versions_allowed is hot-path).
+_stale_engaged: dict = {}
+
+
 def stale_versions_allowed(region_id: int) -> int:
     """How many mutation_versions behind a hit may serve for this region
     RIGHT NOW: ``cache.stale_versions`` while the shed ladder is degraded,
@@ -49,12 +55,26 @@ def stale_versions_allowed(region_id: int) -> int:
     try:
         bound = int(FLAGS.get("cache_stale_versions"))
     except (TypeError, ValueError):
-        return 0
-    if bound <= 0:
-        return 0
-    if degrade_level(region_id) <= 0:
-        return 0
-    return bound
+        bound = 0
+    level = degrade_level(region_id) if bound > 0 else 0
+    allowed = bound if (bound > 0 and level > 0) else 0
+    prev = _stale_engaged.get(region_id, 0)
+    if allowed != prev:
+        _stale_engaged[region_id] = allowed
+        from dingo_tpu.obs.events import EVENTS
+
+        EVENTS.emit(
+            "cache", region_id, "stale_rung", prev, allowed,
+            trigger="engage" if allowed else "disengage",
+            evidence={"degrade_level": level, "bound": bound},
+        )
+    return allowed
+
+
+def forget_region(region_id: int) -> None:
+    """Drop the stale-serving transition memo for a retired region (called
+    from the collector's retire sweep alongside the other planes)."""
+    _stale_engaged.pop(region_id, None)
 
 
 def semantic_allowed(region_id: int) -> bool:

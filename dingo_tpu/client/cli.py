@@ -327,6 +327,76 @@ def format_cluster_consistency(resp, region_id: int = 0) -> str:
     return "\n".join(out)
 
 
+def _fmt_event_time(ts_ms: int) -> str:
+    import datetime
+
+    if not ts_ms:
+        return "-"
+    return datetime.datetime.fromtimestamp(
+        ts_ms / 1000.0).strftime("%H:%M:%S.%f")[:-3]
+
+
+def format_cluster_events(resp, limit: int = 0) -> str:
+    """`cluster events`: the merged control-plane decision timeline from
+    an EventDumpResponse, oldest first (pure render — tests drive it
+    directly). Evidence stays compact JSON: it IS the exact inputs the
+    controller read, abbreviating it would defeat the ledger."""
+    rows = []
+    events = list(resp.events)
+    if limit and len(events) > limit:
+        events = events[-limit:]
+    for e in events:
+        rows.append([
+            _fmt_event_time(e.ts_ms),
+            e.node_id or "-",
+            e.actor,
+            str(e.region_id),
+            e.knob,
+            f"{e.old or '-'} -> {e.new or '-'}",
+            e.trigger,
+            e.evidence or "-",
+        ])
+    out = [_render_table(
+        ["TIME", "NODE", "ACTOR", "REGION", "KNOB", "CHANGE", "TRIGGER",
+         "EVIDENCE"],
+        rows,
+    )]
+    if not rows:
+        out = ["no control-plane events recorded"]
+    dropped = int(getattr(resp, "dropped", 0))
+    if dropped:
+        out.append(f"({dropped} events dropped to the ring bound — "
+                   "raise events.max_entries for longer memory)")
+    return "\n".join(out)
+
+
+def format_cluster_explain(report) -> str:
+    """`cluster explain <region>`: every live override accounted for as
+    its decision chain, orphans called out (pure render over the
+    obs/events.explain_region report — tests drive it directly)."""
+    rid = report["region_id"]
+    out = [f"region {rid}: {len(report['live'])} live override(s)"]
+    if not report["live"]:
+        out.append("  serving at configured defaults — nothing to explain")
+    for entry in report["entries"]:
+        knob, value = entry["knob"], entry["value"]
+        if entry["explained"]:
+            out.append(f"  {knob} = {value}")
+        else:
+            out.append(f"  {knob} = {value}   ** ORPHAN: no explaining "
+                       "event (ring forgot, or a writer bypassed the "
+                       "ledger) **")
+        for e in entry["chain"]:
+            out.append(
+                f"    {_fmt_event_time(e.ts_ms)} [{e.node_id or '-'}] "
+                f"{e.actor}: {e.knob} {e.old or '-'} -> {e.new or '-'} "
+                f"({e.trigger}) {e.evidence or ''}".rstrip()
+            )
+    if report["orphans"]:
+        out.append(f"  orphan knobs: {', '.join(report['orphans'])}")
+    return "\n".join(out)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dingo-cli")
     p.add_argument("--coordinator", default="127.0.0.1:20001",
@@ -497,6 +567,17 @@ def build_parser() -> argparse.ArgumentParser:
     consistency = cluster.add_parser("consistency")
     consistency.add_argument("--region", type=int, default=0,
                              help="limit to one region id")
+    events = cluster.add_parser("events")  # merged decision timeline
+    events.add_argument("--region", type=int, default=0,
+                        help="limit to one region id")
+    events.add_argument("--actor", default="",
+                        help="limit to one controller (tuner/shed/tier/"
+                             "recovery/planner/capacity/cache)")
+    events.add_argument("--limit", type=int, default=50,
+                        help="newest N events (0 = everything merged)")
+    explain = cluster.add_parser("explain")  # live overrides -> chains
+    explain.add_argument("region", type=int,
+                         help="region id to explain")
     jobs = cluster.add_parser("jobs")
     jobs.add_argument("--include-done", action="store_true")
     detail = cluster.add_parser("region-detail")
@@ -816,6 +897,34 @@ def run_command(client: DingoClient, args) -> int:
             pb.GetRegionMetricsRequest(region_id=args.region)
         )
         print(format_cluster_consistency(r, region_id=args.region))
+    elif g == "cluster" and c == "events":
+        stub = client.coordinator_service("ClusterStatService")
+        r = stub.EventDump(pb.EventDumpRequest(
+            region_id=args.region, actor=args.actor, limit=args.limit,
+        ))
+        print(format_cluster_events(r, limit=args.limit))
+    elif g == "cluster" and c == "explain":
+        # live overrides from the freshest replica rows + the merged
+        # timeline, reconciled with the SAME pure function the
+        # coordinator runs (obs/events.explain_region — no divergent
+        # logic between the RPC face and the CLI)
+        from dingo_tpu.obs.events import explain_region, live_overrides
+        from dingo_tpu.server import convert as _convert
+
+        stub = client.coordinator_service("ClusterStatService")
+        rmet = stub.GetRegionMetrics(
+            pb.GetRegionMetricsRequest(region_id=args.region)
+        )
+        live = {}
+        for entry in rmet.regions:
+            if entry.stale:
+                continue
+            if entry.metrics.is_leader or not live:
+                live = live_overrides(entry.metrics)
+        edump = stub.EventDump(pb.EventDumpRequest(region_id=args.region))
+        events = [_convert.control_event_from_pb(e) for e in edump.events]
+        print(format_cluster_explain(
+            explain_region(args.region, live, events)))
     elif g == "cluster" and c == "jobs":
         stub = client.coordinator_service("JobService")
         r = stub.ListJobs(pb.ListJobsRequest(include_done=args.include_done))

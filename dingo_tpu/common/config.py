@@ -572,6 +572,26 @@ FLAGS.define("tier_interval_s", 30.0, mutable=True,
                    "applies at most one transition per store — demotions "
                    "and promotions are full-region copies, so pacing them "
                    "keeps the build/copy bandwidth bounded")
+FLAGS.define("events_enabled", True, mutable=True,
+             help_="control-plane flight recorder (obs/events.py): every "
+                   "controller actuation — tuner step, shed ladder move, "
+                   "tier transition, recovery rung, replica scale, "
+                   "capacity advisory, cache stale rung — records a "
+                   "structured event with the evidence it decided on. "
+                   "Events ride heartbeats to the coordinator for the "
+                   "cluster timeline and `cluster explain`. Off = emit "
+                   "is one flag read, nothing is allocated or shipped")
+FLAGS.define("events_max_entries", 1024, mutable=True,
+             help_="bound on the per-node event ring AND the "
+                   "coordinator's merged timeline: past it the oldest "
+                   "events fall off (never-shipped ones count into "
+                   "event.dropped). Controller decisions are crontab-"
+                   "paced, so 1024 covers hours of history")
+FLAGS.define("events_heartbeat_batch", 128, mutable=True,
+             help_="max events one heartbeat carries to the coordinator "
+                   "(each ships exactly once — the collector keeps a "
+                   "harvest cursor). 0 keeps the ledger node-local "
+                   "(EventDump/flight bundles still see it)")
 FLAGS.define("vector_blocked_layout", "auto", mutable=True,
              help_="maintain a dimension-blocked ([n_blocks, capacity, "
                    "block_d]) scan mirror + per-block norms in float/sq8 "

@@ -40,6 +40,11 @@ class ScaleReplicaOp:
     target: int
     add_stores: List[str]
     drop_stores: List[str]
+    #: heartbeat evidence the sizing read (event-ledger snapshot): the
+    #: region's leader QPS and the per-replica QPS target in force
+    qps: float = 0.0
+    target_qps: float = 0.0
+    floor: int = 0
 
 
 #: load-aware weight: one load unit per this many index bytes (memory is a
@@ -258,7 +263,8 @@ class ReplicaPlanScheduler:
                 if not cand:
                     continue
                 ops.append(ScaleReplicaOp(
-                    rid, current, current + 1, [cand[0]], []
+                    rid, current, current + 1, [cand[0]], [],
+                    qps=float(qps), target_qps=target_qps, floor=floor,
                 ))
             elif target < current and current > floor:
                 leader = next(
@@ -272,11 +278,14 @@ class ReplicaPlanScheduler:
                     followers, key=lambda s: store_load.get(s, 0.0)
                 )
                 ops.append(ScaleReplicaOp(
-                    rid, current, current - 1, [], [drop]
+                    rid, current, current - 1, [], [drop],
+                    qps=float(qps), target_qps=target_qps, floor=floor,
                 ))
         return ops
 
     def dispatch(self) -> int:
+        from dingo_tpu.obs.events import EVENTS
+
         ops = self.plan()
         for op in ops:
             peers = list(self.control.regions[op.region_id].peers)
@@ -286,6 +295,17 @@ class ReplicaPlanScheduler:
             for s in op.drop_stores:
                 peers = [p for p in peers if p != s]
                 self.control.change_peer(op.region_id, peers)
+            EVENTS.emit(
+                "planner", op.region_id, "replicas", op.current, op.target,
+                trigger="scale",
+                evidence={
+                    "qps": round(op.qps, 3),
+                    "target_qps": op.target_qps,
+                    "floor": op.floor,
+                    "add": list(op.add_stores),
+                    "drop": list(op.drop_stores),
+                },
+            )
         return len(ops)
 
 

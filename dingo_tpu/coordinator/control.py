@@ -142,6 +142,14 @@ class CoordinatorControl:
         #: beat that re-derives the same one
         self._capacity_advised: set = set()
         self.jobs: List[RegionCmd] = []
+        #: control-plane flight recorder (obs/events.py): merged cluster
+        #: timeline of controller decisions harvested from heartbeats +
+        #: the coordinator's own planner/capacity emissions. In-memory
+        #: like store_metrics — stores re-ship nothing, the ledger may
+        #: forget
+        from dingo_tpu.obs.events import ClusterTimeline
+
+        self.events = ClusterTimeline()
         self._next_region_id = 1000
         self._next_cmd_id = 1
         self._recover()
@@ -323,6 +331,9 @@ class CoordinatorControl:
             # set demand + advisory tier/split recommendations. Same
             # outside-the-lock, never-raises stance as _check_integrity
             self._update_capacity(store_id, metrics)
+            # control-plane events harvested by the store's collector
+            # fold into the merged cluster timeline — same stance
+            self._merge_events(store_id, metrics, beat_ms)
         return pending
 
     def reset_sent_cmds(self) -> int:
@@ -537,13 +548,86 @@ class CoordinatorControl:
             plan["resident_bytes"])
         g("capacity.advice_count", labels=labels).set(
             len(plan["advice"]))
+        from dingo_tpu.obs.events import EVENTS
+
         for _sid, rid, kind in fresh:
             METRICS.counter("capacity.advisories", region_id=rid,
                             labels={"kind": kind}).add(1)
+            advice = next(a for a in plan["advice"]
+                          if a.region_id == rid and a.kind == kind)
+            EVENTS.emit(
+                "capacity", rid, "advisory", "", kind,
+                trigger="headroom",
+                evidence={
+                    "store": store_id,
+                    "headroom_frac": round(plan["headroom_frac"], 4),
+                    "demand_p99_bytes": plan["demand_p99_bytes"],
+                    "bytes_at_stake": advice.bytes_at_stake,
+                    "reason": advice.reason,
+                },
+            )
             region_log(_log, rid).info(
-                "capacity advisory (%s): %s", kind,
-                next(a.reason for a in plan["advice"]
-                     if a.region_id == rid and a.kind == kind))
+                "capacity advisory (%s): %s", kind, advice.reason)
+
+    # ---------------- control-plane event timeline ---------------------------
+    def _merge_events(self, store_id: str, metrics, recv_ms: int) -> None:
+        """Fold one beat's harvested control-plane events into the merged
+        cluster timeline. Receive-clock normalization: each event's
+        store-stamped wall clock is adjusted by recv_ms - collected_at_ms
+        (the METRICS_STALE_MS discipline — skewed store clocks must not
+        scramble cross-node causality). Never raises."""
+        try:
+            evs = list(getattr(metrics, "events", ()) or ())
+            if not evs:
+                return
+            collected = int(getattr(metrics, "collected_at_ms", 0) or 0)
+            offset = recv_ms - collected if collected else 0
+            self.events.merge(store_id, evs, offset_ms=offset)
+        except Exception:  # noqa: BLE001 — telemetry must not kill beats
+            _log.exception("event timeline merge failed")
+
+    def _fold_local_events(self) -> None:
+        """The coordinator is a controller too (replica planner, capacity
+        advisor): harvest its OWN ledger into the timeline so `cluster
+        events` shows store and coordinator decisions in one order. Its
+        clock needs no offset — it IS the merge clock."""
+        from dingo_tpu.obs.events import EVENTS
+
+        local = EVENTS.harvest(node_id="coordinator")
+        if local:
+            self.events.merge("coordinator", local)
+
+    def cluster_events(self, region_id: int = 0, actor: str = "",
+                       limit: int = 0) -> List:
+        """Merged cluster timeline, oldest first (region_id 0 / actor ""
+        = no filter)."""
+        self._fold_local_events()
+        return self.events.events(
+            region_id=region_id or None, actor=actor, limit=limit
+        )
+
+    def explain_region_overrides(self, region_id: int) -> Dict:
+        """`cluster explain <region>`: reconcile the region's live
+        overrides (freshest non-stale replica rows, leader preferred)
+        against the merged event timeline — every live knob should be
+        accounted for by a decision chain; the rest are orphans
+        (event.orphan_knobs gauge)."""
+        from dingo_tpu.common.metrics import METRICS
+        from dingo_tpu.obs.events import explain_region, live_overrides
+
+        self._fold_local_events()
+        live: Dict[str, str] = {}
+        for _sid, stale, rm in self.get_region_metrics(region_id):
+            if stale:
+                continue
+            if getattr(rm, "is_leader", False) or not live:
+                live = live_overrides(rm)
+        report = explain_region(
+            region_id, live, self.events.events(region_id=region_id)
+        )
+        METRICS.gauge("event.orphan_knobs", region_id=region_id).set(
+            len(report["orphans"]))
+        return report
 
     def capacity_report(self) -> List[Dict]:
         """Per-store capacity plans, store-id ordered (DebugService /

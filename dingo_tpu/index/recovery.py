@@ -109,6 +109,13 @@ class DeviceRecoveryPlane:
         if fresh:
             self._reg.counter("fault.oom_recoveries",
                               labels={"rung": RUNG_DEGRADE}).add(1)
+            from dingo_tpu.obs.events import EVENTS
+
+            EVENTS.emit(
+                "recovery", region_id, "device_degraded", 0, 1,
+                trigger="oom",
+                evidence={"rung": RUNG_DEGRADE, "reason": reason},
+            )
             region_log(_log, region_id).error(
                 "region device-degraded (%s): serving host-exact, "
                 "device writes deferred to re-materialization", reason)
@@ -167,15 +174,23 @@ class DeviceRecoveryPlane:
         return out
 
     def _run_ladder(self, wrapper, region_id: int) -> None:
+        from dingo_tpu.obs.events import EVENTS
+
         idx = getattr(wrapper, "own_index", None) if wrapper else None
         if idx is None:
             return
         if self._drop_rerank(idx):
             self._reg.counter("fault.oom_recoveries",
                               labels={"rung": RUNG_DROP_RERANK}).add(1)
+            EVENTS.emit("recovery", region_id, "recovery_rung", "",
+                        RUNG_DROP_RERANK, trigger="oom",
+                        evidence={"rung": RUNG_DROP_RERANK})
         if self._evict_mirrors(idx):
             self._reg.counter("fault.oom_recoveries",
                               labels={"rung": RUNG_EVICT_MIRRORS}).add(1)
+            EVENTS.emit("recovery", region_id, "recovery_rung", "",
+                        RUNG_EVICT_MIRRORS, trigger="oom",
+                        evidence={"rung": RUNG_EVICT_MIRRORS})
 
     @staticmethod
     def _drop_rerank(idx) -> bool:
@@ -250,6 +265,12 @@ class DeviceRecoveryPlane:
         # time IS degraded-serving time, so the build plane counts remats
         # next to its rows/batches series
         self._reg.counter("build.remat_rebuilds", region_id=rid).add(1)
+        from dingo_tpu.obs.events import EVENTS
+
+        EVENTS.emit(
+            "recovery", rid, "device_degraded", 1, 0, trigger="remat",
+            evidence={"precision": target or "default"},
+        )
         self.clear_degraded(rid)
         region_log(_log, rid).info(
             "re-materialized from engine at precision=%s — degraded "

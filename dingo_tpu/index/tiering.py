@@ -376,6 +376,12 @@ class TierManager:
         #: corruption test flips destination bytes here.
         self.test_hook: Optional[Callable[[str], None]] = None
         self.transitions = 0
+        #: policy inputs of the CURRENT tick (headroom fraction, windowed
+        #: QPS, advisory flag) — stashed by _tick_inner so _transition can
+        #: snapshot the evidence it decided on into the event ledger.
+        #: Direct demote()/promote() calls (tests, forced walks) carry no
+        #: policy context and emit without it.
+        self._decision_ctx: Optional[Dict[str, Any]] = None
 
     @staticmethod
     def enabled() -> bool:
@@ -493,15 +499,29 @@ class TierManager:
         advisory = any(
             st.advisory for st in self._regions.values()
         )
-        if headroom < demote_at or advisory:
-            victim = self._pick_demote(regions, qps, promote_qps)
-            if victim is not None:
-                return self.demote(node, regions[victim])
-        target = self._pick_promote(
-            regions, qps, promote_qps, limit, in_use, demote_at
-        )
-        if target is not None:
-            return self.promote(node, regions[target])
+        # the exact policy inputs this tick decided on — snapshotted into
+        # the transition's ledger event (obs/events.py)
+        self._decision_ctx = {
+            "headroom": round(headroom, 4),
+            "demote_at": demote_at,
+            "promote_qps": promote_qps,
+            "advisory": advisory,
+        }
+        try:
+            if headroom < demote_at or advisory:
+                victim = self._pick_demote(regions, qps, promote_qps)
+                if victim is not None:
+                    self._decision_ctx["qps"] = round(
+                        qps.get(victim, 0.0), 3)
+                    return self.demote(node, regions[victim])
+            target = self._pick_promote(
+                regions, qps, promote_qps, limit, in_use, demote_at
+            )
+            if target is not None:
+                self._decision_ctx["qps"] = round(qps.get(target, 0.0), 3)
+                return self.promote(node, regions[target])
+        finally:
+            self._decision_ctx = None
         return {"idle": True, "headroom": headroom}
 
     def _headroom(self, node) -> Tuple[int, int]:
@@ -647,6 +667,15 @@ class TierManager:
         st.last_change = time.time()
         self.transitions += 1
         elapsed_ms = (time.perf_counter() - t0) * 1e3
+        from dingo_tpu.obs.events import EVENTS
+
+        evidence: Dict[str, Any] = {"ms": round(elapsed_ms, 1)}
+        if self._decision_ctx:
+            evidence.update(self._decision_ctx)
+        EVENTS.emit(
+            "tier", rid, "tier", RUNGS[src_rung], RUNGS[target],
+            trigger=kind, evidence=evidence,
+        )
         self._reg.counter(
             "tier.demotions" if kind == "demote" else "tier.promotions",
             region_id=rid, labels={"to": RUNGS[target]},

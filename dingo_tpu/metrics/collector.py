@@ -15,6 +15,7 @@ Every figure is double-published:
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Optional
@@ -94,6 +95,20 @@ class StoreMetricsCollector:
                 except Exception:  # noqa: BLE001
                     self.collect_errors += 1
                     _log.exception("collect failed for region %d", region.id)
+            # control-plane flight recorder (obs/events.py): harvest the
+            # decision events emitted since the last beat — each ships
+            # exactly once; a failed pass before this point leaves them
+            # pending for the next one
+            from dingo_tpu.obs.events import EVENTS
+
+            evs = EVENTS.harvest(node_id=node.store_id)
+            if evs:
+                snap.events = list(evs)
+                self.registry.gauge("event.heartbeat_bytes").set(sum(
+                    len(e.actor) + len(e.knob) + len(e.old) + len(e.new)
+                    + len(e.trigger) + len(e.evidence) + len(e.node_id)
+                    + len(e.trace_id) + len(e.flight_bundle_id) + 24
+                    for e in evs))
             self._publish(snap)
         except Exception:  # noqa: BLE001
             ok = False
@@ -231,6 +246,22 @@ class StoreMetricsCollector:
         rm.serving_tier = TIERING.region_tier(
             region.id, getattr(own, "_precision", "") if own else ""
         )
+        # control-plane flight recorder (obs/events.py): snapshot the
+        # live overrides in force RIGHT NOW as compact JSON — `cluster
+        # explain` reconciles these against the merged event timeline
+        # (a live knob with no explaining event = orphan)
+        from dingo_tpu.obs.events import events_enabled
+
+        if events_enabled():
+            ts = TIERING.state().get(region.id)
+            advisory = self.registry.gauge(
+                "qos.precision_advisory", region.id).get()
+            rm.live_knobs = json.dumps({
+                "tuning": dict(getattr(own, "tuning", None) or {}),
+                "advisory_precision": "sq8" if advisory > 0 else "",
+                "tier": rm.serving_tier,
+                "tier_base": ts["base"] if ts else rm.serving_tier,
+            }, sort_keys=True, separators=(",", ":"))
         last = INTEGRITY.last_verified_ms(region.id)
         self.registry.gauge(
             "consistency.digest_age_s", region.id
@@ -279,6 +310,18 @@ class StoreMetricsCollector:
 
             HEAT.forget_region(rid)
             COST.forget_region(rid)
+            # event ledger + tier ladder + cache stale-serving memo: a
+            # departed region's decision history / rung / engage state
+            # must not leak (tiering was missing from this sweep — a
+            # region re-created with the same id would inherit its
+            # predecessor's rung)
+            from dingo_tpu.cache import policy as cache_policy
+            from dingo_tpu.index.tiering import TIERING
+            from dingo_tpu.obs.events import EVENTS
+
+            EVENTS.forget_region(rid)
+            TIERING.forget_region(rid)
+            cache_policy.forget_region(rid)
         self._published_regions = current
         g = self.registry.gauge
         g("store.device.bytes_in_use").set(snap.device_bytes_in_use)

@@ -94,6 +94,12 @@ class RegionMetricsSnapshot:
     #: region's reads — hbm / hbm_sq8 / host_sq8 / mmap_sq8 ("" before
     #: the first collection; `cluster top` TIER column)
     serving_tier: str = ""
+    #: control-plane flight recorder (obs/events.py): compact JSON of the
+    #: live overrides in force on this region at collect time —
+    #: {"tuning": {...}, "advisory_precision": ..., "tier": ...,
+    #:  "tier_base": ...}. "" = none. `cluster explain` reconciles these
+    #: against the event ledger (a live knob with no event = orphan)
+    live_knobs: str = ""
 
 
 @persist.register
@@ -110,6 +116,11 @@ class StoreMetricsSnapshot:
     regions: List[RegionMetricsSnapshot] = dataclasses.field(
         default_factory=list
     )
+    #: control-plane events (obs/events.Event) harvested since the last
+    #: beat — each ledger entry ships exactly once (bounded by
+    #: events.heartbeat_batch); the coordinator merges them into its
+    #: cluster timeline. Untyped list: snapshot must not import obs/
+    events: List = dataclasses.field(default_factory=list)
 
     def region(self, region_id: int) -> RegionMetricsSnapshot:
         for r in self.regions:

@@ -154,6 +154,21 @@ class FlightRecorder:
         except Exception:  # noqa: BLE001 — black box must never raise
             return {}
 
+    @staticmethod
+    def _events_state() -> list:
+        """The newest control-plane decisions at capture time
+        (obs/events.py): an anomaly bundle should answer "what did the
+        controllers just DO" without a second RPC — the knob walk that
+        led into the episode is usually the diagnosis."""
+        try:
+            import dataclasses as _dc
+
+            from dingo_tpu.obs.events import EVENTS
+
+            return [_dc.asdict(e) for e in EVENTS.last_before(32)]
+        except Exception:  # noqa: BLE001 — black box must never raise
+            return []
+
     # ---- triggers ----------------------------------------------------------
     def on_slow_query(self, rec: Dict[str, Any]) -> str:
         """Tracer hook: `rec` is the slow-log record (sampled span or the
@@ -305,6 +320,7 @@ class FlightRecorder:
             "cost": self._family_state(now_flat, "cost."),
             "capacity": self._family_state(now_flat, "capacity."),
             "integrity": self._integrity_state(),
+            "events": self._events_state(),
             "config": config,
         }
         blob = zlib.compress(

@@ -590,6 +590,8 @@ class ShedController:
         queue-wait watermark exceeds ``qos.max_queue_ms``, de-escalate
         one level once it falls below half of it (hysteresis band), hold
         in between. Returns the new degrade level."""
+        from dingo_tpu.obs.events import EVENTS
+
         level = self._level.get(region_id, 0)
         g = self.plane.registry.gauge
         if max_queue_ms > 0 and pressure_ms > max_queue_ms:
@@ -597,6 +599,13 @@ class ShedController:
                 level += 1
                 desc = self._apply_level(index, level)
                 self._level[region_id] = level
+                EVENTS.emit(
+                    "shed", region_id, "degrade_level", level - 1, level,
+                    trigger="escalate",
+                    evidence={"pressure_ms": round(pressure_ms, 2),
+                              "max_queue_ms": max_queue_ms,
+                              "step": desc or ""},
+                )
                 self.plane.registry.counter(
                     "qos.degrade_steps", region_id=region_id,
                     labels={"direction": "down"},
@@ -618,6 +627,13 @@ class ShedController:
                 # tick" outlives the level count; the floor ends it)
                 desc = self._apply_level(index, DEGRADE_LOWER_PROBE)
                 if desc:
+                    EVENTS.emit(
+                        "shed", region_id, "degrade_level", level, level,
+                        trigger="escalate",
+                        evidence={"pressure_ms": round(pressure_ms, 2),
+                                  "max_queue_ms": max_queue_ms,
+                                  "step": desc},
+                    )
                     self.plane.registry.counter(
                         "qos.degrade_steps", region_id=region_id,
                         labels={"direction": "down"},
@@ -634,6 +650,12 @@ class ShedController:
                 self._restore(index)
                 self._reset_quality(region_id)
             self._level[region_id] = level
+            EVENTS.emit(
+                "shed", region_id, "degrade_level", level + 1, level,
+                trigger="restore" if level == 0 else "relax",
+                evidence={"pressure_ms": round(pressure_ms, 2),
+                          "max_queue_ms": max_queue_ms},
+            )
             self.plane.registry.counter(
                 "qos.degrade_steps", region_id=region_id,
                 labels={"direction": "up"},
@@ -665,6 +687,8 @@ class ShedController:
                 wrapper = region.vector_index_wrapper
                 if wrapper is not None and wrapper.own_index is not None:
                     by_id[region.id] = wrapper.own_index
+        from dingo_tpu.obs.events import EVENTS
+
         for rid in set(self._level) | set(self._saved):
             index = by_id.get(rid)
             if index is not None:
@@ -672,6 +696,11 @@ class ShedController:
             else:
                 self._saved.pop(rid, None)  # region departed: just drop
                 self.registry_gauge_advisory(rid, 0.0)
+            EVENTS.emit(
+                "shed", rid, "degrade_level",
+                self._level.get(rid, 0), 0, trigger="disable",
+                evidence={"reason": "shed policy flipped off"},
+            )
             self._level.pop(rid, None)
             self.plane.registry.gauge(
                 "qos.degrade_level", region_id=rid).set(0.0)

@@ -258,6 +258,15 @@ class SloTuner:
         if op.applied:
             index.tuning[op.knob] = int(op.new)
             self._plane().reset_region(index.id)
+        from dingo_tpu.obs.events import EVENTS
+
+        EVENTS.emit(
+            "tuner", index.id, op.knob, op.old, op.new,
+            trigger=op.direction if op.applied else "advise",
+            evidence={"ci_low": round(ci_lo, 4), "ci_high": round(ci_hi, 4),
+                      "slo": slo, "p99_ms": p99_ms, "budget_ms": budget,
+                      "queries": int(estimate.get("queries", 0))},
+        )
         self._note(op, getattr(index, "_precision", "fp32"))
         _log.info(
             "tuner region %d: %s %s %s -> %s (recall CI [%.4f, %.4f], "

@@ -274,12 +274,40 @@ _REGION_METRIC_FIELDS = (
     "cost_row_us",
     # memory-tier ladder (index/tiering.py): serving rung name
     "serving_tier",
+    # control-plane flight recorder (obs/events.py): live-overrides JSON
+    "live_knobs",
 )
 
 _STORE_METRIC_FIELDS = (
     "store_id", "collected_at_ms", "device_bytes_in_use",
     "device_bytes_limit", "device_peak_bytes", "engine_key_count",
 )
+
+# control-plane decision events (obs/events.Event <-> pb.ControlEvent);
+# same field names on both sides, all scalars
+_CONTROL_EVENT_FIELDS = (
+    "actor", "region_id", "knob", "old", "new", "trigger", "evidence",
+    "ts_ms", "actor_seq", "node_id", "trace_id", "flight_bundle_id",
+)
+
+
+def control_event_to_pb(ev, out: Optional[pb.ControlEvent] = None
+                        ) -> pb.ControlEvent:
+    out = out if out is not None else pb.ControlEvent()
+    for f in _CONTROL_EVENT_FIELDS:
+        v = getattr(ev, f)
+        # old/new are free-typed on the ledger Event (ints, floats, rung
+        # names, None); the wire carries strings
+        if f in ("old", "new"):
+            v = "" if v is None else str(v)
+        setattr(out, f, v)
+    return out
+
+
+def control_event_from_pb(m: pb.ControlEvent):
+    from dingo_tpu.obs.events import Event
+
+    return Event(**{f: getattr(m, f) for f in _CONTROL_EVENT_FIELDS})
 
 
 def region_metrics_to_pb(rm, out: Optional[pb.RegionMetrics] = None
@@ -305,6 +333,8 @@ def store_metrics_to_pb(snap, out: Optional[pb.StoreMetrics] = None
         setattr(out, f, getattr(snap, f))
     for rm in snap.regions:
         region_metrics_to_pb(rm, out.regions.add())
+    for ev in getattr(snap, "events", ()):
+        control_event_to_pb(ev, out.events.add())
     return out
 
 
@@ -315,4 +345,5 @@ def store_metrics_from_pb(m: pb.StoreMetrics):
         **{f: getattr(m, f) for f in _STORE_METRIC_FIELDS}
     )
     snap.regions = [region_metrics_from_pb(r) for r in m.regions]
+    snap.events = [control_event_from_pb(e) for e in m.events]
     return snap

@@ -163,6 +163,8 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "TraceChromeDump": (pb.MetricsDumpRequest, pb.MetricsDumpResponse),
         "FailPoint": (pb.FailPointRequest, pb.FailPointResponse),
         "FlightDump": (pb.FlightDumpRequest, pb.FlightDumpResponse),
+        # process-local control-plane event ring (obs/events.py)
+        "EventDump": (pb.EventDumpRequest, pb.EventDumpResponse),
     },
     "CoordinatorService": {
         "Hello": (pb.HelloRequest, pb.HelloResponse),
@@ -195,6 +197,9 @@ SERVICE_SCHEMA: Dict[str, Dict[str, Tuple[type, type]]] = {
         "GetRegionMetrics": (
             pb.GetRegionMetricsRequest, pb.GetRegionMetricsResponse,
         ),
+        # merged cross-node control-plane timeline (obs/events.py) —
+        # same message pair as the store-local DebugService.EventDump
+        "EventDump": (pb.EventDumpRequest, pb.EventDumpResponse),
     },
     "RegionControlService": {
         "RegionSnapshot": (

@@ -1470,6 +1470,22 @@ class DebugService:
             resp.payload_bundle_id, resp.payload = found
         return resp
 
+    def EventDump(self, req: pb.EventDumpRequest) -> pb.EventDumpResponse:
+        """This process's control-plane decision ring (obs/events.py),
+        oldest first — harvested-but-unevicted events included, so the
+        local view overlaps the coordinator's merged timeline."""
+        from dingo_tpu.obs.events import EVENTS
+
+        resp = pb.EventDumpResponse()
+        for ev in EVENTS.recent(
+            limit=int(req.limit) or 0,
+            region_id=req.region_id or None,
+            actor=req.actor,
+        ):
+            convert.control_event_to_pb(ev, resp.events.add())
+        resp.dropped = EVENTS.dropped
+        return resp
+
 
 class CoordinatorService:
     def __init__(self, control: CoordinatorControl, tso: TsoControl):
@@ -2016,6 +2032,23 @@ class ClusterStatService:
             entry.stale = stale
             convert.region_metrics_to_pb(rm, entry.metrics)
         resp.diverged_region_ids.extend(self.control.diverged_regions())
+        return resp
+
+    def EventDump(self, req: pb.EventDumpRequest) -> pb.EventDumpResponse:
+        """The merged cross-node control-plane timeline (heartbeat-
+        harvested store events + the coordinator's own planner/capacity
+        decisions), causally ordered — `cluster events` / `cluster
+        explain` render this."""
+        resp = pb.EventDumpResponse()
+        for ev in self.control.cluster_events(
+            region_id=int(req.region_id),
+            actor=req.actor,
+            limit=int(req.limit) or 0,
+        ):
+            convert.control_event_to_pb(ev, resp.events.add())
+        from dingo_tpu.obs.events import EVENTS
+
+        resp.dropped = EVENTS.dropped
         return resp
 
 

@@ -259,3 +259,46 @@ def test_pipeline_sweep_regressions(tmp_path):
         "pipeline_sweep.depths.2.saturation_qps",
         "pipeline_sweep.depths.2.steady_state_recompiles",
     ]
+
+
+def test_event_overhead_classification():
+    """ISSUE 20: the flight recorder's bench keys — per-scenario
+    decision counts (events_emitted, tuner_events, tier_events) are
+    cadence accounting, never a regression signal; the overhead_pct
+    keys ride the absolute-points rule; the added-recompiles count
+    rides the zero invariant."""
+    assert bench_diff.classify("recall_slo.events_emitted") is None
+    assert bench_diff.classify("recall_slo.tuner_events") is None
+    assert bench_diff.classify("memory_pressure.tier_events") is None
+    assert bench_diff.classify("event_overhead.events_emitted") is None
+    assert bench_diff.classify(
+        "event_overhead.p50_overhead_pct") == "overhead"
+    assert bench_diff.classify(
+        "mixed_rw.event_overhead_pct") == "overhead"
+    assert bench_diff.classify(
+        "event_overhead.events_added_recompiles") == "recompiles"
+    assert bench_diff.classify("event_overhead.p50_ms_on") is None
+    assert bench_diff.classify("event_overhead.p50_ms_off") is None
+    # the end-to-end arm comparison is informational — CI-host noise
+    # swamps a ~20us emit — and must never gate a round
+    assert bench_diff.classify("event_overhead.arm_delta_pct") is None
+    assert bench_diff.classify("event_overhead.emit_us_per_event") is None
+
+
+def test_event_overhead_growth_is_a_regression():
+    old = {"event_overhead": {
+        "p50_overhead_pct": 0.3, "p50_ms_on": 5.0, "p50_ms_off": 4.99,
+        "events_emitted": 240, "events_added_recompiles": 0,
+    }}
+    new = copy.deepcopy(old)
+    new["event_overhead"]["p50_overhead_pct"] = 1.5    # +1.2pt: in band
+    new["event_overhead"]["events_emitted"] = 480      # cadence, not perf
+    result = bench_diff.compare(old, new)
+    assert result["regressions"] == []
+    new["event_overhead"]["p50_overhead_pct"] = 9.0    # +8.7pt: regression
+    new["event_overhead"]["events_added_recompiles"] = 2
+    result = bench_diff.compare(old, new)
+    assert sorted(r["path"] for r in result["regressions"]) == [
+        "event_overhead.events_added_recompiles",
+        "event_overhead.p50_overhead_pct",
+    ]

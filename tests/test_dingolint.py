@@ -19,6 +19,7 @@ lint_cli = importlib.import_module("tools.lint")
 from tools.dingolint.checkers.bare_jit import BareJitChecker
 from tools.dingolint.checkers.context_handoff import ContextHandoffChecker
 from tools.dingolint.checkers.host_sync import HostSyncChecker
+from tools.dingolint.checkers.knob_audit import KnobAuditChecker
 from tools.dingolint.checkers.ladder_shape import LadderShapeChecker
 from tools.dingolint.checkers.lock_order import LockOrderChecker
 from tools.dingolint.checkers.metric_names import MetricNamesChecker
@@ -604,6 +605,110 @@ def test_metric_names_shim_still_works():
     assert shim.check_file is not None and shim.FAMILY_NAMES
 
 
+# -- knob-audit --------------------------------------------------------------
+
+def test_knob_audit_flags_unevented_tuning_write(tmp_path):
+    src = """
+        def sneak(index):
+            index.tuning["nprobe"] = 64
+    """
+    findings = _lint(tmp_path, "dingo_tpu/sneak.py", src,
+                     KnobAuditChecker())
+    assert len(findings) == 1
+    assert "tuning override write" in findings[0].message
+    assert findings[0].symbol == "sneak"
+
+
+def test_knob_audit_emit_in_same_function_is_clean(tmp_path):
+    src = """
+        from dingo_tpu.obs.events import EVENTS
+
+        def step(index, rid):
+            index.tuning["nprobe"] = 64
+            EVENTS.emit("tuner", rid, "nprobe", 128, 64, trigger="slo")
+    """
+    assert _lint(tmp_path, "dingo_tpu/t.py", src, KnobAuditChecker()) == []
+
+
+def test_knob_audit_exact_caller_coverage(tmp_path):
+    # the writer has no emit itself, but its exact caller does — the
+    # decision and its record one frame apart is the shed-controller
+    # shape and must stay clean
+    src = """
+        from dingo_tpu.obs.events import EVENTS
+
+        class Shed:
+            def _apply(self, index, level):
+                index.tuning["nprobe"] = 32
+                index.tuning.pop("ef", None)
+
+            def step(self, index, rid, level):
+                self._apply(index, level)
+                EVENTS.emit("shed", rid, "degrade_level", 0, level,
+                            trigger="pressure")
+    """
+    assert _lint(tmp_path, "dingo_tpu/s.py", src, KnobAuditChecker()) == []
+
+
+def test_knob_audit_flags_unreachable_writer_and_pop(tmp_path):
+    # same writer, but nobody emitting ever calls it
+    src = """
+        class Shed:
+            def _apply(self, index, level):
+                index.tuning["nprobe"] = 32
+                index.tuning.pop("ef", None)
+    """
+    findings = _lint(tmp_path, "dingo_tpu/s.py", src, KnobAuditChecker())
+    assert len(findings) == 2
+    assert {f.message.split(" without")[0] for f in findings} == {
+        "tuning override write", "tuning override removal"}
+
+
+def test_knob_audit_rung_assign_semantics(tmp_path):
+    # actuation path flagged; __init__/reset construction exempt
+    src = """
+        class TierState:
+            def __init__(self):
+                self.rung = 0
+
+            def reset(self):
+                self.rung = 0
+
+            def demote(self, st):
+                st.rung = 2
+    """
+    findings = _lint(tmp_path, "dingo_tpu/tier.py", src,
+                     KnobAuditChecker())
+    assert len(findings) == 1
+    assert "tier rung move" in findings[0].message
+    assert findings[0].symbol == "TierState.demote"
+
+
+def test_knob_audit_advisory_gauge_set_vs_read(tmp_path):
+    # setting the advisory gauge is an actuation; reading it is not
+    src = """
+        def advise(reg, rid):
+            reg.gauge("qos.precision_advisory", rid).set(1)
+
+        def observe(reg, rid):
+            return reg.gauge("qos.precision_advisory", rid).get()
+    """
+    findings = _lint(tmp_path, "dingo_tpu/adv.py", src,
+                     KnobAuditChecker())
+    assert len(findings) == 1
+    assert "precision advisory set" in findings[0].message
+    assert findings[0].symbol == "advise"
+
+
+def test_knob_audit_inline_suppression(tmp_path):
+    src = """
+        def seam(index):
+            index.tuning["nprobe"] = 8  # dingolint: ok[knob-audit] test seam
+    """
+    assert _lint(tmp_path, "dingo_tpu/seam.py", src,
+                 KnobAuditChecker()) == []
+
+
 # -- baseline mechanics ------------------------------------------------------
 
 def _finding():
@@ -675,7 +780,7 @@ def test_cli_json_mode(capsys):
     out = json.loads(capsys.readouterr().out)
     assert rc == 0 and out["ok"] is True
     assert out["wall_s"] < 30.0
-    assert len(out["checkers"]) == 8
+    assert len(out["checkers"]) == 9
     assert out["findings"] == []
     assert len(out["baselined"]) >= 1
 

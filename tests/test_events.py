@@ -1,0 +1,633 @@
+"""Control-plane flight recorder (obs/events.py, ISSUE 20).
+
+The load-bearing claims, in ledger order: every one of the seven
+controllers emits a decision event whose evidence snapshots the exact
+inputs it read; the per-node ring is bounded with honest drop
+accounting (a harvested eviction is not a loss); per-actor sequence
+numbers stay monotone across a restart so coordinator dedupe is a
+max-seq watermark; events ride the heartbeat pb round-trip; the
+coordinator merges skewed store clocks into one causal timeline; and
+`cluster explain` accounts for every live override as a decision chain
+— zero orphans when nothing bypassed the ledger, loud orphans when
+something did.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from dingo_tpu.common.config import FLAGS
+from dingo_tpu.common.metrics import METRICS
+from dingo_tpu.metrics.snapshot import (
+    RegionMetricsSnapshot,
+    StoreMetricsSnapshot,
+)
+from dingo_tpu.obs.events import (
+    ACTORS,
+    EVENTS,
+    ClusterTimeline,
+    Event,
+    EventLedger,
+    explain_region,
+    live_overrides,
+)
+from dingo_tpu.server import convert
+from dingo_tpu.server import dingo_pb2 as pb
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    saved = {k: FLAGS.get(k) for k in (
+        "events_enabled", "events_max_entries", "events_heartbeat_batch",
+    )}
+    EVENTS.reset()
+    yield
+    for k, v in saved.items():
+        FLAGS.set(k, v)
+    EVENTS.reset()
+
+
+def _mk_event(**kw):
+    base = dict(actor="tuner", region_id=7, knob="nprobe", old="8",
+                new="16", trigger="tighten", evidence="", ts_ms=1000,
+                actor_seq=1, node_id="s1")
+    base.update(kw)
+    return Event(**base)
+
+
+# ---------------------------------------------------------------------------
+# ledger mechanics
+# ---------------------------------------------------------------------------
+
+def test_emit_records_stringified_change_and_evidence():
+    c0 = METRICS.counter("event.emitted", region_id=42,
+                         labels={"actor": "tuner"}).get()
+    ev = EVENTS.emit("tuner", 42, "nprobe", 8, 16, trigger="tighten",
+                     evidence={"ci_low": 0.71, "slo": 0.95})
+    assert ev is not None
+    assert (ev.actor, ev.region_id, ev.knob) == ("tuner", 42, "nprobe")
+    assert (ev.old, ev.new) == ("8", "16")        # stringified
+    assert ev.ts_ms > 0 and ev.actor_seq > 0
+    assert ev.evidence_dict() == {"ci_low": 0.71, "slo": 0.95}
+    assert METRICS.counter("event.emitted", region_id=42,
+                           labels={"actor": "tuner"}).get() == c0 + 1
+    assert EVENTS.recent(region_id=42) == [ev]
+
+
+def test_flag_off_means_inert():
+    FLAGS.set("events_enabled", False)
+    assert EVENTS.emit("tuner", 1, "nprobe", 8, 16,
+                       trigger="tighten") is None
+    assert EVENTS.recent() == [] and EVENTS.state()["entries"] == 0
+
+
+def test_ring_bound_counts_only_unharvested_drops():
+    FLAGS.set("events_max_entries", 16)
+    for i in range(20):
+        EVENTS.emit("shed", 1, "degrade_level", i, i + 1,
+                    trigger="escalate")
+    st = EVENTS.state()
+    assert st["entries"] == 16
+    assert EVENTS.dropped == 4            # overflowed before any harvest
+    # ship everything, then overflow again: evicting harvested entries is
+    # a normal ring bound, NOT a loss
+    assert len(EVENTS.harvest(batch=16, node_id="s1")) == 16
+    for i in range(16):
+        EVENTS.emit("shed", 1, "degrade_level", i, i + 1,
+                    trigger="escalate")
+    assert EVENTS.dropped == 4
+    assert EVENTS.state()["entries"] == 16
+
+
+def test_actor_seq_monotone_within_and_across_restart():
+    a = EVENTS.emit("tier", 1, "tier", "hbm", "hbm_sq8", trigger="demote")
+    b = EVENTS.emit("tier", 1, "tier", "hbm_sq8", "host_sq8",
+                    trigger="demote")
+    assert b.actor_seq == a.actor_seq + 1
+    time.sleep(0.002)                      # let the epoch-ms seed advance
+    fresh = EventLedger()                  # a restarted store's ledger
+    c = fresh.emit("tier", 1, "tier", "host_sq8", "hbm_sq8",
+                   trigger="promote")
+    assert c.actor_seq > b.actor_seq
+
+
+def test_harvest_ships_each_event_exactly_once_and_stamps_node():
+    for i in range(3):
+        EVENTS.emit("tuner", 1, "nprobe", i, i + 1, trigger="tighten")
+    first = EVENTS.harvest(batch=2, node_id="s9")
+    assert len(first) == 2 and all(e.node_id == "s9" for e in first)
+    second = EVENTS.harvest(batch=8, node_id="s9")
+    assert len(second) == 1
+    assert EVENTS.harvest(batch=8, node_id="s9") == []
+    # shipped events stay queryable locally until the bound evicts them
+    assert len(EVENTS.recent()) == 3
+
+
+def test_forget_region_drops_only_that_region():
+    EVENTS.emit("tuner", 1, "nprobe", 8, 16, trigger="tighten")
+    EVENTS.emit("tuner", 2, "nprobe", 8, 16, trigger="tighten")
+    EVENTS.forget_region(1)
+    evs = EVENTS.recent()
+    assert [e.region_id for e in evs] == [2]
+
+
+# ---------------------------------------------------------------------------
+# pb transport round trip
+# ---------------------------------------------------------------------------
+
+def test_control_event_pb_round_trip():
+    ev = _mk_event(evidence=json.dumps({"p": 1}), trace_id="abc12",
+                   flight_bundle_id="fb-1")
+    back = convert.control_event_from_pb(convert.control_event_to_pb(ev))
+    assert back == ev
+
+
+def test_store_metrics_pb_round_trip_carries_events_and_live_knobs():
+    knobs = json.dumps({"tuning": {"nprobe": 96}, "tier": "host_sq8",
+                        "tier_base": "hbm"})
+    snap = StoreMetricsSnapshot("s1", regions=[
+        RegionMetricsSnapshot(7, is_leader=True, live_knobs=knobs),
+    ])
+    snap.events = [_mk_event(), _mk_event(actor="shed",
+                                          knob="degrade_level",
+                                          actor_seq=2)]
+    back = convert.store_metrics_from_pb(convert.store_metrics_to_pb(snap))
+    assert [e.actor for e in back.events] == ["tuner", "shed"]
+    assert back.events[0] == snap.events[0]
+    assert back.regions[0].live_knobs == knobs
+
+
+# ---------------------------------------------------------------------------
+# coordinator timeline: skew normalization + dedupe
+# ---------------------------------------------------------------------------
+
+def test_timeline_orders_by_receive_adjusted_clock():
+    tl = ClusterTimeline()
+    # store A's clock runs 10s behind: its event happened AFTER b's in
+    # real time, but its raw ts is smaller
+    a = _mk_event(node_id="sA", ts_ms=1_000, actor_seq=5)
+    b = _mk_event(node_id="sB", actor="shed", knob="degrade_level",
+                  ts_ms=10_500, actor_seq=3)
+    assert tl.merge("sB", [b], offset_ms=0) == 1
+    assert tl.merge("sA", [a], offset_ms=10_000) == 1
+    assert [e.node_id for e in tl.events()] == ["sB", "sA"]
+    # re-delivered batch (duplicate heartbeat / raft replay) is idempotent
+    assert tl.merge("sA", [a], offset_ms=10_000) == 0
+    assert len(tl.events()) == 2
+
+
+def test_timeline_filters_and_forget():
+    tl = ClusterTimeline()
+    tl.merge("s1", [_mk_event(region_id=1, actor_seq=1),
+                    _mk_event(region_id=2, actor="shed", actor_seq=1)])
+    assert [e.region_id for e in tl.events(region_id=2)] == [2]
+    assert [e.actor for e in tl.events(actor="tuner")] == ["tuner"]
+    tl.forget_region(1)
+    assert [e.region_id for e in tl.events()] == [2]
+
+
+def test_coordinator_heartbeat_merges_skewed_stores():
+    from dingo_tpu.coordinator.control import CoordinatorControl
+    from dingo_tpu.engine.raw_engine import MemEngine
+
+    coord = CoordinatorControl(MemEngine(), replication=1)
+    coord.register_store("sA")
+    coord.register_store("sB")
+    now = int(time.time() * 1000)
+    # sA's wall clock is ~10s behind; receive-clock normalization
+    # (recv_ms - collected_at_ms) must put its decision AFTER sB's
+    evA = _mk_event(node_id="sA", actor="shed", knob="degrade_level",
+                    ts_ms=now - 10_000, actor_seq=9)
+    evB = _mk_event(node_id="sB", ts_ms=now - 80, actor_seq=4)
+    snapB = StoreMetricsSnapshot("sB")
+    snapB.collected_at_ms = now - 80
+    snapB.events = [evB]
+    snapA = StoreMetricsSnapshot("sA")
+    snapA.collected_at_ms = now - 10_000
+    snapA.events = [evA]
+    coord.store_heartbeat("sB", metrics=snapB)
+    time.sleep(0.01)
+    coord.store_heartbeat("sA", metrics=snapA)
+    evs = coord.cluster_events(region_id=7)
+    assert [e.node_id for e in evs] == ["sB", "sA"]
+    # duplicate beat dedupes on the (node, actor) max-seq watermark
+    coord.store_heartbeat("sA", metrics=snapA)
+    assert len(coord.cluster_events(region_id=7)) == 2
+
+
+# ---------------------------------------------------------------------------
+# live overrides + explain
+# ---------------------------------------------------------------------------
+
+def test_live_overrides_parses_knob_rollup():
+    rm = RegionMetricsSnapshot(
+        7,
+        live_knobs=json.dumps({"tuning": {"nprobe": 96, "ef": 40},
+                               "advisory_precision": "sq8",
+                               "tier": "host_sq8", "tier_base": "hbm"}),
+        qos_degrade_level=2,
+        device_degraded=True,
+    )
+    assert live_overrides(rm) == {
+        "nprobe": "96", "ef": "40", "precision": "sq8",
+        "tier": "host_sq8", "degrade_level": "2", "device_degraded": "1",
+    }
+    # tier at its base rung is not an override
+    rm2 = RegionMetricsSnapshot(7, live_knobs=json.dumps(
+        {"tuning": {}, "tier": "hbm", "tier_base": "hbm"}))
+    assert live_overrides(rm2) == {}
+    # legacy snapshot without the rollup: only an unambiguous demotion
+    rm3 = RegionMetricsSnapshot(7, serving_tier="host_sq8")
+    assert live_overrides(rm3) == {"tier": "host_sq8"}
+    assert live_overrides(RegionMetricsSnapshot(7, serving_tier="hbm")) \
+        == {}
+
+
+def test_explain_reconstructs_the_full_episode_zero_orphans():
+    """The canonical incident: tuner tightens, pressure sheds, capacity
+    advises, the tier manager demotes, recovery degrades then remats —
+    every surviving override must be accounted for by its chain."""
+    rid = 31
+    EVENTS.emit("tuner", rid, "nprobe", 8, 16, trigger="tighten",
+                evidence={"ci_low": 0.7, "slo": 0.95})
+    EVENTS.emit("shed", rid, "degrade_level", 0, 1, trigger="escalate",
+                evidence={"pressure_ms": 120.0})
+    EVENTS.emit("shed", rid, "degrade_level", 1, 2, trigger="escalate",
+                evidence={"pressure_ms": 200.0})
+    EVENTS.emit("capacity", rid, "advisory", "", "demote",
+                trigger="headroom", evidence={"headroom_frac": 0.03})
+    EVENTS.emit("tier", rid, "tier", "hbm", "host_sq8", trigger="demote",
+                evidence={"headroom": 0.03})
+    EVENTS.emit("recovery", rid, "device_degraded", 0, 1, trigger="oom",
+                evidence={"reason": "RESOURCE_EXHAUSTED"})
+    EVENTS.emit("recovery", rid, "device_degraded", 1, 0, trigger="remat",
+                evidence={"precision": "sq8"})
+    live = {"nprobe": "16", "degrade_level": "2", "tier": "host_sq8"}
+    report = explain_region(rid, live, EVENTS.recent())
+    assert report["orphans"] == []
+    assert all(e["explained"] for e in report["entries"])
+    by_knob = {e["knob"]: e for e in report["entries"]}
+    # the degrade chain shows the whole ladder walk, each event once
+    shed_chain = by_knob["degrade_level"]["chain"]
+    assert [(e.old, e.new) for e in shed_chain] == [("0", "1"), ("1", "2")]
+    # cross-controller causality: the tier chain pulls in the capacity
+    # advisory that triggered the demote
+    assert {e.actor for e in by_knob["tier"]["chain"]} == \
+        {"tier", "capacity"}
+
+
+def test_explain_flags_orphans():
+    rid = 32
+    # no event at all for a live knob
+    report = explain_region(rid, {"ef": "64"}, [])
+    assert report["orphans"] == ["ef"]
+    assert report["entries"][0]["explained"] is False
+    # history exists but the live value is NOT where the newest event
+    # left it: something moved the knob without emitting
+    EVENTS.emit("tuner", rid, "nprobe", 8, 16, trigger="tighten")
+    report = explain_region(rid, {"nprobe": "64"}, EVENTS.recent())
+    assert report["orphans"] == ["nprobe"]
+    # matching value: explained, chain anchored on that event
+    report = explain_region(rid, {"nprobe": "16"}, EVENTS.recent())
+    assert report["orphans"] == []
+
+
+def test_coordinator_explain_sets_orphan_gauge():
+    from dingo_tpu.coordinator.control import CoordinatorControl
+    from dingo_tpu.engine.raw_engine import MemEngine
+
+    coord = CoordinatorControl(MemEngine(), replication=1)
+    coord.register_store("s1")
+    rid = 33
+    now = int(time.time() * 1000)
+    knobs = json.dumps({"tuning": {"nprobe": 16}})
+    snap = StoreMetricsSnapshot("s1", regions=[
+        RegionMetricsSnapshot(rid, is_leader=True, live_knobs=knobs),
+    ])
+    snap.collected_at_ms = now
+    snap.events = [_mk_event(region_id=rid, node_id="s1", new="16",
+                             ts_ms=now, actor_seq=1)]
+    coord.store_heartbeat("s1", metrics=snap)
+    report = coord.explain_region_overrides(rid)
+    assert report["orphans"] == []
+    assert METRICS.gauge("event.orphan_knobs", region_id=rid).get() == 0.0
+    # a knob appears with no explaining event: the gauge goes loud
+    snap2 = StoreMetricsSnapshot("s1", regions=[
+        RegionMetricsSnapshot(rid, is_leader=True, live_knobs=json.dumps(
+            {"tuning": {"nprobe": 16, "ef": 80}})),
+    ])
+    snap2.collected_at_ms = now + 1
+    coord.store_heartbeat("s1", metrics=snap2)
+    report = coord.explain_region_overrides(rid)
+    assert report["orphans"] == ["ef"]
+    assert METRICS.gauge("event.orphan_knobs", region_id=rid).get() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# the seven controllers actually emit
+# ---------------------------------------------------------------------------
+
+def _ivf(region_id, d=32, nlist=16, nprobe=2, precision=""):
+    from dingo_tpu.index import IndexParameter, IndexType, new_index
+
+    return new_index(region_id, IndexParameter(
+        index_type=IndexType.IVF_FLAT, dimension=d, ncentroids=nlist,
+        default_nprobe=nprobe, precision=precision,
+    ))
+
+
+class _PlaneRecorder:
+    def reset_region(self, region_id):
+        pass
+
+
+def test_tuner_emits_with_ci_evidence():
+    from dingo_tpu.obs.tuner import SloTuner
+
+    idx = _ivf(9701, nprobe=1)
+    tuner = SloTuner(slo_recall=0.95, latency_budget_ms=0.0,
+                     quality_plane=_PlaneRecorder())
+    op = tuner.step_index(idx, {
+        "recall": 0.5, "ci_low": 0.49, "ci_high": 0.51, "queries": 100,
+        "trials": 1000, "newest_ts": time.time(),
+        "oldest_ts": time.time() - 1.0,
+    })
+    assert op is not None
+    evs = EVENTS.recent(actor="tuner", region_id=9701)
+    assert len(evs) == 1 and evs[0].knob == op.knob
+    ev = evs[0].evidence_dict()
+    assert ev["slo"] == 0.95 and "ci_low" in ev and ev["queries"] == 100
+
+
+def test_shed_controller_emits_ladder_walk():
+    from dingo_tpu.obs.pressure import ShedController
+
+    rid = 9702
+    idx = _ivf(rid, nprobe=4)
+    ctl = ShedController(node=None)
+    try:
+        assert ctl.step_region(rid, idx, pressure_ms=200.0,
+                               max_queue_ms=50.0) == 1
+        assert ctl.step_region(rid, idx, pressure_ms=5.0,
+                               max_queue_ms=50.0) == 0
+    finally:
+        METRICS.gauge("qos.degrade_level", region_id=rid).set(0.0)
+    evs = EVENTS.recent(actor="shed", region_id=rid)
+    assert [(e.old, e.new, e.trigger) for e in evs] == [
+        ("0", "1", "escalate"), ("1", "0", "restore")]
+    assert evs[0].evidence_dict()["pressure_ms"] == 200.0
+
+
+def test_recovery_emits_degrade():
+    from dingo_tpu.index.recovery import RECOVERY
+
+    rid = 9703
+    try:
+        RECOVERY.mark_degraded(rid, "RESOURCE_EXHAUSTED")
+    finally:
+        RECOVERY.clear_degraded(rid)
+    evs = EVENTS.recent(actor="recovery", region_id=rid)
+    assert len(evs) == 1
+    assert (evs[0].knob, evs[0].new, evs[0].trigger) == \
+        ("device_degraded", "1", "oom")
+    assert evs[0].evidence_dict()["reason"] == "RESOURCE_EXHAUSTED"
+
+
+def test_cache_emits_stale_rung_transitions_not_every_read():
+    from dingo_tpu.cache import policy
+
+    rid = 9704
+    old_bound = FLAGS.get("cache_stale_versions")
+    FLAGS.set("cache_stale_versions", 2)
+    gauge = METRICS.gauge("qos.degrade_level", region_id=rid)
+    try:
+        gauge.set(1.0)
+        assert policy.stale_versions_allowed(rid) == 2
+        assert policy.stale_versions_allowed(rid) == 2   # no re-emit
+        gauge.set(0.0)
+        assert policy.stale_versions_allowed(rid) == 0
+    finally:
+        gauge.set(0.0)
+        policy.forget_region(rid)
+        FLAGS.set("cache_stale_versions", old_bound)
+    evs = EVENTS.recent(actor="cache", region_id=rid)
+    assert [(e.old, e.new, e.trigger) for e in evs] == [
+        ("0", "2", "engage"), ("2", "0", "disengage")]
+    assert evs[0].evidence_dict() == {"degrade_level": 1, "bound": 2}
+
+
+def test_replica_planner_emits_scale_decision():
+    from dingo_tpu.coordinator.balance import ReplicaPlanScheduler
+
+    class _FakeStore:
+        def __init__(self, sid):
+            self.store_id = sid
+
+    class _FakeRegion:
+        def __init__(self, peers):
+            self.peers = list(peers)
+
+    class _FakeControl:
+        def __init__(self):
+            self.regions = {1: _FakeRegion(["s1"])}
+            self._metrics = {
+                "s1": StoreMetricsSnapshot("s1", regions=[
+                    RegionMetricsSnapshot(1, is_leader=True,
+                                          search_qps=120.0),
+                ]),
+                "s2": StoreMetricsSnapshot("s2", regions=[]),
+            }
+
+        def alive_stores(self):
+            return [_FakeStore("s1"), _FakeStore("s2")]
+
+        def get_store_metrics(self):
+            return [(sid, snap, 0.0, False)
+                    for sid, snap in self._metrics.items()]
+
+        def change_peer(self, region_id, peers):
+            self.regions[region_id] = _FakeRegion(peers)
+
+    sched = ReplicaPlanScheduler(_FakeControl(), mode="auto",
+                                 qps_target=50.0)
+    assert sched.dispatch() == 1
+    evs = EVENTS.recent(actor="planner", region_id=1)
+    assert len(evs) == 1
+    assert (evs[0].knob, evs[0].old, evs[0].new) == ("replicas", "1", "2")
+    ev = evs[0].evidence_dict()
+    assert ev["qps"] == 120.0 and ev["target_qps"] == 50.0 and ev["add"]
+
+
+def test_capacity_advisor_emits_headroom_evidence():
+    from dingo_tpu.coordinator.control import CoordinatorControl
+    from dingo_tpu.engine.raw_engine import MemEngine
+
+    saved = {k: FLAGS.get(k) for k in ("capacity_advise",
+                                       "capacity_headroom_target")}
+    FLAGS.set("capacity_advise", True)
+    FLAGS.set("capacity_headroom_target", 0.2)
+    try:
+        coord = CoordinatorControl(MemEngine(), replication=1)
+        coord.register_store("s1")
+        rm = RegionMetricsSnapshot(9705)
+        rm.device_memory_bytes = 200 << 20
+        rm.heat_working_set_p99 = 4 << 20
+        rm.heat_touches = 8000
+        rm.heat_hot_fraction = 0.9
+        snap = StoreMetricsSnapshot("s1", regions=[rm])
+        snap.device_bytes_limit = 256 << 20
+        snap.device_bytes_in_use = 250 << 20
+        coord.store_heartbeat("s1", region_ids=[9705], metrics=snap)
+    finally:
+        for k, v in saved.items():
+            FLAGS.set(k, v)
+    evs = EVENTS.recent(actor="capacity", region_id=9705)
+    assert {e.new for e in evs} == {"demote", "split"}
+    assert all(e.knob == "advisory" and e.trigger == "headroom"
+               for e in evs)
+    ev = evs[0].evidence_dict()
+    assert ev["store"] == "s1" and 0.0 <= ev["headroom_frac"] < 0.2
+    # the coordinator's own decisions fold into the merged timeline
+    assert {e.actor for e in coord.cluster_events(region_id=9705)} == \
+        {"capacity"}
+
+
+def test_tier_demote_emits_and_rides_the_heartbeat():
+    """The full loop on a real single-store cluster: a policy-tick
+    demote emits a tier event; the next metrics collection harvests it
+    into the snapshot and publishes the live-knob rollup that `cluster
+    explain` reconciles against."""
+    from dingo_tpu.index.tiering import TIERING
+    from tools.chaos import DIM, cluster
+
+    TIERING.reset()
+    try:
+        with cluster(1, replication=1, seed=20) as c:
+            rid = c.create_region()
+            _sid, node = c.wait_leader(rid)
+            region = node.get_region(rid)
+            rng = np.random.default_rng(5)
+            ids = np.arange(1, 65, dtype=np.int64)
+            x = rng.standard_normal((64, DIM)).astype(np.float32)
+            node.storage.vector_add(region, ids, x)
+            TIERING.note_advisory(rid)
+            FLAGS.set("tier_enabled", True)
+            TIERING.budget_override = 1
+            try:
+                rep = TIERING.tick(node)
+            finally:
+                FLAGS.set("tier_enabled", False)
+                TIERING.budget_override = None
+            assert rep.get("ok"), rep
+            evs = EVENTS.recent(actor="tier", region_id=rid)
+            assert len(evs) == 1 and evs[0].trigger == "demote"
+            assert evs[0].knob == "tier" and evs[0].old != evs[0].new
+            # the collector ships the event and the live-knob rollup
+            node.metrics._latest_mono = 0.0
+            snap = node.metrics.collect()
+            assert any(e.knob == "tier" for e in snap.events)
+            rm = next(r for r in snap.regions if r.region_id == rid)
+            live = live_overrides(rm)
+            assert live.get("tier") == evs[0].new
+            report = explain_region(rid, live, snap.events)
+            assert report["orphans"] == []
+    finally:
+        TIERING.reset()
+
+
+# ---------------------------------------------------------------------------
+# surfaces: RPC, CLI renderers, flight bundle, offline report
+# ---------------------------------------------------------------------------
+
+def test_debug_service_event_dump():
+    from dingo_tpu.server.services import DebugService
+
+    EVENTS.emit("tuner", 5, "nprobe", 8, 16, trigger="tighten")
+    EVENTS.emit("shed", 6, "degrade_level", 0, 1, trigger="escalate")
+    req = pb.EventDumpRequest()
+    req.region_id = 5
+    resp = DebugService().EventDump(req)
+    assert len(resp.events) == 1
+    assert resp.events[0].actor == "tuner" and resp.events[0].new == "16"
+    assert resp.dropped == 0
+
+
+def test_format_cluster_events_renders_timeline():
+    from dingo_tpu.client.cli import format_cluster_events
+
+    resp = pb.EventDumpResponse()
+    convert.control_event_to_pb(
+        _mk_event(evidence='{"p":1}'), resp.events.add())
+    out = format_cluster_events(resp)
+    for frag in ("ACTOR", "tuner", "nprobe", "8 -> 16", "tighten", "s1"):
+        assert frag in out
+    assert "dropped" not in out
+    resp.dropped = 3
+    assert "3 events dropped" in format_cluster_events(resp)
+    empty = pb.EventDumpResponse()
+    assert "no control-plane events" in format_cluster_events(empty)
+
+
+def test_format_cluster_explain_marks_orphans():
+    from dingo_tpu.client.cli import format_cluster_explain
+
+    rid = 44
+    EVENTS.emit("tuner", rid, "nprobe", 8, 16, trigger="tighten")
+    report = explain_region(rid, {"nprobe": "16", "ef": "80"},
+                            EVENTS.recent())
+    out = format_cluster_explain(report)
+    assert "nprobe = 16" in out and "tuner: nprobe 8 -> 16" in out
+    assert "ef = 80   ** ORPHAN" in out
+    assert "orphan knobs: ef" in out
+    clean = format_cluster_explain(explain_region(rid, {}, []))
+    assert "nothing to explain" in clean
+
+
+def test_flight_bundle_carries_events_section():
+    from dingo_tpu.obs.flight import FLIGHT
+
+    old = FLAGS.get("obs_flight_max_bundles")
+    FLAGS.set("obs_flight_max_bundles", 4)
+    FLIGHT.clear()
+    try:
+        EVENTS.emit("recovery", 9, "device_degraded", 0, 1, trigger="oom")
+        bid = FLIGHT.trigger("manual_test", region_id=9)
+        assert bid
+        bundle = FLIGHT.get_json(bid)
+    finally:
+        FLIGHT.clear()
+        FLAGS.set("obs_flight_max_bundles", old)
+    evs = bundle["events"]
+    assert evs and evs[-1]["actor"] == "recovery"
+    assert evs[-1]["knob"] == "device_degraded"
+
+
+def test_event_report_renders_offline_dump(tmp_path):
+    import importlib
+
+    er = importlib.import_module("tools.event_report")
+    events = [
+        {"actor": "tuner", "region_id": 3, "knob": "nprobe", "old": "8",
+         "new": "16", "trigger": "tighten", "evidence": "",
+         "ts_ms": 1700000000000, "actor_seq": 1, "node_id": "s1"},
+        {"actor": "shed", "region_id": 3, "knob": "degrade_level",
+         "old": "0", "new": "1", "trigger": "escalate", "evidence": "",
+         "ts_ms": 1700000000500, "actor_seq": 1, "node_id": "s1"},
+    ]
+    out = er.render(events)
+    assert "region 3" in out and "2 decision(s)" in out
+    assert "decisions by actor: shed=1, tuner=1" in out
+    assert er.render([], region_id=9) == "no matching control-plane events"
+    # loader accepts a flight bundle shape ({"events": [...]}) too
+    p = tmp_path / "bundle.json"
+    p.write_text(json.dumps({"events": events}))
+    assert len(er.load_events(str(p))) == 2
+
+
+def test_actor_table_covers_the_seven_controllers():
+    assert [a[0] for a in ACTORS] == [
+        "tuner", "shed", "tier", "recovery", "planner", "capacity",
+        "cache",
+    ]

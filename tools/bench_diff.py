@@ -95,6 +95,12 @@ def classify(path: str, summary: Optional[dict] = None) -> Optional[str]:
         # rate (2x measured capacity), not code quality — the goodput and
         # gate keys carry the regression signal
         return None
+    if low in ("events_emitted", "tuner_events", "tier_events"):
+        # flight-recorder decision counts (ISSUE 20): how often the
+        # controllers chose to act under a scenario's traffic — cadence
+        # accounting, not a perf signal; the *overhead_pct keys carry
+        # the ledger's cost gate
+        return None
     if low == "value" and summary is not None and (
         summary.get("unit") == "qps"
     ):

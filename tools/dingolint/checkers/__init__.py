@@ -13,6 +13,7 @@ def all_checkers() -> List[Checker]:
         ContextHandoffChecker,
     )
     from tools.dingolint.checkers.host_sync import HostSyncChecker
+    from tools.dingolint.checkers.knob_audit import KnobAuditChecker
     from tools.dingolint.checkers.ladder_shape import LadderShapeChecker
     from tools.dingolint.checkers.lock_order import LockOrderChecker
     from tools.dingolint.checkers.metric_names import MetricNamesChecker
@@ -28,6 +29,7 @@ def all_checkers() -> List[Checker]:
         ContextHandoffChecker(),
         MetricNamesChecker(),
         RetryPolicyChecker(),
+        KnobAuditChecker(),
     ]
 
 

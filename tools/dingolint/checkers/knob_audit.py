@@ -1,0 +1,163 @@
+"""knob-audit: every controller actuation must be evented.
+
+The control-plane flight recorder (dingo_tpu/obs/events.py) only works
+if writers cannot bypass it: a live override with no explaining event is
+an "orphan knob" in ``cluster explain``, and the only way to guarantee
+zero orphans is to make un-evented actuation a lint failure.
+
+An **actuation site** is one of:
+
+- a subscript write or ``pop`` on a ``.tuning`` mapping
+  (``index.tuning["nprobe"] = v`` / ``index.tuning.pop("ef")``) — the
+  per-region serving-override path every controller shares;
+- an attribute assignment to ``.rung`` — the tier ladder's serving rung
+  (skipped inside ``__init__``/``reset``/``forget_region``, which
+  construct or tear down state rather than actuate);
+- a ``.set(...)`` on the ``qos.precision_advisory`` gauge — the shed
+  ladder's precision advisory IS a knob, the gauge is its storage.
+
+A site passes when its enclosing function either contains an
+``EVENTS.emit(...)`` call itself, or is reachable through EXACT call
+edges from a function that does (the shed controller's ``_apply_level``
+helper writes tuning on behalf of the emitting ``step_region`` — the
+decision and its record live one frame apart, which is fine; an
+unreachable writer is not). Fuzzy edges are deliberately excluded: a
+basename coincidence must not launder an un-evented write.
+
+Deliberate exceptions carry ``# dingolint: ok[knob-audit] reason``
+inline (e.g. a test-only seam), or a baseline entry with a rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set, Tuple
+
+from tools.dingolint.core import Checker, Finding, Module, Repo
+
+#: the ledger itself and its test seams may touch knobs while recording
+_EXEMPT_MODULES = ("dingo_tpu.obs.events",)
+
+#: constructor/teardown functions where a ``.rung =`` assign is state
+#: setup, not an actuation
+_RUNG_EXEMPT_FUNCS = {"__init__", "reset", "forget_region"}
+
+
+def _is_emit_call(node: ast.AST) -> bool:
+    """``EVENTS.emit(...)`` (the module-singleton spelling emission sites
+    use; a renamed alias would need an inline suppression anyway)."""
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "emit"
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "EVENTS"
+    )
+
+
+def _is_tuning_sub_write(node: ast.AST) -> bool:
+    """``X.tuning[...] = v`` / ``X.tuning[...] += v``."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, ast.AugAssign):
+        targets = [node.target]
+    for t in targets:
+        if (isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Attribute)
+                and t.value.attr == "tuning"):
+            return True
+    return False
+
+
+def _is_tuning_pop(node: ast.AST) -> bool:
+    """``X.tuning.pop(...)`` — removing an override actuates too."""
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "pop"
+        and isinstance(node.func.value, ast.Attribute)
+        and node.func.value.attr == "tuning"
+    )
+
+
+def _is_rung_assign(node: ast.AST) -> bool:
+    """``st.rung = v`` — a tier-ladder serving-rung move."""
+    if not isinstance(node, ast.Assign):
+        return False
+    return any(
+        isinstance(t, ast.Attribute) and t.attr == "rung"
+        for t in node.targets
+    )
+
+
+def _is_advisory_set(node: ast.AST) -> bool:
+    """``<registry>.gauge("qos.precision_advisory", ...).set(v)``."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "set"):
+        return False
+    inner = node.func.value
+    if not isinstance(inner, ast.Call):
+        return False
+    args = list(inner.args) + [kw.value for kw in inner.keywords]
+    return any(
+        isinstance(a, ast.Constant) and a.value == "qos.precision_advisory"
+        for a in args
+    )
+
+
+class KnobAuditChecker(Checker):
+    name = "knob-audit"
+    description = (
+        "controller actuations (tuning writes, rung moves, precision "
+        "advisories) must emit a control-plane event or be called from "
+        "a function that does"
+    )
+
+    def _sites(self, module: Module) -> List[Tuple[ast.AST, str]]:
+        """(node, what) per actuation site in one module."""
+        out: List[Tuple[ast.AST, str]] = []
+        for node in ast.walk(module.tree):
+            if _is_tuning_sub_write(node):
+                out.append((node, "tuning override write"))
+            elif _is_tuning_pop(node):
+                out.append((node, "tuning override removal"))
+            elif _is_rung_assign(node):
+                fn = module.enclosing_function(node)
+                if fn is not None and fn.name in _RUNG_EXEMPT_FUNCS:
+                    continue
+                out.append((node, "tier rung move"))
+            elif _is_advisory_set(node):
+                out.append((node, "precision advisory set"))
+        return out
+
+    def check_repo(self, repo: Repo) -> List[Finding]:
+        # emitting roots: every function whose body contains EVENTS.emit
+        roots: Set[str] = set()
+        for module in repo.modules:
+            for local_qual, fnode in module.funcs.items():
+                for node in ast.walk(fnode):
+                    if (_is_emit_call(node)
+                            and module.qualname_of(node) == local_qual):
+                        roots.add(f"{module.name}.{local_qual}")
+                        break
+        covered = repo.callgraph().reachable(roots, fuzzy=False)
+        findings: List[Finding] = []
+        for module in repo.modules:
+            if module.name in _EXEMPT_MODULES:
+                continue
+            for node, what in self._sites(module):
+                local = module.qualname_of(node)
+                qual = f"{module.name}.{local}" if local else ""
+                if qual and qual in covered:
+                    continue
+                f = module.finding(
+                    self.name, node,
+                    f"{what} without a control-plane event: emit via "
+                    "obs.events.EVENTS in this function or an exact "
+                    "caller (orphan knobs defeat `cluster explain`)",
+                )
+                if f is not None:
+                    findings.append(f)
+        return findings

@@ -279,6 +279,17 @@ FAMILY_NAMES = {
         "build.remat_rebuilds",     # PR 13 re-materializations riding
                                     # the streaming bulk-build arm
     },
+    "event": {
+        # control-plane flight recorder (obs/events.py): the decision
+        # event ledger + the coordinator's merged cluster timeline
+        "event.emitted",            # decisions recorded, by {actor}
+        "event.dropped",            # unharvested ring-overflow losses
+        "event.heartbeat_bytes",    # estimated bytes the last beat's
+                                    # event batch added (gauge)
+        "event.orphan_knobs",       # live overrides `cluster explain`
+                                    # could NOT account for (gauge, per
+                                    # region — nonzero = ledger gap)
+    },
 }
 
 

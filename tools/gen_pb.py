@@ -103,6 +103,30 @@ NEW_MESSAGES = {
         ("cost_row_us", 38, T.TYPE_DOUBLE, None, False),
         # memory-tier ladder (index/tiering.py): serving rung name
         ("serving_tier", 39, T.TYPE_STRING, None, False),
+        # control-plane flight recorder (obs/events.py): compact JSON of
+        # the live overrides in force on this region at collect time —
+        # {"tuning": {...}, "advisory_precision": ..., "tier": ...,
+        #  "tier_base": ...}. `cluster explain` reconciles these against
+        # the event ledger (a live knob with no event = orphan)
+        ("live_knobs", 40, T.TYPE_STRING, None, False),
+    ],
+    # control-plane decision event (obs/events.py): one controller
+    # actuation with the metric evidence read at decision time. Rides
+    # heartbeats (StoreMetrics.events) to the coordinator's merged
+    # cluster timeline
+    "ControlEvent": [
+        ("actor", 1, T.TYPE_STRING, None, False),
+        ("region_id", 2, T.TYPE_INT64, None, False),
+        ("knob", 3, T.TYPE_STRING, None, False),
+        ("old", 4, T.TYPE_STRING, None, False),
+        ("new", 5, T.TYPE_STRING, None, False),
+        ("trigger", 6, T.TYPE_STRING, None, False),
+        ("evidence", 7, T.TYPE_STRING, None, False),  # compact JSON
+        ("ts_ms", 8, T.TYPE_INT64, None, False),
+        ("actor_seq", 9, T.TYPE_INT64, None, False),
+        ("node_id", 10, T.TYPE_STRING, None, False),
+        ("trace_id", 11, T.TYPE_STRING, None, False),
+        ("flight_bundle_id", 12, T.TYPE_STRING, None, False),
     ],
     # whole-store snapshot (process device gauges + per-region list)
     "StoreMetrics": [
@@ -113,6 +137,9 @@ NEW_MESSAGES = {
         ("device_peak_bytes", 5, T.TYPE_INT64, None, False),
         ("engine_key_count", 6, T.TYPE_INT64, None, False),
         ("regions", 7, T.TYPE_MESSAGE, ".dingo_tpu.RegionMetrics", True),
+        # control-plane events harvested since the last beat (bounded by
+        # events.heartbeat_batch; each event ships exactly once)
+        ("events", 8, T.TYPE_MESSAGE, ".dingo_tpu.ControlEvent", True),
     ],
     "GetStoreMetricsRequest": [
         ("info", 1, T.TYPE_MESSAGE, ".dingo_tpu.RequestInfo", False),
@@ -168,6 +195,20 @@ NEW_MESSAGES = {
         ("bundles", 3, T.TYPE_MESSAGE, ".dingo_tpu.FlightBundleMeta", True),
         ("payload", 4, T.TYPE_BYTES, None, False),  # zlib(JSON) bundle
         ("payload_bundle_id", 5, T.TYPE_STRING, None, False),
+    ],
+    # event-ledger dump (DebugService on stores: process-local ring;
+    # ClusterStatService on the coordinator: merged cluster timeline)
+    "EventDumpRequest": [
+        ("info", 1, T.TYPE_MESSAGE, ".dingo_tpu.RequestInfo", False),
+        ("region_id", 2, T.TYPE_INT64, None, False),  # 0 = every region
+        ("actor", 3, T.TYPE_STRING, None, False),     # "" = every actor
+        ("limit", 4, T.TYPE_INT64, None, False),      # 0 = default bound
+    ],
+    "EventDumpResponse": [
+        ("info", 1, T.TYPE_MESSAGE, ".dingo_tpu.ResponseInfo", False),
+        ("error", 2, T.TYPE_MESSAGE, ".dingo_tpu.Error", False),
+        ("events", 3, T.TYPE_MESSAGE, ".dingo_tpu.ControlEvent", True),
+        ("dropped", 4, T.TYPE_INT64, None, False),
     ],
 }
 
